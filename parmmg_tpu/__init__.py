@@ -1,0 +1,12 @@
+"""parmmg_tpu: TPU-native parallel tetrahedral mesh adaptation.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of ParMmg
+(distributed anisotropic remeshing by iterative remesh-and-repartition; see
+SURVEY.md): flat sharded mesh arrays, batched remeshing kernels, SFC
+repartitioning, and collective-based interface exchange in place of MPI.
+"""
+
+__version__ = "0.1.0"
+
+from .core.mesh import Mesh  # noqa: F401
+from .core import tags  # noqa: F401

@@ -1,0 +1,1 @@
+from . import adjacency, mesh, metric, tags  # noqa: F401

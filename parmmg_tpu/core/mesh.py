@@ -1,0 +1,384 @@
+"""Flat structure-of-arrays tetrahedral mesh on device.
+
+TPU-native replacement for the reference's pointer-based mesh data model
+(`PMMG_Grp` wrapping `MMG5_Mesh`/`MMG5_Sol`, reference
+`src/libparmmgtypes.h:286-307`). Where Mmg stores linked entity arrays with
+1-based indices, EOK flags and side xpoint/xtetra structures, we store fixed
+capacity, 0-based flat arrays with validity masks — the shape XLA needs for
+batched kernels. Capacities are static (recompile on growth-bucket change);
+live counts are dynamic scalars derived from masks.
+
+Conventions:
+ - vertex/tet/tria/edge slots are valid iff the corresponding mask bit is set;
+   invalid slots may contain arbitrary data and must never be dereferenced
+   unmasked.
+ - `tet[:, i]` is the vertex opposite to local face `i` (standard simplex
+   numbering, same convention the reference inherits from Mmg).
+ - `adja[t, f] = 4*t2 + f2` encodes that face `f` of tet `t` is glued to face
+   `f2` of tet `t2`; `-1` marks a boundary (or unmatched) face. This is the
+   flat analog of Mmg's `adja` built by `MMG3D_hashTetra`.
+ - metric `met` has 1 component (isotropic size h) or 6 (upper-triangular
+   symmetric 3x3 anisotropic metric, order m11,m12,m13,m22,m23,m33 — matching
+   the Medit SolAtVertices symmetric-tensor layout).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import tags
+
+# local face f of a tet is the triple of vertex slots != f, oriented so that
+# the normal points outward for a positively oriented tet.
+FACE_VERTS = np.array(
+    [[1, 2, 3], [0, 3, 2], [0, 1, 3], [0, 2, 1]], dtype=np.int32
+)
+# the 6 edges of a tet as local vertex-slot pairs.
+EDGE_VERTS = np.array(
+    [[0, 1], [0, 2], [0, 3], [1, 2], [1, 3], [2, 3]], dtype=np.int32
+)
+
+
+def _pad2(a: np.ndarray, cap: int, fill) -> np.ndarray:
+    out = np.full((cap,) + a.shape[1:], fill, dtype=a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Mesh:
+    """One shard's worth of mesh, as a JAX pytree of fixed-capacity arrays."""
+
+    # vertices
+    vert: jax.Array   # [PC, 3] float coords
+    vref: jax.Array   # [PC] int32 reference
+    vtag: jax.Array   # [PC] int32 tag bitfield (tags.py)
+    vmask: jax.Array  # [PC] bool validity
+    # tetrahedra
+    tet: jax.Array    # [TC, 4] int32 vertex ids
+    tref: jax.Array   # [TC] int32
+    tmask: jax.Array  # [TC] bool
+    adja: jax.Array   # [TC, 4] int32, 4*neighbor+face or -1
+    # boundary triangles
+    tria: jax.Array   # [FC, 3] int32 vertex ids
+    trref: jax.Array  # [FC] int32
+    trtag: jax.Array  # [FC] int32
+    trmask: jax.Array  # [FC] bool
+    # feature edges (ridges / required edges)
+    edge: jax.Array   # [EC, 2] int32 vertex ids
+    edref: jax.Array  # [EC] int32
+    edtag: jax.Array  # [EC] int32
+    edmask: jax.Array  # [EC] bool
+    # vertex-attached solutions
+    met: jax.Array    # [PC, 1|6] metric (all-ones when unset)
+    ls: jax.Array     # [PC, 0|1] level-set
+    disp: jax.Array   # [PC, 0|3] displacement
+    fields: jax.Array  # [PC, K] concatenated user fields
+    field_ncomp: Tuple[int, ...] = dataclasses.field(
+        default=(), metadata=dict(static=True)
+    )
+
+    # --- capacities (static) ---------------------------------------------
+    @property
+    def pcap(self) -> int:
+        return self.vert.shape[0]
+
+    @property
+    def tcap(self) -> int:
+        return self.tet.shape[0]
+
+    @property
+    def fcap(self) -> int:
+        return self.tria.shape[0]
+
+    @property
+    def ecap(self) -> int:
+        return self.edge.shape[0]
+
+    @property
+    def dtype(self):
+        return self.vert.dtype
+
+    # --- dynamic counts ---------------------------------------------------
+    @property
+    def npoin(self) -> jax.Array:
+        return jnp.sum(self.vmask.astype(jnp.int32))
+
+    @property
+    def ntet(self) -> jax.Array:
+        return jnp.sum(self.tmask.astype(jnp.int32))
+
+    @property
+    def ntria(self) -> jax.Array:
+        return jnp.sum(self.trmask.astype(jnp.int32))
+
+    @property
+    def nedge(self) -> jax.Array:
+        return jnp.sum(self.edmask.astype(jnp.int32))
+
+    @property
+    def aniso(self) -> bool:
+        return self.met.shape[1] == 6
+
+    # --- constructors -----------------------------------------------------
+    @staticmethod
+    def from_numpy(
+        verts: np.ndarray,
+        tets: np.ndarray,
+        *,
+        vrefs: np.ndarray | None = None,
+        trefs: np.ndarray | None = None,
+        trias: np.ndarray | None = None,
+        trrefs: np.ndarray | None = None,
+        edges: np.ndarray | None = None,
+        edrefs: np.ndarray | None = None,
+        vtags: np.ndarray | None = None,
+        trtags: np.ndarray | None = None,
+        edtags: np.ndarray | None = None,
+        met: np.ndarray | None = None,
+        ls: np.ndarray | None = None,
+        disp: np.ndarray | None = None,
+        fields: np.ndarray | None = None,
+        field_ncomp: Tuple[int, ...] = (),
+        pcap: int | None = None,
+        tcap: int | None = None,
+        fcap: int | None = None,
+        ecap: int | None = None,
+        headroom: float = 1.5,
+        dtype=jnp.float32,
+    ) -> "Mesh":
+        """Build a device Mesh from 0-based numpy arrays, padding to capacity.
+
+        `headroom` sizes capacities relative to current counts so remeshing
+        has room to grow before a host-side rebucket (the capacity-planning
+        analog of the reference's memory budgeting in `src/zaldy_pmmg.c`).
+        """
+        npo, nte = len(verts), len(tets)
+        trias = np.zeros((0, 3), np.int32) if trias is None else trias
+        edges = np.zeros((0, 2), np.int32) if edges is None else edges
+        ntr, ned = len(trias), len(edges)
+
+        def cap(n, c, lo=8):
+            return int(c) if c is not None else max(lo, int(np.ceil(n * headroom)))
+
+        pc, tc = cap(npo, pcap), cap(nte, tcap)
+        fc, ec = cap(ntr, fcap, lo=8), cap(ned, ecap, lo=8)
+
+        def ints(a, n, given):
+            if given is None:
+                return np.zeros(n, np.int32)
+            return np.asarray(given, np.int32)
+
+        verts = np.asarray(verts, np.float64)
+        mcomp = 1 if met is None else np.asarray(met).reshape(npo, -1).shape[1]
+        if mcomp not in (1, 6):
+            raise ValueError(f"metric must have 1 or 6 components, got {mcomp}")
+        met_np = (
+            np.ones((npo, 1)) if met is None else np.asarray(met, np.float64).reshape(npo, mcomp)
+        )
+        ls_np = np.zeros((npo, 0)) if ls is None else np.asarray(ls, np.float64).reshape(npo, -1)
+        disp_np = (
+            np.zeros((npo, 0)) if disp is None else np.asarray(disp, np.float64).reshape(npo, -1)
+        )
+        f_np = (
+            np.zeros((npo, 0))
+            if fields is None
+            else np.asarray(fields, np.float64).reshape(npo, -1)
+        )
+
+        mesh = Mesh(
+            vert=jnp.asarray(_pad2(verts, pc, 0.0), dtype),
+            vref=jnp.asarray(_pad2(ints(None, npo, vrefs), pc, 0)),
+            vtag=jnp.asarray(_pad2(ints(None, npo, vtags), pc, 0)),
+            vmask=jnp.asarray(_pad2(np.ones(npo, bool), pc, False)),
+            tet=jnp.asarray(_pad2(np.asarray(tets, np.int32), tc, 0)),
+            tref=jnp.asarray(_pad2(ints(None, nte, trefs), tc, 0)),
+            tmask=jnp.asarray(_pad2(np.ones(nte, bool), tc, False)),
+            adja=jnp.full((tc, 4), -1, jnp.int32),
+            tria=jnp.asarray(_pad2(np.asarray(trias, np.int32), fc, 0)),
+            trref=jnp.asarray(_pad2(ints(None, ntr, trrefs), fc, 0)),
+            trtag=jnp.asarray(_pad2(ints(None, ntr, trtags), fc, 0)),
+            trmask=jnp.asarray(_pad2(np.ones(ntr, bool), fc, False)),
+            edge=jnp.asarray(_pad2(np.asarray(edges, np.int32), ec, 0)),
+            edref=jnp.asarray(_pad2(ints(None, ned, edrefs), ec, 0)),
+            edtag=jnp.asarray(_pad2(ints(None, ned, edtags), ec, 0)),
+            edmask=jnp.asarray(_pad2(np.ones(ned, bool), ec, False)),
+            met=jnp.asarray(_pad2(met_np, pc, 1.0), dtype),
+            ls=jnp.asarray(_pad2(ls_np, pc, 0.0), dtype),
+            disp=jnp.asarray(_pad2(disp_np, pc, 0.0), dtype),
+            fields=jnp.asarray(_pad2(f_np, pc, 0.0), dtype),
+            field_ncomp=tuple(field_ncomp),
+        )
+        return mesh
+
+    # --- host-side extraction --------------------------------------------
+    def to_numpy(self) -> dict:
+        """Pull valid entities to host as compact 0-based numpy arrays.
+
+        Vertex ids in tets/trias/edges are renumbered to the compacted
+        vertex order (the host analog of the reference's `PMMG_packParMesh`).
+        """
+        vmask = np.asarray(self.vmask)
+        tmask = np.asarray(self.tmask)
+        trmask = np.asarray(self.trmask)
+        edmask = np.asarray(self.edmask)
+        new_id = np.cumsum(vmask) - 1  # old slot -> compact id
+        out = dict(
+            verts=np.asarray(self.vert)[vmask],
+            vrefs=np.asarray(self.vref)[vmask],
+            vtags=np.asarray(self.vtag)[vmask],
+            tets=new_id[np.asarray(self.tet)[tmask]],
+            trefs=np.asarray(self.tref)[tmask],
+            trias=new_id[np.asarray(self.tria)[trmask]],
+            trrefs=np.asarray(self.trref)[trmask],
+            trtags=np.asarray(self.trtag)[trmask],
+            edges=new_id[np.asarray(self.edge)[edmask]],
+            edrefs=np.asarray(self.edref)[edmask],
+            edtags=np.asarray(self.edtag)[edmask],
+            met=np.asarray(self.met)[vmask],
+            ls=np.asarray(self.ls)[vmask],
+            disp=np.asarray(self.disp)[vmask],
+            fields=np.asarray(self.fields)[vmask],
+            field_ncomp=self.field_ncomp,
+        )
+        return out
+
+    # --- capacity management ---------------------------------------------
+    def with_capacity(
+        self,
+        pcap: int | None = None,
+        tcap: int | None = None,
+        fcap: int | None = None,
+        ecap: int | None = None,
+    ) -> "Mesh":
+        """Grow (never shrink below live data) capacities, host-side."""
+        pc = max(self.pcap, pcap or 0)
+        tc = max(self.tcap, tcap or 0)
+        fc = max(self.fcap, fcap or 0)
+        ec = max(self.ecap, ecap or 0)
+
+        def grow(a, cap, fill):
+            a = np.asarray(a)
+            if a.shape[0] == cap:
+                return jnp.asarray(a)
+            return jnp.asarray(_pad2(a, cap, fill))
+
+        return dataclasses.replace(
+            self,
+            vert=grow(self.vert, pc, 0.0),
+            vref=grow(self.vref, pc, 0),
+            vtag=grow(self.vtag, pc, 0),
+            vmask=grow(self.vmask, pc, False),
+            tet=grow(self.tet, tc, 0),
+            tref=grow(self.tref, tc, 0),
+            tmask=grow(self.tmask, tc, False),
+            adja=grow(self.adja, tc, -1),
+            tria=grow(self.tria, fc, 0),
+            trref=grow(self.trref, fc, 0),
+            trtag=grow(self.trtag, fc, 0),
+            trmask=grow(self.trmask, fc, False),
+            edge=grow(self.edge, ec, 0),
+            edref=grow(self.edref, ec, 0),
+            edtag=grow(self.edtag, ec, 0),
+            edmask=grow(self.edmask, ec, False),
+            met=grow(self.met, pc, 1.0),
+            ls=grow(self.ls, pc, 0.0),
+            disp=grow(self.disp, pc, 0.0),
+            fields=grow(self.fields, pc, 0.0),
+        )
+
+    def replace(self, **kw) -> "Mesh":
+        return dataclasses.replace(self, **kw)
+
+
+def tet_coords(mesh: Mesh) -> jax.Array:
+    """[TC, 4, 3] coordinates of each tet's vertices (garbage where masked)."""
+    return mesh.vert[mesh.tet]
+
+
+def tet_volumes(mesh: Mesh) -> jax.Array:
+    """Signed volumes of all tet slots ([TC], garbage where masked)."""
+    c = tet_coords(mesh)
+    d1, d2, d3 = c[:, 1] - c[:, 0], c[:, 2] - c[:, 0], c[:, 3] - c[:, 0]
+    return jnp.einsum("ti,ti->t", jnp.cross(d1, d2), d3) / 6.0
+
+
+@partial(jax.jit, donate_argnums=0)
+def compact(mesh: Mesh) -> Mesh:
+    """Compact valid entities to array prefixes and drop unreferenced vertices.
+
+    Masked-compaction analog of the reference's pack step
+    (`PMMG_packParMesh`, `src/libparmmg1.c:195`): scan-based renumbering in
+    place of Mmg's serial in-place repacking.
+    """
+    # drop vertices not referenced by any valid tet/tria/edge and not REQUIRED
+    pc = mesh.pcap
+    used = jnp.zeros(pc, bool)
+    used = used.at[mesh.tet.reshape(-1)].max(
+        jnp.repeat(mesh.tmask, 4), mode="drop"
+    )
+    used = used.at[mesh.tria.reshape(-1)].max(
+        jnp.repeat(mesh.trmask, 3), mode="drop"
+    )
+    used = used.at[mesh.edge.reshape(-1)].max(
+        jnp.repeat(mesh.edmask, 2), mode="drop"
+    )
+    keep_v = mesh.vmask & (used | ((mesh.vtag & tags.REQUIRED) != 0))
+
+    vpos = jnp.cumsum(keep_v.astype(jnp.int32)) - 1  # new id per old slot
+    vnew = jnp.where(keep_v, vpos, 0).astype(jnp.int32)
+
+    def scat_v(a, fill):
+        out = jnp.full_like(a, fill)
+        idx = jnp.where(keep_v, vpos, pc)  # dead -> OOB drop
+        return out.at[idx].set(a, mode="drop")
+
+    def compact_ent(conn, mask, extras, fills):
+        n = conn.shape[0]
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        idx = jnp.where(mask, pos, n)
+        new_conn = jnp.zeros_like(conn).at[idx].set(vnew[conn], mode="drop")
+        new_mask = jnp.zeros_like(mask).at[idx].set(mask, mode="drop")
+        new_extras = tuple(
+            jnp.full_like(e, f).at[idx].set(e, mode="drop")
+            for e, f in zip(extras, fills)
+        )
+        return new_conn, new_mask, new_extras
+
+    tet, tmask, (tref,) = compact_ent(mesh.tet, mesh.tmask, (mesh.tref,), (0,))
+    tria, trmask, (trref, trtag) = compact_ent(
+        mesh.tria, mesh.trmask, (mesh.trref, mesh.trtag), (0, 0)
+    )
+    edge, edmask, (edref, edtag) = compact_ent(
+        mesh.edge, mesh.edmask, (mesh.edref, mesh.edtag), (0, 0)
+    )
+
+    return mesh.replace(
+        vert=scat_v(mesh.vert, 0.0),
+        vref=scat_v(mesh.vref, 0),
+        vtag=scat_v(mesh.vtag, 0),
+        vmask=scat_v(keep_v, False),
+        met=scat_v(mesh.met, 1.0),
+        ls=scat_v(mesh.ls, 0.0),
+        disp=scat_v(mesh.disp, 0.0),
+        fields=scat_v(mesh.fields, 0.0),
+        tet=tet,
+        tmask=tmask,
+        tref=tref,
+        adja=jnp.full_like(mesh.adja, -1),
+        tria=tria,
+        trmask=trmask,
+        trref=trref,
+        trtag=trtag,
+        edge=edge,
+        edmask=edmask,
+        edref=edref,
+        edtag=edtag,
+    )

@@ -1,0 +1,59 @@
+"""Entity tag bitfield and status constants.
+
+TPU-native analog of the MG_* tag discipline used by the reference
+(ParMmg `src/tag_pmmg.c:39-180` and the Mmg tag bits it manipulates).
+Tags are carried as an int32 bitfield per vertex / triangle / tet-face so
+that masked, vectorized kernels can test them with bitwise ops instead of
+pointer-chased xpoint/xtetra side structures.
+"""
+
+from __future__ import annotations
+
+import enum
+
+# --- entity tag bits (vertices, edges, triangles) -------------------------
+NOTAG = 0
+REF = 1 << 0        # reference edge/vertex (feature line)
+BDY = 1 << 1        # on the geometric boundary
+RIDGE = 1 << 2      # ridge (sharp dihedral angle) entity
+REQUIRED = 1 << 3   # required: must not be modified by remeshing
+CORNER = 1 << 4     # corner vertex (singular point)
+NOM = 1 << 5        # non-manifold entity
+GEO = RIDGE         # alias: geometric ridge
+PARBDY = 1 << 6     # on an inter-shard (parallel) interface — frozen
+PARBDYBDY = 1 << 7  # parallel interface that is also a true boundary
+OLDPARBDY = 1 << 8  # was a parallel interface at the previous iteration
+NOSURF = 1 << 9     # required only because parallel, not user-required
+OVERLAP = 1 << 10   # belongs to a halo/ghost overlap region
+
+# A vertex with any of these cannot be moved by smoothing:
+IMMOVABLE = REQUIRED | CORNER | PARBDY
+# A vertex with any of these cannot be deleted by collapse:
+UNCOLLAPSIBLE = REQUIRED | CORNER | PARBDY | NOM
+
+
+class ReturnStatus(enum.IntEnum):
+    """Graded failure model, mirroring the reference semantics
+    (PMMG_SUCCESS / PMMG_LOWFAILURE / PMMG_STRONGFAILURE,
+    reference `src/libparmmgtypes.h:45-66`): LOWFAILURE means the mesh is
+    still conformal and savable; STRONGFAILURE means it is unusable."""
+
+    SUCCESS = 0
+    LOWFAILURE = 1
+    STRONGFAILURE = 2
+
+
+class RedistributionMode(enum.IntEnum):
+    """Repartitioning strategies (reference `src/libparmmgtypes.h:173-228`)."""
+
+    IFC_DISPLACEMENT = 0  # advancing-front interface displacement (default)
+    GRAPH = 1             # graph/SFC-based repartitioning
+    NONE = 2
+
+
+class APIDistrib(enum.IntEnum):
+    """Distributed-API input mode (faces or nodes interface description)."""
+
+    UNSET = 0
+    FACES = 1
+    NODES = 2

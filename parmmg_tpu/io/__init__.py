@@ -1,0 +1,1 @@
+from . import medit  # noqa: F401

@@ -1,0 +1,58 @@
+"""ctypes bridge to the optional C++ tokenizer in native/medit_tok.cpp.
+
+The reference's I/O layer is native C (`src/inout_pmmg.c`); here only the
+hot tokenization loop is native — parsing/assembly stays in numpy. Falls
+back silently to the pure-Python tokenizer when the shared library has not
+been built (see native/build.sh)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(here, "native", "libmedit_tok.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _lib_path()
+    if os.path.exists(path):
+        try:
+            lib = ctypes.CDLL(path)
+            lib.medit_tokenize.restype = ctypes.c_void_p
+            lib.medit_tokenize.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_long),
+            ]
+            lib.medit_free.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+        except OSError:
+            _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def tokenize(path: str) -> List[str]:
+    lib = _load()
+    n = ctypes.c_long(0)
+    buf = lib.medit_tokenize(path.encode(), ctypes.byref(n))
+    if not buf:
+        raise IOError(f"native tokenizer failed on {path}")
+    try:
+        raw = ctypes.string_at(buf, n.value)
+    finally:
+        lib.medit_free(buf)
+    return raw.decode().split("\x00")

@@ -1,0 +1,49 @@
+"""Test harness: simulate an 8-device mesh on CPU, mirroring how the
+reference exercises its distributed path with oversubscribed mpiexec ranks
+(SURVEY.md §4).
+
+The surrounding environment pins JAX to a single-chip TPU tunnel (an `axon`
+PJRT plugin registered by sitecustomize at interpreter start, with
+JAX_PLATFORMS=axon). jax initializes *every* registered backend factory on
+first use regardless of JAX_PLATFORMS, so to keep tests hermetic and offline
+we deregister the accelerator factories before any backend exists, then pin
+the CPU platform with 8 virtual devices and x64 for exact geometry checks."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+assert not _xb._default_backend, "conftest must run before jax backend init"
+for _accel in ("axon", "tpu", "cuda", "rocm"):
+    _xb._backend_factories.pop(_accel, None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pathlib  # noqa: E402
+
+import pytest  # noqa: E402
+
+REF_EX0 = pathlib.Path("/root/reference/libexamples/adaptation_example0")
+REF_EX1 = pathlib.Path("/root/reference/libexamples/adaptation_example1")
+
+
+@pytest.fixture(scope="session")
+def cube_mesh_path():
+    return str(REF_EX0 / "cube.mesh")
+
+
+@pytest.fixture(scope="session")
+def cube_met_path():
+    return str(REF_EX0 / "cube-met.sol")
+
+
+@pytest.fixture(scope="session")
+def wave_shard_paths():
+    return [str(REF_EX1 / f"wave.{r}.mesh") for r in range(4)]

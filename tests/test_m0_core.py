@@ -1,0 +1,204 @@
+"""M0 tests: Medit I/O, mesh core, adjacency, edges, quality, compaction."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from parmmg_tpu.core import adjacency, tags
+from parmmg_tpu.core.mesh import FACE_VERTS, Mesh, compact, tet_volumes
+from parmmg_tpu.io import medit
+from parmmg_tpu.ops import quality
+
+
+def load_cube(cube_mesh_path, cube_met_path=None):
+    return medit.load_mesh(cube_mesh_path, cube_met_path, dtype=jnp.float64)
+
+
+def test_read_cube(cube_mesh_path):
+    raw = medit.read_mesh(cube_mesh_path)
+    assert raw.verts.shape == (12, 3)
+    assert raw.tets.shape == (12, 4)
+    assert raw.trias.shape[0] > 0
+    assert raw.tets.min() == 0 and raw.tets.max() == 11
+
+
+def test_read_sol(cube_met_path):
+    vals, types = medit.read_sol(cube_met_path)
+    assert types == [medit.SOL_SCALAR]
+    assert vals.shape == (12, 1)
+    assert np.allclose(vals, 0.5)
+
+
+def test_roundtrip(tmp_path, cube_mesh_path, cube_met_path):
+    m = load_cube(cube_mesh_path, cube_met_path)
+    out = tmp_path / "out.mesh"
+    medit.save_mesh(m, str(out))
+    raw2 = medit.read_mesh(str(out))
+    raw1 = medit.read_mesh(cube_mesh_path)
+    np.testing.assert_allclose(raw1.verts, raw2.verts)
+    np.testing.assert_array_equal(raw1.tets, raw2.tets)
+    np.testing.assert_array_equal(raw1.trefs, raw2.trefs)
+    np.testing.assert_array_equal(raw1.trias, raw2.trias)
+
+
+def test_volumes_positive(cube_mesh_path):
+    m = load_cube(cube_mesh_path)
+    vol = np.asarray(tet_volumes(m))
+    tm = np.asarray(m.tmask)
+    assert (vol[tm] > 0).all()
+    # cube example covers the unit cube
+    assert np.isclose(vol[tm].sum(), 1.0)
+
+
+def brute_adjacency(tets):
+    """O(n^2)-ish reference adjacency via dict."""
+    faces = {}
+    nt = len(tets)
+    adja = -np.ones((nt, 4), np.int64)
+    for t in range(nt):
+        for f in range(4):
+            key = tuple(sorted(tets[t, FACE_VERTS[f]]))
+            if key in faces:
+                t2, f2 = faces.pop(key)
+                adja[t, f] = 4 * t2 + f2
+                adja[t2, f2] = 4 * t + f
+            else:
+                faces[key] = (t, f)
+    return adja
+
+
+def test_adjacency_matches_bruteforce(cube_mesh_path):
+    m = load_cube(cube_mesh_path)
+    m = adjacency.build_adjacency(m)
+    tm = np.asarray(m.tmask)
+    tets = np.asarray(m.tet)[tm]
+    expect = brute_adjacency(tets)
+    got = np.asarray(m.adja)[tm]
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_adjacency_ignores_dead_slots(cube_mesh_path):
+    m = load_cube(cube_mesh_path)
+    # kill one tet; its neighbors should become boundary faces
+    tmask = np.asarray(m.tmask).copy()
+    live = np.nonzero(tmask)[0]
+    kill = live[3]
+    tmask[kill] = False
+    m2 = m.replace(tmask=jnp.asarray(tmask))
+    m2 = adjacency.build_adjacency(m2)
+    adja = np.asarray(m2.adja)
+    assert (adja[kill] == -1).all()
+    assert not np.any(adja // 4 == kill)
+
+
+def test_unique_edges(cube_mesh_path):
+    m = load_cube(cube_mesh_path)
+    edges, emask, t2e, n_unique = adjacency.unique_edges(m, ecap=200)
+    em = np.asarray(emask)
+    e = np.asarray(edges)[em]
+    # brute force unique edges
+    tets = np.asarray(m.tet)[np.asarray(m.tmask)]
+    from parmmg_tpu.core.mesh import EDGE_VERTS
+
+    s = set()
+    for t in tets:
+        for a, b in t[EDGE_VERTS]:
+            s.add((min(a, b), max(a, b)))
+    got = set(map(tuple, e))
+    assert got == s
+    assert int(n_unique) == len(s)
+    # tet2edge maps back to correct pairs
+    t2e_np = np.asarray(t2e)
+    tm = np.asarray(m.tmask)
+    for t in np.nonzero(tm)[0]:
+        for k, (a, b) in enumerate(np.asarray(m.tet)[t][EDGE_VERTS]):
+            eid = t2e_np[t, k]
+            assert eid >= 0
+            assert tuple(np.asarray(edges)[eid]) == (min(a, b), max(a, b))
+
+
+def test_quality_unit(cube_mesh_path):
+    m = load_cube(cube_mesh_path)
+    q = np.asarray(quality.tet_quality(m))
+    tm = np.asarray(m.tmask)
+    assert (q[tm] > 0.0).all() and (q[tm] <= 1.0).all()
+
+
+def test_quality_regular_tet_is_one():
+    # regular tetrahedron
+    verts = np.array(
+        [
+            [1, 1, 1],
+            [1, -1, -1],
+            [-1, -1, 1],
+            [-1, 1, -1],
+        ],
+        np.float64,
+    )
+    m = Mesh.from_numpy(verts, np.array([[0, 1, 2, 3]]), dtype=jnp.float64)
+    q = float(quality.tet_quality(m)[0])
+    assert q == pytest.approx(1.0, rel=1e-12)
+    # aniso identity metric gives the same score
+    met6 = np.tile(np.array([1.0, 0, 0, 1.0, 0, 1.0]), (4, 1))
+    m6 = Mesh.from_numpy(
+        verts, np.array([[0, 1, 2, 3]]), met=met6, dtype=jnp.float64
+    )
+    q6 = float(quality.tet_quality(m6)[0])
+    assert q6 == pytest.approx(q, rel=1e-10)
+
+
+def test_quality_histogram(cube_mesh_path):
+    m = load_cube(cube_mesh_path)
+    h = quality.quality_histogram(m)
+    assert int(h.ne) == 12
+    assert int(h.counts.sum()) == 12
+    assert 0 < float(h.qmin) <= float(h.qavg) <= float(h.qmax) <= 1.0
+    s = quality.format_histogram(h)
+    assert "12 elements" in s
+
+
+def test_compact(cube_mesh_path):
+    m = load_cube(cube_mesh_path)
+    vol0 = np.asarray(tet_volumes(m))[np.asarray(m.tmask)].sum()
+    tmask = np.asarray(m.tmask).copy()
+    live = np.nonzero(tmask)[0]
+    tmask[live[::3]] = False  # kill every 3rd tet
+    killed_vol = np.asarray(tet_volumes(m))[live[::3]].sum()
+    m2 = m.replace(tmask=jnp.asarray(tmask))
+    m3 = compact(m2)
+    # counts shrank, volumes preserved
+    assert int(m3.ntet) == tmask.sum()
+    vol3 = np.asarray(tet_volumes(m3))[np.asarray(m3.tmask)].sum()
+    assert np.isclose(vol3, vol0 - killed_vol)
+    # valid slots are a prefix
+    tm3 = np.asarray(m3.tmask)
+    assert tm3[: tmask.sum()].all() and not tm3[tmask.sum():].any()
+    # triangles still reference live vertices with same coordinates
+    d = m3.to_numpy()
+    assert d["trias"].max() < len(d["verts"])
+
+
+def test_distributed_wave_read(wave_shard_paths):
+    raw = medit.read_mesh(wave_shard_paths[0])
+    assert raw.face_comms is not None
+    ncomm = len(raw.face_comms)
+    assert ncomm >= 1
+    for color, loc, glob in raw.face_comms:
+        assert 0 <= color < 4
+        assert len(loc) == len(glob)
+        assert loc.min() >= 0 and loc.max() < len(raw.trias)
+
+
+def test_distributed_roundtrip(tmp_path, wave_shard_paths):
+    raw = medit.read_mesh(wave_shard_paths[1])
+    m = medit.raw_to_mesh(raw)
+    out = tmp_path / "wave.out.mesh"
+    medit.save_mesh(m, str(out), face_comms=raw.face_comms)
+    raw2 = medit.read_mesh(str(out))
+    assert raw2.face_comms is not None
+    assert len(raw2.face_comms) == len(raw.face_comms)
+    for (c1, l1, g1), (c2, l2, g2) in zip(raw.face_comms, raw2.face_comms):
+        assert c1 == c2
+        np.testing.assert_array_equal(l1, l2)
+        np.testing.assert_array_equal(g1, g2)
