@@ -25,10 +25,12 @@ def _sort3(a, b, c):
     return lo, mid, hi
 
 
-@partial(jax.jit, donate_argnums=0)
+@jax.jit
 def build_adjacency(mesh: Mesh) -> Mesh:
     """Fill `mesh.adja`: adja[t,f] = 4*t2+f2 for the tet face glued to (t,f),
-    -1 for boundary faces. Masked tets get all -1 and never match."""
+    -1 for boundary faces. Masked tets get all -1 and never match. Faces
+    shared by 3+ tets (invalid input) are left unmatched (-1) rather than
+    silently mis-paired; `utils.conformity.check_mesh` reports them."""
     tc = mesh.tcap
     tet = mesh.tet
     # face vertex triples, canonically sorted; dead slots get unique sentinels
@@ -46,8 +48,13 @@ def build_adjacency(mesh: Mesh) -> Mesh:
     )
     eq_next = jnp.concatenate([eq_next, jnp.zeros(1, bool)])
     eq_prev = jnp.concatenate([jnp.zeros(1, bool), eq_next[:-1]])
+    # pair only runs of exactly 2 equal faces; longer runs are invalid
+    not_mid = ~(eq_next & eq_prev)  # not the middle of a 3+-run
+    pair2 = eq_next & not_mid & jnp.roll(not_mid, -1)  # i pairs with i+1
     partner = jnp.where(
-        eq_next, jnp.roll(order, -1), jnp.where(eq_prev, jnp.roll(order, 1), -1)
+        pair2,
+        jnp.roll(order, -1),
+        jnp.where(jnp.roll(pair2, 1), jnp.roll(order, 1), -1),
     )
     adja_flat = jnp.full(tc * 4, -1, jnp.int32).at[order].set(partner)
     return mesh.replace(adja=adja_flat.reshape(tc, 4))

@@ -171,10 +171,15 @@ class Mesh:
         pc, tc = cap(npo, pcap), cap(nte, tcap)
         fc, ec = cap(ntr, fcap, lo=8), cap(ned, ecap, lo=8)
 
-        def ints(a, n, given):
+        def ints(n, given):
             if given is None:
                 return np.zeros(n, np.int32)
-            return np.asarray(given, np.int32)
+            out = np.asarray(given, np.int32)
+            if out.shape[0] != n:
+                raise ValueError(
+                    f"attribute length {out.shape[0]} != entity count {n}"
+                )
+            return out
 
         verts = np.asarray(verts, np.float64)
         mcomp = 1 if met is None else np.asarray(met).reshape(npo, -1).shape[1]
@@ -195,20 +200,20 @@ class Mesh:
 
         mesh = Mesh(
             vert=jnp.asarray(_pad2(verts, pc, 0.0), dtype),
-            vref=jnp.asarray(_pad2(ints(None, npo, vrefs), pc, 0)),
-            vtag=jnp.asarray(_pad2(ints(None, npo, vtags), pc, 0)),
+            vref=jnp.asarray(_pad2(ints(npo, vrefs), pc, 0)),
+            vtag=jnp.asarray(_pad2(ints(npo, vtags), pc, 0)),
             vmask=jnp.asarray(_pad2(np.ones(npo, bool), pc, False)),
             tet=jnp.asarray(_pad2(np.asarray(tets, np.int32), tc, 0)),
-            tref=jnp.asarray(_pad2(ints(None, nte, trefs), tc, 0)),
+            tref=jnp.asarray(_pad2(ints(nte, trefs), tc, 0)),
             tmask=jnp.asarray(_pad2(np.ones(nte, bool), tc, False)),
             adja=jnp.full((tc, 4), -1, jnp.int32),
             tria=jnp.asarray(_pad2(np.asarray(trias, np.int32), fc, 0)),
-            trref=jnp.asarray(_pad2(ints(None, ntr, trrefs), fc, 0)),
-            trtag=jnp.asarray(_pad2(ints(None, ntr, trtags), fc, 0)),
+            trref=jnp.asarray(_pad2(ints(ntr, trrefs), fc, 0)),
+            trtag=jnp.asarray(_pad2(ints(ntr, trtags), fc, 0)),
             trmask=jnp.asarray(_pad2(np.ones(ntr, bool), fc, False)),
             edge=jnp.asarray(_pad2(np.asarray(edges, np.int32), ec, 0)),
-            edref=jnp.asarray(_pad2(ints(None, ned, edrefs), ec, 0)),
-            edtag=jnp.asarray(_pad2(ints(None, ned, edtags), ec, 0)),
+            edref=jnp.asarray(_pad2(ints(ned, edrefs), ec, 0)),
+            edtag=jnp.asarray(_pad2(ints(ned, edtags), ec, 0)),
             edmask=jnp.asarray(_pad2(np.ones(ned, bool), ec, False)),
             met=jnp.asarray(_pad2(met_np, pc, 1.0), dtype),
             ls=jnp.asarray(_pad2(ls_np, pc, 0.0), dtype),

@@ -13,14 +13,16 @@ sqrt(e^T M e), and the unit-mesh goal is length 1 for every edge.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
 # unit-edge thresholds of the "unit mesh" framework (standard in the
 # anisotropic remeshing literature): split above SQRT2, collapse below
 # 1/SQRT2 — same role as Mmg's long/short edge bounds.
-LLONG = jnp.sqrt(2.0)
-LSHRT = 1.0 / jnp.sqrt(2.0)
+LLONG = math.sqrt(2.0)
+LSHRT = 1.0 / math.sqrt(2.0)
 
 
 def sym6_to_mat(m6: jax.Array) -> jax.Array:

@@ -127,11 +127,17 @@ def read_mesh(path: str) -> RawMesh:
             "ParallelCommunicatorTriangles",
             "ParallelCommunicatorVertices",
         ):
-            head = comm_heads[
+            head_kw = (
                 "ParallelTriangleCommunicators"
                 if "Triangles" in kw
                 else "ParallelVertexCommunicators"
-            ]
+            )
+            if head_kw not in comm_heads:
+                raise ValueError(
+                    f"{path}: section {kw} appears before its header "
+                    f"section {head_kw}"
+                )
+            head = comm_heads[head_kw]
             ntot = int(head[:, 1].sum())
             arr = np.array(toks[i : i + ntot * 3], dtype=np.int64).reshape(ntot, 3)
             i += ntot * 3
@@ -164,6 +170,11 @@ def read_mesh(path: str) -> RawMesh:
         if head_kw not in comm_heads:
             return None
         head = comm_heads[head_kw]
+        if item_kw not in comm_items:
+            raise ValueError(
+                f"{path}: header section {head_kw} present but item "
+                f"section {item_kw} missing"
+            )
         items = comm_items[item_kw]
         out = []
         for icomm in range(head.shape[0]):
@@ -257,7 +268,9 @@ def load_mesh(path: str, metpath: str | None = None, **kw) -> Mesh:
     """Centralized load: mesh file plus optional metric sol file."""
     raw = read_mesh(path)
     met = None
-    if metpath is not None and os.path.exists(metpath):
+    if metpath is not None:
+        if not os.path.exists(metpath):
+            raise FileNotFoundError(f"metric sol file not found: {metpath}")
         vals, types = read_sol(metpath)
         if types[0] not in (SOL_SCALAR, SOL_TENSOR):
             raise ValueError("metric sol must be scalar or symmetric tensor")
@@ -310,17 +323,34 @@ def save_mesh(
         _fmt_block(f, "RequiredEdges", req_ed[:, None], None, False)
         req_tr = np.nonzero(d["trtags"] & tags.REQUIRED)[0] + 1
         _fmt_block(f, "RequiredTriangles", req_tr[:, None], None, False)
-        for kw_head, kw_items, comms in (
-            ("ParallelTriangleCommunicators", "ParallelCommunicatorTriangles", face_comms),
-            ("ParallelVertexCommunicators", "ParallelCommunicatorVertices", node_comms),
+        # communicator local ids are mesh slot ids; entity sections above
+        # are written in compacted numbering, so remap through the same maps
+        tr_live = np.asarray(mesh.trmask)
+        v_live = np.asarray(mesh.vmask)
+        tr_new = np.cumsum(tr_live) - 1
+        v_new = np.cumsum(v_live) - 1
+        for kw_head, kw_items, comms, live, renum in (
+            ("ParallelTriangleCommunicators", "ParallelCommunicatorTriangles",
+             face_comms, tr_live, tr_new),
+            ("ParallelVertexCommunicators", "ParallelCommunicatorVertices",
+             node_comms, v_live, v_new),
         ):
             if not comms:
                 continue
-            f.write(f"\n{kw_head}\n{len(comms)}\n")
+            remapped = []
             for color, loc, glob in comms:
+                loc = np.asarray(loc)
+                if not live[loc].all():
+                    raise ValueError(
+                        f"communicator (color {color}) references deleted "
+                        f"entities; cannot save"
+                    )
+                remapped.append((color, renum[loc], np.asarray(glob)))
+            f.write(f"\n{kw_head}\n{len(remapped)}\n")
+            for color, loc, glob in remapped:
                 f.write(f"{color} {len(loc)}\n")
             f.write(f"\n{kw_items}\n")
-            for icomm, (color, loc, glob) in enumerate(comms):
+            for icomm, (color, loc, glob) in enumerate(remapped):
                 for l, g in zip(loc, glob):
                     f.write(f"{l + 1} {g} {icomm}\n")
         f.write("\nEnd\n")

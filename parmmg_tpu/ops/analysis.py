@@ -1,0 +1,46 @@
+"""Mesh analysis: boundary detection and (growing) surface classification.
+
+Covers the role of Mmg's `MMG3D_analys` as used by the reference
+(`src/libparmmg.c:180`, `src/analys_pmmg.c` for the parallel version):
+deriving which entities are boundary, ridges, corners, and required from
+the raw connectivity. Round 1 implements boundary-vertex marking and
+missing-boundary-triangle synthesis; dihedral-angle ridge/corner detection
+lands with the surface milestone.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tags
+from ..core.mesh import FACE_VERTS, Mesh
+from ..core.adjacency import build_adjacency
+
+
+@partial(jax.jit, donate_argnums=0)
+def mark_boundary(mesh: Mesh) -> Mesh:
+    """OR the BDY bit into vtag for every vertex lying on the boundary
+    surface: vertices of valid trias, plus vertices of tet faces with no
+    neighbor (requires fresh adjacency; pass through build_adjacency
+    first when trias may be incomplete)."""
+    pcap = mesh.pcap
+    bdy = jnp.zeros(pcap, bool)
+    idx = jnp.where(mesh.trmask[:, None], mesh.tria, pcap)
+    bdy = bdy.at[idx.reshape(-1)].set(True, mode="drop")
+    # faces with no neighbor
+    open_face = (mesh.adja < 0) & mesh.tmask[:, None]  # [TC,4]
+    fverts = mesh.tet[:, jnp.asarray(FACE_VERTS)]      # [TC,4,3]
+    idx2 = jnp.where(open_face[..., None], fverts, pcap)
+    bdy = bdy.at[idx2.reshape(-1)].set(True, mode="drop")
+    vtag = jnp.where(bdy & mesh.vmask, mesh.vtag | tags.BDY, mesh.vtag)
+    return mesh.replace(vtag=vtag)
+
+
+def analyze(mesh: Mesh) -> Mesh:
+    """Entry analysis pass: adjacency + boundary marking. Grows toward the
+    full `MMG3D_analys` equivalent (ridges, normals, singularities)."""
+    mesh = build_adjacency(mesh)
+    return mark_boundary(mesh)

@@ -1,0 +1,148 @@
+"""Batched edge collapse: coarsen every metric-short edge in parallel.
+
+Counterpart of the coarsening half of Mmg's kernel (`MMG5_mmg3d1_delone` via
+reference `src/libparmmg1.c:739`). A candidate short edge (src→dst) removes
+vertex src and retargets its ball onto dst. Independent-set selection uses
+the union of tets touching either endpoint as the conflict arena, which
+guarantees (a) each vertex joins at most one collapse per sweep and (b)
+simultaneous application is safe. Validity = positive volumes + bounded
+quality loss; topological safety (Mmg's link condition) is enforced by a
+vectorized duplicate-tet detector on the tentative configuration.
+
+Round-1 scope: interior vertices only — boundary/ridge collapses arrive
+with the surface-analysis milestone (Hausdorff control), so the boundary
+surface is preserved exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import metric as metric_mod
+from ..core import tags
+from ..core.mesh import Mesh
+from . import common
+
+_VOL_EPS = 1e-14
+
+
+class CollapseStats(NamedTuple):
+    ncollapse: jax.Array
+    ncand: jax.Array
+    nrej_geom: jax.Array   # rejected by volume/quality
+    nrej_topo: jax.Array   # rejected by duplicate-tet (link) check
+
+
+@partial(jax.jit, static_argnames=("lshrt",), donate_argnums=0)
+def collapse_short_edges(
+    mesh: Mesh,
+    edges: jax.Array,
+    emask: jax.Array,
+    t2e: jax.Array,
+    lshrt: float = float(metric_mod.LSHRT),
+):
+    """One collapse sweep. Mesh must be compacted; adjacency left stale."""
+    ecap = edges.shape[0]
+    tcap, pcap = mesh.tcap, mesh.pcap
+    tet, tmask = mesh.tet, mesh.tmask
+
+    a, b = edges[:, 0], edges[:, 1]
+    l = metric_mod.edge_length(
+        mesh.vert[a], mesh.vert[b], mesh.met[a], mesh.met[b]
+    )
+    interior = mesh.vmask & (
+        (mesh.vtag & (tags.UNCOLLAPSIBLE | tags.BDY | tags.OVERLAP)) == 0
+    )
+    ra, rb = interior[a], interior[b]
+    cand = emask & (l < lshrt) & (ra | rb)
+    src = jnp.where(ra, a, b)
+    dst = jnp.where(ra, b, a)
+    ncand = jnp.sum(cand.astype(jnp.int32))
+
+    # --- arena selection: tets containing src or dst ----------------------
+    def scatter_arena(vals):
+        vb = jnp.full(pcap, -jnp.inf, vals.dtype)
+        vb = vb.at[src].max(vals, mode="drop")
+        vb = vb.at[dst].max(vals, mode="drop")
+        tv = jnp.max(vb[tet], axis=1)
+        return jnp.where(tmask, tv, -jnp.inf)
+
+    def gather_arena(tv):
+        ub = jnp.full(pcap, -jnp.inf, tv.dtype)
+        idx = jnp.where(tmask[:, None], tet, pcap)
+        ub = ub.at[idx.reshape(-1)].max(
+            jnp.broadcast_to(tv[:, None], (tcap, 4)).reshape(-1), mode="drop"
+        )
+        return jnp.maximum(ub[src], ub[dst])
+
+    # shorter edge = higher priority
+    win = common.two_phase_winners(-l, cand, scatter_arena, gather_arena)
+
+    # per-vertex winner map (each vertex touched by <= 1 winner)
+    eidx = jnp.arange(ecap, dtype=jnp.int32)
+    wv = jnp.full(pcap, -1, jnp.int32)
+    wv = wv.at[jnp.where(win, src, pcap)].max(eidx, mode="drop")
+    wv = wv.at[jnp.where(win, dst, pcap)].max(eidx, mode="drop")
+
+    # per-tet winner and role
+    wt4 = wv[tet]                                   # [TC,4]
+    e_t = jnp.max(wt4, axis=1)                      # winner edge or -1
+    has = (e_t >= 0) & tmask
+    e_ts = jnp.maximum(e_t, 0)
+    src_t, dst_t = src[e_ts], dst[e_ts]
+    has_src = jnp.any(tet == src_t[:, None], axis=1) & has
+    has_dst = jnp.any(tet == dst_t[:, None], axis=1) & has
+    is_shell = has_src & has_dst
+    is_ball = has_src & ~is_shell
+
+    new_tet = jnp.where(
+        (tet == src_t[:, None]) & is_ball[:, None], dst_t[:, None], tet
+    )
+    q_old = common.quality_of(mesh.vert, mesh.met, tet)
+    q_new = common.quality_of(mesh.vert, mesh.met, new_tet)
+    vol_new = common.vol_of(mesh.vert, new_tet)
+
+    # --- geometric validity per winner ------------------------------------
+    inf = jnp.inf
+    ball_old = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
+        q_old, mode="drop"
+    )
+    ball_new = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
+        jnp.where(vol_new > _VOL_EPS, q_new, -inf), mode="drop"
+    )
+    ok_geom = (ball_new >= 0.6 * ball_old) | (ball_new >= 0.3)
+    ok_geom = ok_geom & (ball_new > 0.0) & jnp.isfinite(ball_new)
+    accept = win & ok_geom
+    nrej_geom = jnp.sum((win & ~ok_geom).astype(jnp.int32))
+
+    # --- topological check: tentative apply + duplicate detection ---------
+    app_t = is_ball & accept[e_ts]
+    del_t = is_shell & accept[e_ts]
+    tet_tent = jnp.where(app_t[:, None], new_tet, tet)
+    valid_tent = tmask & ~del_t
+    dup = common.duplicate_tets(tet_tent, valid_tent)
+    bad_e = jnp.zeros(ecap, bool).at[jnp.where(dup & has, e_t, ecap)].max(
+        True, mode="drop"
+    )
+    nrej_topo = jnp.sum((accept & bad_e).astype(jnp.int32))
+    accept = accept & ~bad_e
+
+    # --- final apply -------------------------------------------------------
+    app_t = is_ball & accept[e_ts]
+    del_t = is_shell & accept[e_ts]
+    tet_out = jnp.where(app_t[:, None], new_tet, tet)
+    tmask_out = tmask & ~del_t
+    vmask_out = mesh.vmask.at[jnp.where(accept, src, pcap)].set(
+        False, mode="drop"
+    )
+    ncollapse = jnp.sum(accept.astype(jnp.int32))
+
+    out = mesh.replace(tet=tet_out, tmask=tmask_out, vmask=vmask_out)
+    return out, CollapseStats(
+        ncollapse=ncollapse, ncand=ncand, nrej_geom=nrej_geom,
+        nrej_topo=nrej_topo,
+    )

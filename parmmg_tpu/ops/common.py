@@ -1,0 +1,185 @@
+"""Shared primitives for the batched remeshing kernels.
+
+The reference applies Mmg's cavity operators serially per group
+(`MMG5_mmg3d1_delone` at reference `src/libparmmg1.c:739`); here operators are
+applied in parallel Jacobi sweeps over *independent sets*: every candidate
+operation claims an arena of tets, and only the best-priority candidate per
+arena survives. These helpers implement that selection plus the sort-based
+set matching the kernels need — int32/sort/scatter only (TPU-safe without
+x64), no hash tables.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import Mesh
+
+
+def two_phase_winners(
+    prio: jax.Array,
+    cand: jax.Array,
+    scatter_arena,
+    gather_arena,
+):
+    """Generic independent-set selection with exact tie-breaking.
+
+    prio: [N] float priorities (higher wins), cand: [N] bool candidates.
+    scatter_arena(values) -> arena max-combined values: scatter each
+      candidate's value to every arena cell it touches (max combine).
+    gather_arena(arena_values) -> [N]: per candidate, max over its cells.
+
+    Phase 1 maxes the float priority per arena cell; phase 2 breaks exact
+    float ties by candidate index. Returns [N] bool winners — candidates
+    that are the unique argmax in every arena cell they touch.
+    """
+    n = prio.shape[0]
+    p = jnp.where(cand, prio, -jnp.inf)
+    best = gather_arena(scatter_arena(p))
+    is_top = cand & (p >= best) & jnp.isfinite(p)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    idx_p = jnp.where(is_top, idx, -1)
+    best_idx = gather_arena(scatter_arena(idx_p.astype(jnp.float32)))
+    return is_top & (idx.astype(jnp.float32) >= best_idx)
+
+
+def _run_match(keys: jax.Array, query: jax.Array):
+    """Sort-merge row matching: for each query row, does it appear among
+    `keys` rows, and at what first index? Rows containing any negative
+    entry are treated as invalid and never match. Returns (hit [Q] bool,
+    idx [Q] int32 first-match index into keys or -1). int32-only."""
+    k, c = keys.shape
+    q = query.shape[0]
+    n = k + q
+    rows = jnp.concatenate([keys, query], axis=0).astype(jnp.int32)
+    invalid = jnp.any(rows < 0, axis=1)
+    slot = jnp.arange(n, dtype=jnp.int32)
+    uniq = jnp.concatenate(
+        [(-(slot[:, None] + 2)), jnp.zeros((n, c - 1), jnp.int32)], axis=1
+    )
+    rows = jnp.where(invalid[:, None], uniq, rows)
+    order = jnp.lexsort(tuple(rows[:, i] for i in reversed(range(c)))).astype(
+        jnp.int32
+    )
+    sr = rows[order]
+    newgrp = jnp.concatenate(
+        [jnp.ones(1, bool), jnp.any(sr[1:] != sr[:-1], axis=1)]
+    )
+    gid = (jnp.cumsum(newgrp.astype(jnp.int32)) - 1).astype(jnp.int32)
+    from_key = order < k
+    cnt = jnp.zeros(n, jnp.int32).at[gid].add(from_key.astype(jnp.int32))
+    big = jnp.int32(n)
+    minidx = (
+        jnp.full(n, big, jnp.int32)
+        .at[gid]
+        .min(jnp.where(from_key, order, big))
+    )
+    hit_sorted = cnt[gid] > 0
+    idx_sorted = jnp.where(hit_sorted, minidx[gid], -1)
+    hit = jnp.zeros(n, bool).at[order].set(hit_sorted)
+    idx = jnp.full(n, -1, jnp.int32).at[order].set(idx_sorted)
+    return hit[k:] & ~invalid[k:], jnp.where(invalid[k:], -1, idx[k:])
+
+
+def sorted_membership(keys: jax.Array, query: jax.Array) -> jax.Array:
+    """[Q] bool: does each query row appear among `keys` rows? Rows with
+    any negative entry never match."""
+    hit, _ = _run_match(keys, query)
+    return hit
+
+
+def match_rows(keys: jax.Array, query: jax.Array) -> jax.Array:
+    """[Q] int32 index of the first row of `keys` equal to each query row,
+    -1 if absent."""
+    _, idx = _run_match(keys, query)
+    return idx
+
+
+def tria_edge_keys(mesh: Mesh) -> jax.Array:
+    """[3*FC, 2] canonically sorted (lo,hi) vertex pairs of all valid tria
+    edges; dead trias give (-1,-1) rows."""
+    t = mesh.tria
+    pairs = jnp.stack(
+        [t[:, [0, 1]], t[:, [1, 2]], t[:, [0, 2]]], axis=1
+    )  # [FC,3,2]
+    lo = jnp.minimum(pairs[..., 0], pairs[..., 1])
+    hi = jnp.maximum(pairs[..., 0], pairs[..., 1])
+    dead = ~mesh.trmask[:, None]
+    lo = jnp.where(dead, -1, lo).reshape(-1)
+    hi = jnp.where(dead, -1, hi).reshape(-1)
+    return jnp.stack([lo, hi], axis=1)
+
+
+def surface_edge_mask(mesh: Mesh, edges: jax.Array, emask: jax.Array):
+    """[E] bool: edge lies on the boundary surface (appears in a valid
+    tria). The flat-array analog of the xtetra-tag lookups the reference
+    does through `MMG5_HGeom` hashes (`src/hash_pmmg.c`)."""
+    keys = tria_edge_keys(mesh)
+    q = jnp.where(emask[:, None], edges, -1)
+    return sorted_membership(keys, q)
+
+
+def feature_edge_index(mesh: Mesh, edges: jax.Array, emask: jax.Array):
+    """[E] int32 index into mesh.edge of the feature edge matching each
+    unique tet edge (-1 if none)."""
+    lo = jnp.minimum(mesh.edge[:, 0], mesh.edge[:, 1])
+    hi = jnp.maximum(mesh.edge[:, 0], mesh.edge[:, 1])
+    dead = ~mesh.edmask
+    keys = jnp.stack(
+        [jnp.where(dead, -1, lo), jnp.where(dead, -1, hi)], axis=1
+    )
+    q = jnp.where(emask[:, None], edges, -1)
+    return match_rows(keys, q)
+
+
+def duplicate_tets(tet: jax.Array, valid: jax.Array) -> jax.Array:
+    """[T] bool: tet's sorted vertex set appears more than once among valid
+    tets (topological damage detector used to reject unsafe collapses —
+    the batched stand-in for Mmg's link-condition check)."""
+    tcap = tet.shape[0]
+    keys = jnp.sort(tet, axis=1)
+    slot = jnp.arange(tcap, dtype=jnp.int32)
+    keys = jnp.where(valid[:, None], keys, -(slot[:, None] + 2))
+    order = jnp.lexsort((keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0])).astype(
+        jnp.int32
+    )
+    sk = keys[order]
+    same_next = jnp.concatenate(
+        [jnp.all(sk[:-1] == sk[1:], axis=1), jnp.zeros(1, bool)]
+    )
+    same_prev = jnp.concatenate([jnp.zeros(1, bool), same_next[:-1]])
+    dup_sorted = same_next | same_prev
+    out = jnp.zeros(tcap, bool).at[order].set(dup_sorted)
+    return out & valid
+
+
+def vol_of(vert: jax.Array, tet: jax.Array) -> jax.Array:
+    c = vert[tet]
+    d1, d2, d3 = c[:, 1] - c[:, 0], c[:, 2] - c[:, 0], c[:, 3] - c[:, 0]
+    return jnp.einsum("ti,ti->t", jnp.cross(d1, d2), d3) / 6.0
+
+
+def quality_of(vert: jax.Array, met: jax.Array, tet: jax.Array) -> jax.Array:
+    """Quality of arbitrary tet rows against given vert/met arrays (same
+    measure as ops.quality.tet_quality, usable on tentative configs)."""
+    from ..core import metric as metric_mod
+    from ..core.mesh import EDGE_VERTS
+    from .quality import ALPHA
+
+    vol = vol_of(vert, tet)
+    ev = tet[:, EDGE_VERTS]
+    p0, p1 = vert[ev[..., 0]], vert[ev[..., 1]]
+    e = p1 - p0
+    if met.shape[1] == 6:
+        mt = jnp.mean(met[tet], axis=1)
+        M = metric_mod.sym6_to_mat(mt)
+        l2 = jnp.einsum("tei,tij,tej->te", e, M, e)
+        volm = vol * jnp.sqrt(jnp.maximum(metric_mod.metric_det(mt), 0.0))
+    else:
+        h = jnp.mean(met[tet, 0], axis=1)
+        l2 = jnp.sum(e * e, axis=-1) / jnp.maximum(h[:, None] ** 2, 1e-30)
+        volm = vol / jnp.maximum(h**3, 1e-30)
+    rap = jnp.sum(l2, axis=-1)
+    q = ALPHA * volm / jnp.maximum(rap, 1e-30) ** 1.5
+    return jnp.where(jnp.isfinite(q), q, 0.0)

@@ -90,17 +90,25 @@ def quality_histogram(mesh: Mesh, nbins: int = 5) -> QualityHisto:
 
 def reduce_histograms(h: QualityHisto, axis_name: str) -> QualityHisto:
     """Cross-shard reduction of per-shard histograms (inside shard_map),
-    replacing the reference's custom MPI_Op argmin-with-location reduce."""
+    replacing the reference's custom MPI_Op argmin-with-location reduce
+    (`PMMG_min_iel_compute`, reference `src/quality_pmmg.c:82`): worst_elt
+    becomes `shard * BIG + local_elt` of the globally worst element."""
     ne = jax.lax.psum(h.ne, axis_name)
     qmin = jax.lax.pmin(h.qmin, axis_name)
     qmax = jax.lax.pmax(h.qmax, axis_name)
     qavg = jax.lax.psum(h.qavg * h.ne.astype(h.qavg.dtype), axis_name) / jnp.maximum(
         ne, 1
     ).astype(h.qavg.dtype)
+    # argmin-with-location: only shards holding the global min vote
+    shard = jax.lax.axis_index(axis_name)
+    big = jnp.int64(2**31) if h.worst_elt.dtype == jnp.int64 else jnp.int32(2**20)
+    loc = shard.astype(h.worst_elt.dtype) * big + h.worst_elt
+    loc = jnp.where(h.qmin <= qmin, loc, jnp.iinfo(h.worst_elt.dtype).max)
+    worst = jax.lax.pmin(loc, axis_name)
     nbad = jax.lax.psum(h.nbad, axis_name)
     ninv = jax.lax.psum(h.ninverted, axis_name)
     counts = jax.lax.psum(h.counts, axis_name)
-    return QualityHisto(ne, qmin, qmax, qavg, h.worst_elt, nbad, ninv, counts)
+    return QualityHisto(ne, qmin, qmax, qavg, worst, nbad, ninv, counts)
 
 
 def format_histogram(h: QualityHisto, label: str = "MESH QUALITY") -> str:
@@ -142,8 +150,12 @@ class LengthStats:
     counts: jax.Array   # [nbins] histogram over log2-length classes
 
 
-# log2 bin edges for the length histogram (Mmg-style geometric classes)
-_LEN_EDGES = jnp.array([0.0, 0.3, 0.6, 0.7071, 0.9, 1.111, 1.4142, 2.0, 5.0])
+# bin edges for the length histogram (geometric classes around the exact
+# collapse/split thresholds so bins agree with n_small/n_large)
+_LEN_EDGES = jnp.array(
+    [0.0, 0.3, 0.6, float(metric_mod.LSHRT), 0.9, 1.111,
+     float(metric_mod.LLONG), 2.0, 5.0]
+)
 
 
 def length_stats(mesh: Mesh, edges, emask) -> LengthStats:

@@ -1,0 +1,205 @@
+"""Batched edge split: refine every metric-long edge in parallel.
+
+Functional counterpart of the refinement half of Mmg's adaptation kernel
+(`MMG5_mmg3d1_delone`, invoked by the reference at `src/libparmmg1.c:739`):
+edges longer than LLONG in the metric are bisected. Instead of serial cavity
+splits, a maximal independent set of long edges is selected per sweep (at
+most one split edge per tet, priority = metric length), and every incident
+tet/tria/feature-edge is split 1→2 in one vectorized update. Repeated
+sweeps converge to the same unit-length goal as the serial kernel.
+
+Frozen entities (PARBDY interface, REQUIRED) are never split, matching the
+reference's interface-freezing discipline (`src/tag_pmmg.c`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import metric as metric_mod
+from ..core import tags
+from ..core.mesh import EDGE_VERTS, Mesh
+from . import common
+
+
+class SplitStats(NamedTuple):
+    nsplit: jax.Array       # edges split this sweep
+    ncand: jax.Array        # long-edge candidates before selection
+    capped: jax.Array       # bool: capacity limited the sweep
+
+
+# tag bits a new mid-edge vertex inherits from a surface/feature edge
+_INHERIT = tags.BDY | tags.RIDGE | tags.REF | tags.REQUIRED
+
+
+@partial(jax.jit, static_argnames=("llong",), donate_argnums=0)
+def split_long_edges(
+    mesh: Mesh,
+    edges: jax.Array,
+    emask: jax.Array,
+    t2e: jax.Array,
+    llong: float = float(metric_mod.LLONG),
+):
+    """One split sweep. Mesh must be compacted (valid slots are prefixes).
+
+    Returns (mesh, SplitStats). Adjacency is left stale."""
+    ecap = edges.shape[0]
+    tcap = mesh.tcap
+    np0 = mesh.npoin
+    ne0 = mesh.ntet
+    nf0 = mesh.ntria
+    ned0 = mesh.nedge
+
+    a, b = edges[:, 0], edges[:, 1]
+    l = metric_mod.edge_length(
+        mesh.vert[a], mesh.vert[b], mesh.met[a], mesh.met[b]
+    )
+
+    surf = common.surface_edge_mask(mesh, edges, emask)
+    feat = common.feature_edge_index(mesh, edges, emask)
+    feat_tag = jnp.where(feat >= 0, mesh.edtag[feat], 0)
+    frozen = (
+        ((mesh.vtag[a] & tags.PARBDY) != 0) & ((mesh.vtag[b] & tags.PARBDY) != 0)
+    ) | ((feat_tag & tags.REQUIRED) != 0)
+    cand = emask & (l > llong) & ~frozen
+    ncand = jnp.sum(cand.astype(jnp.int32))
+
+    # --- independent-set selection: arena = incident tets ------------------
+    live_e = (t2e >= 0) & mesh.tmask[:, None]  # [TC,6]
+    safe_t2e = jnp.where(live_e, t2e, 0)
+
+    def scatter_arena(vals):  # [E] -> [TC] max over own edges
+        v6 = jnp.where(live_e, vals[safe_t2e], -jnp.inf)
+        return jnp.max(v6, axis=1)
+
+    def gather_arena(av):  # [TC] -> [E] max over incident tets
+        tgt = jnp.where(live_e, t2e, ecap)
+        out = jnp.full(ecap, -jnp.inf, av.dtype)
+        return out.at[tgt.reshape(-1)].max(
+            jnp.broadcast_to(av[:, None], (tcap, 6)).reshape(-1), mode="drop"
+        )
+
+    win = common.two_phase_winners(l, cand, scatter_arena, gather_arena)
+
+    # --- capacity capping --------------------------------------------------
+    inc_t = jnp.zeros(ecap, jnp.int32).at[safe_t2e.reshape(-1)].add(
+        live_e.reshape(-1).astype(jnp.int32), mode="drop"
+    )  # tets per edge
+    wi = win.astype(jnp.int32)
+    rank_v = jnp.cumsum(wi) - 1                      # new-vertex rank
+    used_t = jnp.cumsum(wi * inc_t)                  # appended tets
+    used_f = jnp.cumsum(wi * surf.astype(jnp.int32) * 2)  # appended trias (<=2)
+    used_e = jnp.cumsum(wi * (feat >= 0).astype(jnp.int32))
+    fits = (
+        (np0 + rank_v + 1 <= mesh.pcap)
+        & (ne0 + used_t <= tcap)
+        & (nf0 + used_f <= mesh.fcap)
+        & (ned0 + used_e <= mesh.ecap)
+    )
+    capped = jnp.any(win & ~fits)
+    win = win & fits
+    wi = win.astype(jnp.int32)
+    rank_v = jnp.cumsum(wi) - 1
+    nsplit = jnp.sum(wi)
+
+    # new vertex slot per winner edge
+    vnew = jnp.where(win, np0 + rank_v, -1).astype(jnp.int32)
+
+    # --- new vertex data ---------------------------------------------------
+    pa, pb = mesh.vert[a], mesh.vert[b]
+    mid = 0.5 * (pa + pb)
+    ma = mesh.met[a]
+    mets = jnp.stack([ma, mesh.met[b]], axis=-2)  # [E,2,C]
+    half = jnp.full(ecap, 0.5, mesh.vert.dtype)
+    bary = jnp.stack([half, half], axis=-1)
+    mmid = metric_mod.interp_metric(mets, bary)
+    new_tag = jnp.where(surf, tags.BDY, 0) | (feat_tag & _INHERIT)
+    new_ref = jnp.where(feat >= 0, mesh.edref[jnp.maximum(feat, 0)], 0)
+
+    tgt_v = jnp.where(win, vnew, mesh.pcap).astype(jnp.int32)  # OOB drop
+    vert = mesh.vert.at[tgt_v].set(mid, mode="drop")
+    met = mesh.met.at[tgt_v].set(mmid, mode="drop")
+    ls = mesh.ls.at[tgt_v].set(0.5 * (mesh.ls[a] + mesh.ls[b]), mode="drop")
+    disp = mesh.disp.at[tgt_v].set(0.5 * (mesh.disp[a] + mesh.disp[b]), mode="drop")
+    fields = mesh.fields.at[tgt_v].set(
+        0.5 * (mesh.fields[a] + mesh.fields[b]), mode="drop"
+    )
+    vtag = mesh.vtag.at[tgt_v].set(new_tag, mode="drop")
+    vref = mesh.vref.at[tgt_v].set(new_ref, mode="drop")
+    vmask = mesh.vmask.at[tgt_v].set(True, mode="drop")
+
+    # --- split tets --------------------------------------------------------
+    w6 = jnp.where(live_e, win[safe_t2e], False)  # [TC,6]
+    has = jnp.any(w6, axis=1) & mesh.tmask
+    k = jnp.argmax(w6, axis=1)                    # local edge slot
+    e_of_t = safe_t2e[jnp.arange(tcap), k]
+    nv_of_t = vnew[e_of_t]
+    ev_j = jnp.asarray(EDGE_VERTS)
+    li = ev_j[k, 0]
+    lj = ev_j[k, 1]
+    rows = jnp.arange(tcap)
+    # child A in place: vertex lj -> newv
+    tetA = mesh.tet.at[rows, lj].set(
+        jnp.where(has, nv_of_t, mesh.tet[rows, lj])
+    )
+    # child B appended: vertex li -> newv (of the ORIGINAL tet)
+    tetB = mesh.tet.at[rows, li].set(nv_of_t)
+    app_rank = jnp.cumsum(has.astype(jnp.int32)) - 1
+    tgt_t = jnp.where(has, ne0 + app_rank, tcap).astype(jnp.int32)
+    tet = tetA.at[tgt_t].set(tetB, mode="drop")
+    tref = mesh.tref.at[tgt_t].set(mesh.tref, mode="drop")
+    tmask = mesh.tmask.at[tgt_t].set(has, mode="drop")
+
+    # --- split trias -------------------------------------------------------
+    fcap = mesh.fcap
+    edge_keys = jnp.where(emask[:, None], edges, -1)
+    tri_keys = common.tria_edge_keys(mesh)  # [3*FC, 2], pair order 01,12,02
+    eid3 = common.match_rows(edge_keys, tri_keys).reshape(fcap, 3)
+    w3 = (eid3 >= 0) & win[jnp.maximum(eid3, 0)] & mesh.trmask[:, None]
+    fhas = jnp.any(w3, axis=1)
+    fk = jnp.argmax(w3, axis=1)
+    _TRI_PAIRS = jnp.array([[0, 1], [1, 2], [0, 2]], jnp.int32)
+    fu = _TRI_PAIRS[fk, 0]
+    fv = _TRI_PAIRS[fk, 1]
+    fe = jnp.maximum(eid3[jnp.arange(fcap), fk], 0)
+    fnv = vnew[fe]
+    frows = jnp.arange(fcap)
+    triA = mesh.tria.at[frows, fv].set(
+        jnp.where(fhas, fnv, mesh.tria[frows, fv])
+    )
+    triB = mesh.tria.at[frows, fu].set(fnv)
+    frank = jnp.cumsum(fhas.astype(jnp.int32)) - 1
+    tgt_f = jnp.where(fhas, nf0 + frank, fcap).astype(jnp.int32)
+    tria = triA.at[tgt_f].set(triB, mode="drop")
+    trref = mesh.trref.at[tgt_f].set(mesh.trref, mode="drop")
+    trtag = mesh.trtag.at[tgt_f].set(mesh.trtag, mode="drop")
+    trmask = mesh.trmask.at[tgt_f].set(fhas, mode="drop")
+
+    # --- split feature edges ----------------------------------------------
+    ehas = win & (feat >= 0)
+    fidx = jnp.where(ehas, feat, mesh.ecap).astype(jnp.int32)
+    # in place: (a,b) -> (a,newv)
+    edge_arr = mesh.edge.at[fidx, 1].set(vnew, mode="drop")
+    # append (newv, b)
+    erank = jnp.cumsum(ehas.astype(jnp.int32)) - 1
+    tgt_e = jnp.where(ehas, ned0 + erank, mesh.ecap).astype(jnp.int32)
+    newrow = jnp.stack([vnew, b], axis=1)
+    edge_arr = edge_arr.at[tgt_e].set(newrow, mode="drop")
+    edref = mesh.edref.at[tgt_e].set(
+        jnp.where(feat >= 0, mesh.edref[jnp.maximum(feat, 0)], 0), mode="drop"
+    )
+    edtag = mesh.edtag.at[tgt_e].set(feat_tag, mode="drop")
+    edmask = mesh.edmask.at[tgt_e].set(ehas, mode="drop")
+
+    out = mesh.replace(
+        vert=vert, met=met, ls=ls, disp=disp, fields=fields,
+        vtag=vtag, vref=vref, vmask=vmask,
+        tet=tet, tref=tref, tmask=tmask,
+        tria=tria, trref=trref, trtag=trtag, trmask=trmask,
+        edge=edge_arr, edref=edref, edtag=edtag, edmask=edmask,
+    )
+    return out, SplitStats(nsplit=nsplit, ncand=ncand, capped=capped)

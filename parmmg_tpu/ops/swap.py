@@ -1,0 +1,311 @@
+"""Batched topology swaps: 3-2 edge swaps and 2-3 face swaps.
+
+Counterpart of Mmg's swap operators inside `MMG5_mmg3d1_delone` (reference
+`src/libparmmg1.c:739`), quality-driven: a swap is applied only when the
+worst quality of the new configuration beats the worst of the old by a
+margin. Independent sets are selected with the affected tets as arena, and
+a duplicate-tet post-check rejects the rare interacting pathologies.
+
+The 3-2 swap extracts the ring of a 3-tet interior edge shell without a
+walk: each shell tet contributes its two off-edge vertices, every ring
+vertex appears exactly twice, so {min, sum/2-min-max, max} are the three
+ring vertices — one scatter instead of Mmg's pointer chase.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tags
+from ..core.mesh import FACE_VERTS, Mesh
+from . import common
+
+_VOL_EPS = 1e-14
+GAIN = 1.02          # required relative quality improvement
+QTHRESH = 0.5        # only try to improve tets worse than this
+
+
+class SwapStats(NamedTuple):
+    nswap32: jax.Array
+    nswap23: jax.Array
+
+
+def _oriented(t4: jax.Array, vert) -> jax.Array:
+    """Fix orientation of candidate tets [N,4] by swapping first two
+    vertices where the volume is negative."""
+    vol = common.vol_of(vert, t4)
+    sw = vol < 0
+    v0 = jnp.where(sw, t4[:, 1], t4[:, 0])
+    v1 = jnp.where(sw, t4[:, 0], t4[:, 1])
+    return jnp.stack([v0, v1, t4[:, 2], t4[:, 3]], axis=1)
+
+
+@partial(jax.jit, donate_argnums=0)
+def swap_32(
+    mesh: Mesh,
+    edges: jax.Array,
+    emask: jax.Array,
+    t2e: jax.Array,
+):
+    """3-2 edge swap sweep. Mesh must be compacted; adjacency left stale."""
+    ecap = edges.shape[0]
+    tcap = mesh.tcap
+    tet, tmask = mesh.tet, mesh.tmask
+    a, b = edges[:, 0], edges[:, 1]
+
+    live_e = (t2e >= 0) & tmask[:, None]
+    safe_t2e = jnp.where(live_e, t2e, 0)
+    flat_e = jnp.where(live_e, t2e, ecap).reshape(-1)
+
+    # shell size per edge
+    inc = jnp.zeros(ecap, jnp.int32).at[flat_e].add(
+        jnp.ones(tcap * 6, jnp.int32), mode="drop"
+    )
+    surf = common.surface_edge_mask(mesh, edges, emask)
+
+    # ring vertices via the twice-each trick
+    e6 = jnp.where(live_e, t2e, ecap)
+    # off-edge vertex sum/min/max per edge: each tet contributes the two
+    # vertices not on the edge
+    va, vb = a[safe_t2e], b[safe_t2e]          # [TC,6]
+    ring_sum = jnp.zeros(ecap, jnp.int32)
+    ring_min = jnp.full(ecap, 2**30, jnp.int32)
+    ring_max = jnp.full(ecap, -1, jnp.int32)
+    for c in range(4):
+        vc = tet[:, c][:, None]                # [TC,1] -> broadcast [TC,6]
+        vcb = jnp.broadcast_to(vc, (tcap, 6))
+        off = (vcb != va) & (vcb != vb) & live_e
+        idx = jnp.where(off, e6, ecap).reshape(-1)
+        vals = vcb.reshape(-1)
+        ring_sum = ring_sum.at[idx].add(vals, mode="drop")
+        ring_min = ring_min.at[idx].min(vals, mode="drop")
+        ring_max = ring_max.at[idx].max(vals, mode="drop")
+    u = ring_min
+    w = ring_max
+    v = ring_sum // 2 - u - w
+
+    # old worst quality over the shell
+    q_old = common.quality_of(mesh.vert, mesh.met, tet)
+    shell_min_q = jnp.full(ecap, jnp.inf).at[flat_e].min(
+        jnp.broadcast_to(q_old[:, None], (tcap, 6)).reshape(-1), mode="drop"
+    )
+
+    ok_ring = (u >= 0) & (v >= 0) & (w >= 0) & (u != v) & (v != w) & (u != w)
+    cand = (
+        emask
+        & (inc == 3)
+        & ~surf
+        & ok_ring
+        & (shell_min_q < QTHRESH)
+        # conservative near frozen interfaces
+        & ((mesh.vtag[a] & tags.PARBDY) == 0)
+        & ((mesh.vtag[b] & tags.PARBDY) == 0)
+    )
+
+    # new configuration
+    t1 = _oriented(jnp.stack([u, v, w, a], axis=1), mesh.vert)
+    t2_ = _oriented(jnp.stack([u, w, v, b], axis=1), mesh.vert)
+    q1 = common.quality_of(mesh.vert, mesh.met, t1)
+    q2 = common.quality_of(mesh.vert, mesh.met, t2_)
+    v1 = common.vol_of(mesh.vert, t1)
+    v2 = common.vol_of(mesh.vert, t2_)
+    # volume conservation rejects non-convex shells whose new tets are
+    # individually positive but overlap outside the old shell (each tet
+    # has exactly one slot matching this edge, so the scatter counts each
+    # shell tet once)
+    vol_all = common.vol_of(mesh.vert, tet)
+    shell_vol = jnp.zeros(ecap, vol_all.dtype).at[flat_e].add(
+        jnp.broadcast_to(vol_all[:, None], (tcap, 6)).reshape(-1), mode="drop"
+    )
+    new_min = jnp.minimum(q1, q2)
+    conserve = jnp.abs((v1 + v2) - shell_vol) <= 1e-9 * jnp.maximum(
+        shell_vol, 1e-30
+    )
+    gain_ok = (
+        (new_min > GAIN * shell_min_q)
+        & (v1 > _VOL_EPS)
+        & (v2 > _VOL_EPS)
+        & conserve
+    )
+    # the new tets must not already exist
+    tet_keys = jnp.where(tmask[:, None], jnp.sort(tet, axis=1), -1)
+    exists1 = common.sorted_membership(tet_keys, jnp.sort(t1, axis=1))
+    exists2 = common.sorted_membership(tet_keys, jnp.sort(t2_, axis=1))
+    cand = cand & gain_ok & ~exists1 & ~exists2
+
+    # --- arena = the 3 shell tets -----------------------------------------
+    def scatter_arena(vals):
+        out = jnp.full(tcap, -jnp.inf, vals.dtype)
+        v6 = jnp.where(live_e, vals[safe_t2e], -jnp.inf)
+        return jnp.max(v6, axis=1)
+
+    def gather_arena(av):
+        out = jnp.full(ecap, -jnp.inf, av.dtype)
+        return out.at[flat_e].max(
+            jnp.broadcast_to(av[:, None], (tcap, 6)).reshape(-1), mode="drop"
+        )
+
+    win = common.two_phase_winners(new_min - shell_min_q, cand,
+                                   scatter_arena, gather_arena)
+
+    # per-tet winner edge (<=1 by arena property)
+    w6 = jnp.where(live_e, win[safe_t2e], False)
+    has = jnp.any(w6, axis=1) & tmask
+    k = jnp.argmax(w6, axis=1)
+    e_t = jnp.where(has, safe_t2e[jnp.arange(tcap), k], -1)
+
+    # rank shell tets of each winner by slot id
+    slot = jnp.arange(tcap, dtype=jnp.int32)
+    smin = jnp.full(ecap, tcap, jnp.int32).at[
+        jnp.where(has, e_t, ecap)
+    ].min(slot, mode="drop")
+    smax = jnp.full(ecap, -1, jnp.int32).at[
+        jnp.where(has, e_t, ecap)
+    ].max(slot, mode="drop")
+    e_ts = jnp.maximum(e_t, 0)
+    rank0 = has & (slot == smin[e_ts])
+    rank2 = has & (slot == smax[e_ts])
+    rank1 = has & ~rank0 & ~rank2
+
+    tet_new = jnp.where(rank0[:, None], t1[e_ts], tet)
+    tet_new = jnp.where(rank1[:, None], t2_[e_ts], tet_new)
+    tmask_new = tmask & ~rank2
+
+    # duplicate post-check (cross-swap interactions)
+    dup = common.duplicate_tets(tet_new, tmask_new)
+    bad_e = jnp.zeros(ecap, bool).at[
+        jnp.where(dup & has, e_t, ecap)
+    ].max(True, mode="drop")
+    win = win & ~bad_e
+    wk = win[e_ts] & has
+    tet_out = jnp.where((rank0 & wk)[:, None], t1[e_ts], tet)
+    tet_out = jnp.where((rank1 & wk)[:, None], t2_[e_ts], tet_out)
+    tmask_out = tmask & ~(rank2 & wk)
+
+    nswap = jnp.sum(win.astype(jnp.int32))
+    out = mesh.replace(tet=tet_out, tmask=tmask_out)
+    return out, SwapStats(nswap32=nswap, nswap23=jnp.int32(0))
+
+
+@partial(jax.jit, donate_argnums=0)
+def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
+    """2-3 face swap sweep. Requires FRESH adjacency; leaves it stale."""
+    tcap = mesh.tcap
+    tet, tmask, adja = mesh.tet, mesh.tmask, mesh.adja
+    ne0 = mesh.ntet
+    ncand_cap = tcap * 4
+
+    # candidate faces: interior, t < neighbor (dedupe)
+    t_id = jnp.broadcast_to(
+        jnp.arange(tcap, dtype=jnp.int32)[:, None], (tcap, 4)
+    ).reshape(-1)
+    f_id = jnp.broadcast_to(
+        jnp.arange(4, dtype=jnp.int32)[None, :], (tcap, 4)
+    ).reshape(-1)
+    nb = adja.reshape(-1)
+    t2 = nb // 4
+    valid = (nb >= 0) & tmask[jnp.clip(t2, 0, tcap - 1)] & tmask[t_id]
+    t2c = jnp.clip(t2, 0, tcap - 1)
+    valid = valid & (t_id < t2c)
+
+    fvidx = jnp.asarray(FACE_VERTS)[f_id]               # [N,3] local slots
+    fv = jnp.take_along_axis(tet[t_id], fvidx, axis=1)  # [N,3] vertex ids
+    d1 = tet[t_id, f_id]
+    d2 = tet[t2c, nb % 4]
+
+    q_all = common.quality_of(mesh.vert, mesh.met, tet)
+    old_min = jnp.minimum(q_all[t_id], q_all[t2c])
+
+    # edge (d1,d2) must not already exist
+    elo = jnp.minimum(d1, d2)
+    ehi = jnp.maximum(d1, d2)
+    ekeys = jnp.where(emask[:, None], edges, -1)
+    equery = jnp.stack(
+        [jnp.where(valid, elo, -1), jnp.where(valid, ehi, -1)], axis=1
+    )
+    edge_exists = common.sorted_membership(ekeys, equery)
+
+    # three new tets around (d1,d2)
+    x, y, z = fv[:, 0], fv[:, 1], fv[:, 2]
+    cands = [
+        jnp.stack([x, y, d1, d2], axis=1),
+        jnp.stack([y, z, d1, d2], axis=1),
+        jnp.stack([z, x, d1, d2], axis=1),
+    ]
+    cands = [_oriented(c, mesh.vert) for c in cands]
+    qs = [common.quality_of(mesh.vert, mesh.met, c) for c in cands]
+    vs = [common.vol_of(mesh.vert, c) for c in cands]
+    new_min = jnp.minimum(jnp.minimum(qs[0], qs[1]), qs[2])
+    vol_old2 = common.vol_of(mesh.vert, tet)
+    pair_vol = vol_old2[t_id] + vol_old2[t2c]
+    conserve = jnp.abs((vs[0] + vs[1] + vs[2]) - pair_vol) <= 1e-9 * jnp.maximum(
+        pair_vol, 1e-30
+    )
+    vol_ok = (
+        (vs[0] > _VOL_EPS) & (vs[1] > _VOL_EPS) & (vs[2] > _VOL_EPS) & conserve
+    )
+
+    cand = (
+        valid
+        & (old_min < QTHRESH)
+        & ~edge_exists
+        & vol_ok
+        & (new_min > GAIN * old_min)
+    )
+
+    # --- arena = the two tets ---------------------------------------------
+    def scatter_arena(vals):
+        out = jnp.full(tcap, -jnp.inf, vals.dtype)
+        out = out.at[t_id].max(vals, mode="drop")
+        out = out.at[t2c].max(vals, mode="drop")
+        return out
+
+    def gather_arena(av):
+        return jnp.maximum(av[t_id], av[t2c])
+
+    win = common.two_phase_winners(new_min - old_min, cand,
+                                   scatter_arena, gather_arena)
+
+    # capacity: one appended tet per winner
+    wi = win.astype(jnp.int32)
+    rank = jnp.cumsum(wi) - 1
+    fits = ne0 + rank + 1 <= tcap
+    win = win & fits
+    wi = win.astype(jnp.int32)
+    rank = jnp.cumsum(wi) - 1
+
+    # tentative apply: children 0/1 overwrite t and t2, child 2 appended
+    tet_out = tet
+    tgt_a = jnp.where(win, t_id, tcap)
+    tet_out = tet_out.at[tgt_a].set(cands[0], mode="drop")
+    tgt_b = jnp.where(win, t2c, tcap)
+    tet_out = tet_out.at[tgt_b].set(cands[1], mode="drop")
+    tgt_c = jnp.where(win, ne0 + rank, tcap).astype(jnp.int32)
+    tet_out = tet_out.at[tgt_c].set(cands[2], mode="drop")
+    tmask_out = tmask.at[tgt_c].set(win, mode="drop")
+
+    # duplicate post-check: reject interacting winners and revert
+    dup = common.duplicate_tets(tet_out, tmask_out)
+    bad = (
+        dup[jnp.clip(t_id, 0, tcap - 1)]
+        | dup[t2c]
+        | dup[jnp.clip(ne0 + rank, 0, tcap - 1)]
+    ) & win
+    win2 = win & ~bad
+    tgt_a = jnp.where(win2, t_id, tcap)
+    tgt_b = jnp.where(win2, t2c, tcap)
+    tgt_c = jnp.where(win2, ne0 + rank, tcap).astype(jnp.int32)
+    tet_out = tet
+    tet_out = tet_out.at[tgt_a].set(cands[0], mode="drop")
+    tet_out = tet_out.at[tgt_b].set(cands[1], mode="drop")
+    tet_out = tet_out.at[tgt_c].set(cands[2], mode="drop")
+    tref_out = mesh.tref.at[tgt_c].set(mesh.tref[t_id], mode="drop")
+    tmask_out = tmask.at[tgt_c].set(win2, mode="drop")
+
+    out = mesh.replace(tet=tet_out, tref=tref_out, tmask=tmask_out)
+    return out, SwapStats(nswap32=jnp.int32(0),
+                          nswap23=jnp.sum(win2.astype(jnp.int32)))

@@ -1,0 +1,91 @@
+"""Mesh conformity / integrity checker.
+
+The single-shard analog of the reference's communicator invariant checker
+(`src/chkcomm_pmmg.c`, used as asserts at phase boundaries): verifies that a
+mesh is a valid conforming tetrahedrization so remeshing bugs surface
+immediately in tests and debug runs instead of corrupting later phases.
+
+Host-side numpy (used in tests/debug paths, not in the hot loop).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import List
+
+import numpy as np
+
+from ..core.mesh import FACE_VERTS, Mesh
+
+
+@dataclass
+class ConformityReport:
+    ok: bool
+    errors: List[str] = field(default_factory=list)
+
+    def __str__(self):
+        return "conforming" if self.ok else "; ".join(self.errors)
+
+
+def check_mesh(mesh: Mesh, check_boundary: bool = True) -> ConformityReport:
+    d = mesh.to_numpy()
+    verts, tets, trias = d["verts"], d["tets"], d["trias"]
+    errors: List[str] = []
+
+    if len(tets):
+        if tets.min() < 0 or tets.max() >= len(verts):
+            errors.append("tet vertex index out of range")
+
+        # positive volumes
+        c = verts[tets]
+        vol = np.einsum(
+            "ti,ti->t",
+            np.cross(c[:, 1] - c[:, 0], c[:, 2] - c[:, 0]),
+            c[:, 3] - c[:, 0],
+        ) / 6.0
+        ninv = int((vol <= 0).sum())
+        if ninv:
+            errors.append(f"{ninv} non-positive tets (minvol {vol.min():.3e})")
+
+        # degenerate tets (repeated vertex)
+        srt = np.sort(tets, axis=1)
+        if np.any(srt[:, :-1] == srt[:, 1:]):
+            errors.append("tet with repeated vertex")
+
+        # duplicate tets
+        _, cnt = np.unique(srt, axis=0, return_counts=True)
+        if (cnt > 1).any():
+            errors.append(f"{int((cnt > 1).sum())} duplicate tets")
+
+        # every face shared by at most 2 tets; count boundary faces
+        faces = np.sort(tets[:, FACE_VERTS].reshape(-1, 3), axis=1)
+        fkeys, fcnt = np.unique(faces, axis=0, return_counts=True)
+        over = fcnt > 2
+        if over.any():
+            errors.append(f"{int(over.sum())} faces shared by >2 tets")
+        bfaces = {tuple(r) for r in fkeys[fcnt == 1]}
+
+        if check_boundary and len(trias):
+            tset = Counter(tuple(r) for r in np.sort(trias, axis=1))
+            dup_tria = sum(1 for k, v in tset.items() if v > 1)
+            if dup_tria:
+                errors.append(f"{dup_tria} duplicate trias")
+            missing = [t for t in tset if t not in bfaces]
+            # trias may also sit on internal material interfaces (faces
+            # shared by 2 tets with different refs) — only flag trias
+            # matching no tet face at all
+            allf = {tuple(r) for r in fkeys}
+            ghost = sum(1 for t in missing if t not in allf)
+            if ghost:
+                errors.append(f"{ghost} trias matching no tet face")
+            uncovered = sum(1 for t in bfaces if t not in tset)
+            if uncovered:
+                errors.append(f"{uncovered} boundary faces without tria")
+
+        # vertices referenced must be valid (to_numpy guarantees range) —
+        # check no orphan NaN coords among referenced vertices
+        if np.isnan(verts[np.unique(tets)]).any():
+            errors.append("NaN coordinates")
+
+    return ConformityReport(ok=not errors, errors=errors)
