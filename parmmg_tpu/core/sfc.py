@@ -1,0 +1,49 @@
+"""Space-filling-curve (Morton) keys for spatial seeding and partitioning.
+
+The reference partitions via Metis k-way graph partitioning
+(`src/metis_pmmg.c`, `PMMG_part_meshElts2metis:1271`); the TPU-native design
+replaces the graph library with Morton keys of tet barycenters + a prefix-sum
+split into contiguous key ranges — fully on device, no host graph build.
+The same keys provide cache-friendly renumbering (the Scotch role,
+reference `src/libparmmg1.c:468-535`) and walk-seed locality for point
+location (`src/locate_pmmg.c` warm starts under USE_POINTMAP).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MORTON_BITS = 10  # 10 bits/axis -> 30-bit keys, fits int32
+
+
+def _spread3(x: jax.Array) -> jax.Array:
+    """Spread the low 10 bits of x so consecutive bits land 3 apart."""
+    x = x & 0x3FF
+    x = (x | (x << 16)) & 0x030000FF
+    x = (x | (x << 8)) & 0x0300F00F
+    x = (x | (x << 4)) & 0x030C30C3
+    x = (x | (x << 2)) & 0x09249249
+    return x
+
+
+def morton3d(ix: jax.Array, iy: jax.Array, iz: jax.Array) -> jax.Array:
+    """Interleave three 10-bit integer coords into a 30-bit Morton key."""
+    return (
+        _spread3(ix.astype(jnp.int32))
+        | (_spread3(iy.astype(jnp.int32)) << 1)
+        | (_spread3(iz.astype(jnp.int32)) << 2)
+    )
+
+
+def quantize(pts: jax.Array, lo: jax.Array, hi: jax.Array) -> jax.Array:
+    """[...,3] float coords -> [...,3] integer grid coords in [0, 2^10)."""
+    scale = (2.0**MORTON_BITS - 1.0) / jnp.maximum(hi - lo, 1e-30)
+    q = (pts - lo) * scale
+    return jnp.clip(q.astype(jnp.int32), 0, 2**MORTON_BITS - 1)
+
+
+def morton_keys(pts: jax.Array, lo: jax.Array, hi: jax.Array) -> jax.Array:
+    """[...] int32 Morton key of each point within the box [lo, hi]."""
+    q = quantize(pts, lo, hi)
+    return morton3d(q[..., 0], q[..., 1], q[..., 2])

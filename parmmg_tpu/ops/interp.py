@@ -1,0 +1,74 @@
+"""Metric and field interpolation from a background (old) mesh.
+
+TPU-native counterpart of `src/interpmesh_pmmg.c`
+(`PMMG_interpMetricsAndFields:663`, per-vertex dispatch
+`PMMG_interpMetricsAndFields_mesh:477`): every valid vertex of the new mesh
+is located in the old mesh (batched walk, `ops.locate`) and its metric,
+level-set, displacement and user fields are interpolated with P1 barycentric
+weights — log-Euclidean for anisotropic tensors, harmonic-in-1/h for
+isotropic sizes (`PMMG_interp4bar_iso:206` / `_ani:247` semantics).
+REQUIRED vertices keep their previous values instead of being re-interpolated
+(`PMMG_copyMetrics_point:373` / `PMMG_copySol_point:312` role).
+
+No cross-shard communication happens here: like the reference, each shard
+interpolates from *its own* old snapshot because remeshing precedes
+migration within an iteration (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import metric as metric_mod, tags
+from ..core.mesh import Mesh
+from . import locate
+
+
+@jax.jit
+def interp_at(
+    old: Mesh, tet_idx: jax.Array, bary: jax.Array
+):
+    """Interpolate old-mesh vertex data at located points.
+
+    tet_idx: [Q] containing tet slots in `old`, bary: [Q,4].
+    Returns (met [Q,C], ls [Q,·], disp [Q,·], fields [Q,·]).
+    """
+    vids = old.tet[tet_idx]  # [Q,4]
+    met = metric_mod.interp_metric(old.met[vids], bary)
+
+    def lin(a):
+        return jnp.einsum("qk,qkc->qc", bary, a[vids])
+
+    return met, lin(old.ls), lin(old.disp), lin(old.fields)
+
+
+def interp_metrics_and_fields(
+    new: Mesh,
+    old: Mesh,
+    max_steps: int = 64,
+) -> tuple[Mesh, locate.LocateResult]:
+    """Locate every valid new vertex in `old` and pull met/ls/disp/fields.
+
+    `old` must carry fresh adjacency (`adjacency.build_adjacency`).
+    Vertices tagged REQUIRED keep their current values. Returns the updated
+    mesh and the location result (for search statistics / diagnostics).
+    """
+    res = locate.locate_points(old, new.vert, max_steps=max_steps)
+    met_q, ls_q, disp_q, f_q = interp_at(old, res.tet, res.bary)
+    keep = (~new.vmask) | ((new.vtag & tags.REQUIRED) != 0)
+
+    def sel(cur, q):
+        if cur.shape[1] == 0 or q.shape[-1] != cur.shape[1]:
+            return cur
+        return jnp.where(keep[:, None], cur, q.astype(cur.dtype))
+
+    return (
+        new.replace(
+            met=sel(new.met, met_q),
+            ls=sel(new.ls, ls_q),
+            disp=sel(new.disp, disp_q),
+            fields=sel(new.fields, f_q),
+        ),
+        res,
+    )

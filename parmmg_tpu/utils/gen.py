@@ -1,0 +1,123 @@
+"""Structured test-mesh generation.
+
+Stands in for the reference CI's mesh fixtures and its mesh-generator helper
+binary (`cmake/testing/pmmg_tests.cmake:250-304` drives
+`libexamples/.../genDistributedMesh`): a unit-cube structured tet mesh of
+n^3 cells x 6 tets, with boundary triangles and refs, at any size — used by
+tests and by `bench.py` to build the 10M-tet class workloads of
+BASELINE.json without external fixture downloads.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# 6-tet Kuhn decomposition of the unit cube: each tet is a chain of corners
+# along a permutation of the axes (vertex 0 = cube corner 0, vertex 3 =
+# corner 7) — all positively oriented, face-to-face compatible between cells.
+_KUHN_PERMS = [
+    (0, 1, 2), (0, 2, 1), (1, 0, 2), (1, 2, 0), (2, 0, 1), (2, 1, 0)
+]
+
+
+def _kuhn_tets() -> np.ndarray:
+    tets = []
+    for p in _KUHN_PERMS:
+        corners = [0]
+        acc = np.zeros(3, np.int64)
+        for ax in p:
+            acc[ax] = 1
+            corners.append(acc[0] + 2 * acc[1] + 4 * acc[2])
+        tets.append(corners)
+    t = np.array(tets, np.int64)
+    # fix orientation: ensure positive volume for corner coords
+    corner = np.array([[i & 1, (i >> 1) & 1, (i >> 2) & 1] for i in range(8)], float)
+    for i, row in enumerate(t):
+        c = corner[row]
+        v = np.dot(np.cross(c[1] - c[0], c[2] - c[0]), c[3] - c[0])
+        if v < 0:
+            t[i] = t[i, [0, 1, 3, 2]]
+    return t
+
+
+_KUHN = _kuhn_tets()
+
+
+def unit_cube(n: int, perturb: float = 0.0, seed: int = 0):
+    """Structured unit-cube mesh: (n+1)^3 vertices, 6*n^3 tets.
+
+    Returns dict(verts, tets, trias, trrefs, vrefs) of 0-based numpy arrays.
+    `perturb` jitters interior vertices by a fraction of the cell size (to
+    de-structure the mesh while keeping it valid for perturb <~ 0.25).
+    """
+    k = n + 1
+    idx = np.arange(k)
+    z, y, x = np.meshgrid(idx, idx, idx, indexing="ij")
+    verts = np.stack([x, y, z], axis=-1).reshape(-1, 3).astype(np.float64) / n
+
+    def vid(ix, iy, iz):
+        return ix + k * (iy + k * iz)
+
+    cz, cy, cx = np.meshgrid(
+        np.arange(n), np.arange(n), np.arange(n), indexing="ij"
+    )
+    cx, cy, cz = cx.reshape(-1), cy.reshape(-1), cz.reshape(-1)
+    # 8 cube corner ids per cell, bit i of corner index = axis offset
+    corners = np.stack(
+        [
+            vid(cx + (c & 1), cy + ((c >> 1) & 1), cz + ((c >> 2) & 1))
+            for c in range(8)
+        ],
+        axis=1,
+    )  # [ncell, 8]
+    tets = corners[:, _KUHN].reshape(-1, 4)
+
+    if perturb:
+        rng = np.random.default_rng(seed)
+        interior = np.all((verts > 1e-12) & (verts < 1 - 1e-12), axis=1)
+        verts[interior] += (
+            rng.uniform(-perturb, perturb, (interior.sum(), 3)) / n
+        )
+
+    # boundary triangles: the two face-diagonal triangles per boundary cell
+    # face, extracted from tet faces lying on the box sides (ref = side id)
+    from ..core.mesh import FACE_VERTS
+
+    fv = tets[:, FACE_VERTS].reshape(-1, 3)  # all tet faces
+    c = verts[fv]  # [F,3,3]
+    trias, trrefs = [], []
+    for axis in range(3):
+        for side, val, ref in ((0, 0.0, 2 * axis + 1), (1, 1.0, 2 * axis + 2)):
+            on = np.all(np.abs(c[..., axis] - val) < 1e-12, axis=1)
+            trias.append(fv[on])
+            trrefs.append(np.full(on.sum(), ref, np.int64))
+    trias = np.concatenate(trias)
+    trrefs = np.concatenate(trrefs)
+    return dict(
+        verts=verts,
+        tets=tets.astype(np.int64),
+        trias=trias.astype(np.int64),
+        trrefs=trrefs,
+        vrefs=np.zeros(len(verts), np.int64),
+    )
+
+
+def unit_cube_mesh(n: int, dtype=None, perturb: float = 0.0, seed: int = 0,
+                   headroom: float = 1.5, **kw):
+    """unit_cube as a device Mesh with adjacency built."""
+    import jax.numpy as jnp
+
+    from ..core import adjacency
+    from ..core.mesh import Mesh
+
+    raw = unit_cube(n, perturb=perturb, seed=seed)
+    m = Mesh.from_numpy(
+        raw["verts"],
+        raw["tets"],
+        trias=raw["trias"],
+        trrefs=raw["trrefs"],
+        dtype=dtype or jnp.float32,
+        headroom=headroom,
+        **kw,
+    )
+    return adjacency.build_adjacency(m)

@@ -1,0 +1,159 @@
+"""M2 tests: barycentric coords, point location walk, interpolation.
+
+Mirrors the intent of the reference's location/interpolation CI tests
+(`cmake/testing/pmmg_tests.cmake:215-241` field interpolation and
+`:598-625` locate scenarios incl. exhaustive fallback), run on device.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from parmmg_tpu.core import adjacency
+from parmmg_tpu.core.mesh import Mesh
+from parmmg_tpu.ops import interp, locate
+from parmmg_tpu.utils import gen
+
+
+@pytest.fixture(scope="module")
+def cube8():
+    return gen.unit_cube_mesh(8, dtype=jnp.float64, perturb=0.15)
+
+
+def test_kuhn_mesh_valid():
+    m = gen.unit_cube_mesh(4, dtype=jnp.float64)
+    from parmmg_tpu.core.mesh import tet_volumes
+
+    vol = np.asarray(tet_volumes(m))[np.asarray(m.tmask)]
+    assert (vol > 0).all()
+    assert np.isclose(vol.sum(), 1.0)
+    # every interior face matched
+    adja = np.asarray(m.adja)[np.asarray(m.tmask)]
+    nbnd = (adja < 0).sum()
+    assert nbnd == 2 * 6 * 4 * 4  # 2 trias per cell face * 6 sides * n^2
+
+
+def test_barycoords_unit_tet():
+    c = jnp.array(
+        [[[0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1]]], dtype=jnp.float64
+    )
+    p = jnp.array([[0.25, 0.25, 0.25]])
+    lam = locate.tet_barycoords(c, p)
+    np.testing.assert_allclose(
+        np.asarray(lam)[0], [0.25, 0.25, 0.25, 0.25], atol=1e-14
+    )
+    # vertex reproduces indicator
+    lam = locate.tet_barycoords(c, jnp.array([[0.0, 0.0, 0.0]]))
+    np.testing.assert_allclose(np.asarray(lam)[0], [1, 0, 0, 0], atol=1e-14)
+    # outside: negative coordinate on the far side
+    lam = locate.tet_barycoords(c, jnp.array([[-0.5, 0.2, 0.2]]))
+    assert np.asarray(lam)[0].min() < 0
+
+
+def test_walk_locates_interior_points(cube8):
+    rng = np.random.default_rng(3)
+    pts = jnp.asarray(rng.uniform(0.05, 0.95, (500, 3)))
+    res = locate.locate_points(cube8, pts)
+    assert bool(jnp.all(res.found))
+    # containing tet reproduces the point from its barycoords
+    c = cube8.vert[cube8.tet[res.tet]]
+    rec = jnp.einsum("qk,qkd->qd", res.bary, c)
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(pts), atol=1e-10)
+
+
+def test_exhaustive_fallback_outside_point(cube8):
+    pts = jnp.asarray([[1.5, 0.5, 0.5], [0.5, 0.5, 0.5]])
+    res = locate.locate_points(cube8, pts)
+    # outside point not walkable but gets a closest element with clamped
+    # simplex coords (reference closest-point fallback, barycoord_pmmg.c:324)
+    assert not bool(res.found[0])
+    assert bool(res.found[1])
+    lam = np.asarray(res.bary)
+    assert (lam >= 0).all()
+    np.testing.assert_allclose(lam.sum(1), 1.0, atol=1e-12)
+
+
+def test_interp_linear_field_exact(cube8):
+    # P1 interpolation reproduces affine fields exactly
+    a = np.array([0.3, -1.2, 2.0])
+    b = 0.7
+    v = np.asarray(cube8.vert)
+    ls = (v @ a + b)[:, None]
+    old = cube8.replace(ls=jnp.asarray(ls))
+    rng = np.random.default_rng(7)
+    pts = jnp.asarray(rng.uniform(0.02, 0.98, (300, 3)))
+    res = locate.locate_points(old, pts)
+    _, ls_q, _, _ = interp.interp_at(old, res.tet, res.bary)
+    expect = np.asarray(pts) @ a + b
+    np.testing.assert_allclose(np.asarray(ls_q)[:, 0], expect, atol=1e-10)
+
+
+def test_interp_constant_metric_iso(cube8):
+    old = cube8.replace(met=jnp.full((cube8.pcap, 1), 0.37, jnp.float64))
+    pts = jnp.asarray(np.random.default_rng(0).uniform(0.1, 0.9, (50, 3)))
+    res = locate.locate_points(old, pts)
+    met_q, _, _, _ = interp.interp_at(old, res.tet, res.bary)
+    np.testing.assert_allclose(np.asarray(met_q), 0.37, atol=1e-12)
+
+
+def test_interp_constant_metric_aniso(cube8):
+    m6 = np.array([4.0, 0.5, 0.0, 9.0, 0.0, 16.0])
+    met = np.tile(m6, (cube8.pcap, 1))
+    old = cube8.replace(met=jnp.asarray(met))
+    pts = jnp.asarray(np.random.default_rng(1).uniform(0.1, 0.9, (50, 3)))
+    res = locate.locate_points(old, pts)
+    met_q, _, _, _ = interp.interp_at(old, res.tet, res.bary)
+    np.testing.assert_allclose(
+        np.asarray(met_q), np.tile(m6, (50, 1)), atol=1e-9
+    )
+
+
+def test_interp_mesh_driver(cube8):
+    """interp_metrics_and_fields maps new-mesh vertices through the old
+    snapshot: smooth iso metric field interpolates within field bounds."""
+    v = np.asarray(cube8.vert)
+    h = (0.05 + 0.1 * v[:, 0] + 0.05 * v[:, 1])[:, None]
+    old = cube8.replace(met=jnp.asarray(h))
+    new = gen.unit_cube_mesh(5, dtype=jnp.float64, perturb=0.1)
+    new, res = interp.interp_metrics_and_fields(new, old)
+    got = np.asarray(new.met)[np.asarray(new.vmask)]
+    assert got.min() >= 0.05 - 1e-9
+    assert got.max() <= 0.2 + 1e-9
+    # interior points located strictly
+    assert np.asarray(res.found).mean() > 0.9
+
+
+def test_required_vertices_keep_values(cube8):
+    from parmmg_tpu.core import tags
+
+    new = gen.unit_cube_mesh(5, dtype=jnp.float64)
+    vtag = np.asarray(new.vtag).copy()
+    vtag[7] |= tags.REQUIRED
+    met0 = np.asarray(new.met).copy()
+    met0[7] = 123.0
+    new = new.replace(vtag=jnp.asarray(vtag), met=jnp.asarray(met0))
+    old = cube8.replace(met=jnp.full((cube8.pcap, 1), 0.5, jnp.float64))
+    new, _ = interp.interp_metrics_and_fields(new, old)
+    met = np.asarray(new.met)
+    assert met[7, 0] == 123.0
+    assert np.allclose(met[:7, 0], 0.5)
+
+
+def test_locate_after_adapt(cube_mesh_path):
+    """End-to-end M2: adapt the reference cube then re-interpolate its
+    metric from the pre-adaptation snapshot (the parmmglib1 inner-loop
+    pattern, reference src/libparmmg1.c:829)."""
+    from parmmg_tpu.io import medit
+    from parmmg_tpu.models import adapt as adapt_mod
+
+    old = medit.load_mesh(cube_mesh_path, dtype=jnp.float64)
+    old = adjacency.build_adjacency(old)
+    old = old.replace(met=jnp.full((old.pcap, 1), 0.3, jnp.float64))
+
+    opts = adapt_mod.AdaptOptions(niter=1, max_sweeps=4, hsiz=0.3)
+    new, _ = adapt_mod.adapt(old, opts)
+    new, res = interp.interp_metrics_and_fields(new, old)
+    met = np.asarray(new.met)[np.asarray(new.vmask)]
+    np.testing.assert_allclose(met, 0.3, atol=1e-9)
