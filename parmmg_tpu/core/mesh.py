@@ -84,6 +84,10 @@ class Mesh:
     field_ncomp: Tuple[int, ...] = dataclasses.field(
         default=(), metadata=dict(static=True)
     )
+    # whether `met` holds a user-prescribed metric (vs. the all-ones fill);
+    # an explicit flag, not value sniffing — a legitimate uniform h=1.0
+    # metric must not be mistaken for "unset"
+    met_set: bool = dataclasses.field(default=False, metadata=dict(static=True))
 
     # --- capacities (static) ---------------------------------------------
     @property
@@ -220,8 +224,18 @@ class Mesh:
             disp=jnp.asarray(_pad2(disp_np, pc, 0.0), dtype),
             fields=jnp.asarray(_pad2(f_np, pc, 0.0), dtype),
             field_ncomp=tuple(field_ncomp),
+            met_set=met is not None,
         )
         return mesh
+
+    def with_metric(self, met) -> "Mesh":
+        """Attach a user metric (marks it as prescribed for the adapter)."""
+        met = jnp.asarray(met, self.dtype)
+        if met.shape[0] != self.pcap:
+            raise ValueError(
+                f"metric rows {met.shape[0]} != vertex capacity {self.pcap}"
+            )
+        return dataclasses.replace(self, met=met, met_set=True)
 
     # --- host-side extraction --------------------------------------------
     def to_numpy(self) -> dict:

@@ -74,21 +74,25 @@ def remesh_sweep(
     if not noinsert:
         mesh, s_split = split.split_long_edges(mesh, edges, emask, t2e)
         mesh = compact(mesh)
-        edges, emask, t2e, _ = adjacency.unique_edges(mesh, ecap)
+        edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
+        n_unique = jnp.maximum(n_unique, nu)
     else:
         s_split = split.SplitStats(jnp.int32(0), jnp.int32(0), jnp.bool_(False))
 
     mesh, s_col = collapse.collapse_short_edges(mesh, edges, emask, t2e)
     mesh = compact(mesh)
-    edges, emask, t2e, _ = adjacency.unique_edges(mesh, ecap)
+    edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
+    n_unique = jnp.maximum(n_unique, nu)
 
     if not noswap:
         mesh, s_32 = swap.swap_32(mesh, edges, emask, t2e)
         mesh = adjacency.build_adjacency(compact(mesh))
-        edges, emask, t2e, _ = adjacency.unique_edges(mesh, ecap)
+        edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
+        n_unique = jnp.maximum(n_unique, nu)
         mesh, s_23 = swap.swap_23(mesh, edges, emask)
         mesh = compact(mesh)
-        edges, emask, t2e, _ = adjacency.unique_edges(mesh, ecap)
+        edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
+        n_unique = jnp.maximum(n_unique, nu)
         nswap = s_32.nswap32 + s_23.nswap23
     else:
         nswap = jnp.int32(0)
@@ -119,13 +123,13 @@ def prepare_metric(mesh: Mesh, opts: AdaptOptions, ecap: int) -> Mesh:
         met = metric_mod.constant_iso_metric(
             mesh.pcap, opts.hsiz, mesh.dtype
         )
-    elif is_iso and (opts.optim or bool(jnp.all(met == 1.0))):
-        # unset metric defaults to the implied sizes (like -optim)
+    elif is_iso and (opts.optim or not mesh.met_set):
+        # no prescribed metric: default to the implied sizes (like -optim)
         met = metric_mod.implied_iso_metric(
             mesh.vert, mesh.tet, mesh.tmask, mesh.pcap
         ).astype(mesh.dtype)
     met = metric_mod.apply_hbounds(met, opts.hmin, opts.hmax)
-    mesh = mesh.replace(met=met)
+    mesh = mesh.replace(met=met, met_set=True)
     if opts.hgrad is not None and met.shape[1] == 1:
         edges, emask, _, _ = adjacency.unique_edges(mesh, ecap)
         met = metric_mod.gradate_iso(
@@ -186,7 +190,10 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     single-shard skeleton that `PMMG_parmmglib1` wraps with migration and
     interpolation in the distributed driver."""
     opts = opts or AdaptOptions()
-    ecap_of = lambda m: int(m.tcap * 1.6) + 64
+    # unique-edge capacity multiplier: ~1.19 edges/tet asymptotically, but
+    # pathological meshes can exceed 1.6x — grown on overflow (see below)
+    emult = [1.6]
+    ecap_of = lambda m: int(m.tcap * emult[0]) + 64
 
     mesh = ensure_capacity(mesh, opts)
     mesh = analysis.analyze(mesh)
@@ -209,13 +216,22 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     for it in range(opts.niter):
         for sweep in range(opts.max_sweeps):
             mesh = ensure_capacity(mesh, opts)
+            ecap = ecap_of(mesh)
             mesh, st = remesh_sweep(
                 mesh,
-                ecap_of(mesh),
+                ecap,
                 noinsert=opts.noinsert,
                 noswap=opts.noswap,
                 nomove=opts.nomove,
             )
+            overflow = int(st.n_unique) > ecap
+            if overflow:
+                # unique_edges dropped overflow edges this sweep (its
+                # documented contract): grow the cap and redo coverage
+                emult[0] = max(
+                    emult[0] * 1.5,
+                    1.1 * int(st.n_unique) / max(int(mesh.tcap), 1),
+                )
             rec = dict(
                 iter=it,
                 sweep=sweep,
@@ -235,8 +251,10 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
                     f"{rec['nmoved']} moved -> ne={rec['ne']}"
                 )
             nops = rec["nsplit"] + rec["ncollapse"] + rec["nswap"]
-            if not rec["capped"] and nops <= opts.converge_frac * max(
-                rec["ne"], 1
+            if (
+                not rec["capped"]
+                and not overflow
+                and nops <= opts.converge_frac * max(rec["ne"], 1)
             ):
                 break
 

@@ -31,17 +31,23 @@ def two_phase_winners(
     gather_arena(arena_values) -> [N]: per candidate, max over its cells.
 
     Phase 1 maxes the float priority per arena cell; phase 2 breaks exact
-    float ties by candidate index. Returns [N] bool winners — candidates
-    that are the unique argmax in every arena cell they touch.
+    float ties by candidate index, compared in two 12-bit halves so indices
+    stay exactly representable in float32 (a single float32 cast collides
+    above 2^24 candidates — routine at TPU mesh scale). Returns [N] bool
+    winners — candidates that are the unique argmax in every arena cell
+    they touch.
     """
     n = prio.shape[0]
     p = jnp.where(cand, prio, -jnp.inf)
     best = gather_arena(scatter_arena(p))
     is_top = cand & (p >= best) & jnp.isfinite(p)
     idx = jnp.arange(n, dtype=jnp.int32)
-    idx_p = jnp.where(is_top, idx, -1)
-    best_idx = gather_arena(scatter_arena(idx_p.astype(jnp.float32)))
-    return is_top & (idx.astype(jnp.float32) >= best_idx)
+    hi = (idx >> 12).astype(jnp.float32)
+    best_hi = gather_arena(scatter_arena(jnp.where(is_top, hi, -1.0)))
+    is_top = is_top & (hi >= best_hi)
+    lo = (idx & 0xFFF).astype(jnp.float32)
+    best_lo = gather_arena(scatter_arena(jnp.where(is_top, lo, -1.0)))
+    return is_top & (lo >= best_lo)
 
 
 def _run_match(keys: jax.Array, query: jax.Array):
@@ -96,16 +102,17 @@ def match_rows(keys: jax.Array, query: jax.Array) -> jax.Array:
     return idx
 
 
-def tria_edge_keys(mesh: Mesh) -> jax.Array:
-    """[3*FC, 2] canonically sorted (lo,hi) vertex pairs of all valid tria
-    edges; dead trias give (-1,-1) rows."""
+def tria_edge_keys(mesh: Mesh, mask: jax.Array | None = None) -> jax.Array:
+    """[3*FC, 2] canonically sorted (lo,hi) vertex pairs of tria edges
+    (valid trias by default, or only those selected by `mask`); excluded
+    trias give (-1,-1) rows."""
     t = mesh.tria
     pairs = jnp.stack(
         [t[:, [0, 1]], t[:, [1, 2]], t[:, [0, 2]]], axis=1
     )  # [FC,3,2]
     lo = jnp.minimum(pairs[..., 0], pairs[..., 1])
     hi = jnp.maximum(pairs[..., 0], pairs[..., 1])
-    dead = ~mesh.trmask[:, None]
+    dead = ~(mesh.trmask if mask is None else mask)[:, None]
     lo = jnp.where(dead, -1, lo).reshape(-1)
     hi = jnp.where(dead, -1, hi).reshape(-1)
     return jnp.stack([lo, hi], axis=1)

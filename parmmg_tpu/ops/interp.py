@@ -54,12 +54,20 @@ def interp_metrics_and_fields(
     Vertices tagged REQUIRED keep their current values. Returns the updated
     mesh and the location result (for search statistics / diagnostics).
     """
+    for name in ("met", "ls", "disp", "fields"):
+        cn, co = getattr(new, name).shape[1], getattr(old, name).shape[1]
+        if cn != co:
+            raise ValueError(
+                f"solution family mismatch: new.{name} has {cn} components, "
+                f"old.{name} has {co} — the meshes must carry the same "
+                "metric/sol types (the reference errors likewise)"
+            )
     res = locate.locate_points(old, new.vert, max_steps=max_steps)
     met_q, ls_q, disp_q, f_q = interp_at(old, res.tet, res.bary)
     keep = (~new.vmask) | ((new.vtag & tags.REQUIRED) != 0)
 
     def sel(cur, q):
-        if cur.shape[1] == 0 or q.shape[-1] != cur.shape[1]:
+        if cur.shape[1] == 0:
             return cur
         return jnp.where(keep[:, None], cur, q.astype(cur.dtype))
 
@@ -69,6 +77,7 @@ def interp_metrics_and_fields(
             ls=sel(new.ls, ls_q),
             disp=sel(new.disp, disp_q),
             fields=sel(new.fields, f_q),
+            met_set=old.met_set,
         ),
         res,
     )

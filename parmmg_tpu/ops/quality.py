@@ -64,10 +64,13 @@ class QualityHisto:
     qmin: jax.Array
     qmax: jax.Array
     qavg: jax.Array
-    worst_elt: jax.Array  # slot id of the worst element (local to shard)
+    worst_elt: jax.Array  # slot id of the worst element (local to its shard)
     nbad: jax.Array       # count with q < BADQUAL
     ninverted: jax.Array  # count with q <= 0
     counts: jax.Array     # [nbins] histogram over (0,1], bin k = [k/n,(k+1)/n)
+    worst_shard: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.int32(-1)
+    )  # shard owning worst_elt after reduce (-1 = unreduced/single shard)
 
 
 def quality_histogram(mesh: Mesh, nbins: int = 5) -> QualityHisto:
@@ -91,24 +94,31 @@ def quality_histogram(mesh: Mesh, nbins: int = 5) -> QualityHisto:
 def reduce_histograms(h: QualityHisto, axis_name: str) -> QualityHisto:
     """Cross-shard reduction of per-shard histograms (inside shard_map),
     replacing the reference's custom MPI_Op argmin-with-location reduce
-    (`PMMG_min_iel_compute`, reference `src/quality_pmmg.c:82`): worst_elt
-    becomes `shard * BIG + local_elt` of the globally worst element."""
+    (`PMMG_min_iel_compute`, reference `src/quality_pmmg.c:82`): after the
+    reduce, (worst_shard, worst_elt) identify the globally worst element
+    by shard id and that shard's local slot id."""
     ne = jax.lax.psum(h.ne, axis_name)
     qmin = jax.lax.pmin(h.qmin, axis_name)
     qmax = jax.lax.pmax(h.qmax, axis_name)
     qavg = jax.lax.psum(h.qavg * h.ne.astype(h.qavg.dtype), axis_name) / jnp.maximum(
         ne, 1
     ).astype(h.qavg.dtype)
-    # argmin-with-location: only shards holding the global min vote
+    # argmin-with-location, exact: only shards holding the global min vote
+    # for lowest shard id, then that shard's element id wins — no packed
+    # integer encoding (which would overflow at TPU-scale element counts)
     shard = jax.lax.axis_index(axis_name)
-    big = jnp.int64(2**31) if h.worst_elt.dtype == jnp.int64 else jnp.int32(2**20)
-    loc = shard.astype(h.worst_elt.dtype) * big + h.worst_elt
-    loc = jnp.where(h.qmin <= qmin, loc, jnp.iinfo(h.worst_elt.dtype).max)
-    worst = jax.lax.pmin(loc, axis_name)
+    imax = jnp.iinfo(jnp.int32).max
+    has = h.qmin <= qmin
+    worst_shard = jax.lax.pmin(jnp.where(has, shard, imax), axis_name)
+    worst = jax.lax.pmin(
+        jnp.where(shard == worst_shard, h.worst_elt, imax), axis_name
+    )
     nbad = jax.lax.psum(h.nbad, axis_name)
     ninv = jax.lax.psum(h.ninverted, axis_name)
     counts = jax.lax.psum(h.counts, axis_name)
-    return QualityHisto(ne, qmin, qmax, qavg, worst, nbad, ninv, counts)
+    return QualityHisto(
+        ne, qmin, qmax, qavg, worst, nbad, ninv, counts, worst_shard
+    )
 
 
 def format_histogram(h: QualityHisto, label: str = "MESH QUALITY") -> str:
@@ -119,7 +129,8 @@ def format_histogram(h: QualityHisto, label: str = "MESH QUALITY") -> str:
     lines = [
         f"  -- {label}  {int(h.ne)} elements",
         f"     BEST {float(h.qmax):8.6f}  AVRG {float(h.qavg):8.6f} "
-        f" WRST {float(h.qmin):8.6f} (elt {int(h.worst_elt)})",
+        f" WRST {float(h.qmin):8.6f} (elt {int(h.worst_elt)}"
+        + (f" on shard {int(h.worst_shard)})" if int(h.worst_shard) >= 0 else ")"),
     ]
     ne = max(int(h.ne), 1)
     for k in reversed(range(n)):

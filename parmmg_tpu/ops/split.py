@@ -62,9 +62,16 @@ def split_long_edges(
     surf = common.surface_edge_mask(mesh, edges, emask)
     feat = common.feature_edge_index(mesh, edges, emask)
     feat_tag = jnp.where(feat >= 0, mesh.edtag[feat], 0)
+    # edges of REQUIRED triangles are frozen too, not just required feature
+    # edges (RequiredTriangles discipline, reference src/tag_pmmg.c)
+    req_tri = mesh.trmask & ((mesh.trtag & tags.REQUIRED) != 0)
+    in_req_tri = common.sorted_membership(
+        common.tria_edge_keys(mesh, mask=req_tri),
+        jnp.where(emask[:, None], edges, -1),
+    )
     frozen = (
         ((mesh.vtag[a] & tags.PARBDY) != 0) & ((mesh.vtag[b] & tags.PARBDY) != 0)
-    ) | ((feat_tag & tags.REQUIRED) != 0)
+    ) | ((feat_tag & tags.REQUIRED) != 0) | in_req_tri
     cand = emask & (l > llong) & ~frozen
     ncand = jnp.sum(cand.astype(jnp.int32))
 
@@ -182,12 +189,13 @@ def split_long_edges(
     # --- split feature edges ----------------------------------------------
     ehas = win & (feat >= 0)
     fidx = jnp.where(ehas, feat, mesh.ecap).astype(jnp.int32)
-    # in place: (a,b) -> (a,newv)
+    # use the stored row's own endpoint order (rows are not canonically
+    # sorted): in place (r0,r1) -> (r0,newv), append (newv,r1)
+    r1 = mesh.edge[jnp.maximum(feat, 0), 1]
     edge_arr = mesh.edge.at[fidx, 1].set(vnew, mode="drop")
-    # append (newv, b)
     erank = jnp.cumsum(ehas.astype(jnp.int32)) - 1
     tgt_e = jnp.where(ehas, ned0 + erank, mesh.ecap).astype(jnp.int32)
-    newrow = jnp.stack([vnew, b], axis=1)
+    newrow = jnp.stack([vnew, r1], axis=1)
     edge_arr = edge_arr.at[tgt_e].set(newrow, mode="drop")
     edref = mesh.edref.at[tgt_e].set(
         jnp.where(feat >= 0, mesh.edref[jnp.maximum(feat, 0)], 0), mode="drop"

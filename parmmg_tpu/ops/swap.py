@@ -139,7 +139,6 @@ def swap_32(
 
     # --- arena = the 3 shell tets -----------------------------------------
     def scatter_arena(vals):
-        out = jnp.full(tcap, -jnp.inf, vals.dtype)
         v6 = jnp.where(live_e, vals[safe_t2e], -jnp.inf)
         return jnp.max(v6, axis=1)
 

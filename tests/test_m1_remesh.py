@@ -173,3 +173,59 @@ def test_adapt_noinsert_nomove(cube_mesh_path):
     # no insertion, no move, no swap, nothing to collapse: mesh unchanged
     assert int(m2.ntet) == 12
     assert int(m2.npoin) == 12
+
+
+def test_split_feature_edge_reversed_rows(cube_mesh_path):
+    """Feature edges stored as (hi, lo) must split into both halves
+    (regression: the append used the canonical hi endpoint instead of the
+    stored row's own second vertex)."""
+    m = load_cube(cube_mesh_path, hsiz=0.2)
+    # pick a real tet edge and store it hi-before-lo as a feature edge
+    e, em, t2e, _ = edges_of(m)
+    eid = int(np.nonzero(np.asarray(em))[0][0])
+    a, b = (int(v) for v in np.asarray(e)[eid])
+    ed = np.asarray(m.edge).copy()
+    edm = np.asarray(m.edmask).copy()
+    edt = np.asarray(m.edtag).copy()
+    ed[0] = (b, a)  # reversed storage order
+    edm[0] = True
+    edt[0] = tags.RIDGE
+    m = m.replace(
+        edge=jnp.asarray(ed), edmask=jnp.asarray(edm), edtag=jnp.asarray(edt)
+    )
+    # the feature edge must win its arena eventually (longer diagonals
+    # split first) — 15 sweeps is plenty for the cube at hsiz=0.2
+    for _ in range(15):
+        m = compact(m)
+        e, em, t2e, _ = edges_of(m)
+        m, st = split.split_long_edges(m, e, em, t2e)
+        if int(m.nedge) > 1:
+            break
+    ed2 = np.asarray(m.edge)[np.asarray(m.edmask)]
+    assert len(ed2) >= 2
+    # the halves must still cover both original endpoints and chain
+    # through shared midpoints (connectivity of the feature line)
+    ends = ed2.reshape(-1).tolist()
+    assert a in ends and b in ends
+    from collections import Counter
+
+    deg = Counter(ends)
+    odd = [v for v, d in deg.items() if d % 2 == 1]
+    assert sorted(odd) == sorted([a, b])  # a simple path from a to b
+
+
+def test_split_respects_required_triangles(cube_mesh_path):
+    """Edges of REQUIRED triangles are frozen even without a required
+    feature edge covering them (RequiredTriangles discipline)."""
+    m = load_cube(cube_mesh_path, hsiz=0.2)
+    m = m.replace(
+        trtag=jnp.where(m.trmask, m.trtag | tags.REQUIRED, m.trtag)
+    )
+    tria0 = np.asarray(m.tria)[np.asarray(m.trmask)]
+    e, em, t2e, _ = edges_of(m)
+    m2, st = split.split_long_edges(m, e, em, t2e)
+    # interior edges may split, but every original boundary tria survives
+    tria2 = np.asarray(m2.tria)[np.asarray(m2.trmask)]
+    s0 = {tuple(sorted(t)) for t in tria0.tolist()}
+    s2 = {tuple(sorted(t)) for t in tria2.tolist()}
+    assert s0 == s2
