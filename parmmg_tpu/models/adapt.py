@@ -131,7 +131,13 @@ def prepare_metric(mesh: Mesh, opts: AdaptOptions, ecap: int) -> Mesh:
     met = metric_mod.apply_hbounds(met, opts.hmin, opts.hmax)
     mesh = mesh.replace(met=met, met_set=True)
     if opts.hgrad is not None and met.shape[1] == 1:
-        edges, emask, _, _ = adjacency.unique_edges(mesh, ecap)
+        # honor unique_edges' overflow contract: retry with a larger cap
+        # so gradation sees every edge
+        while True:
+            edges, emask, _, nu = adjacency.unique_edges(mesh, ecap)
+            if int(nu) <= ecap:
+                break
+            ecap = int(int(nu) * 1.1) + 64
         met = metric_mod.gradate_iso(
             mesh.vert, mesh.met, edges, emask, hgrad=opts.hgrad
         )
@@ -214,7 +220,9 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
 
     history: List[dict] = []
     for it in range(opts.niter):
-        for sweep in range(opts.max_sweeps):
+        sweep = 0
+        budget = opts.max_sweeps
+        while sweep < budget:
             mesh = ensure_capacity(mesh, opts)
             ecap = ecap_of(mesh)
             mesh, st = remesh_sweep(
@@ -227,11 +235,15 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
             overflow = int(st.n_unique) > ecap
             if overflow:
                 # unique_edges dropped overflow edges this sweep (its
-                # documented contract): grow the cap and redo coverage
+                # documented contract): grow the cap and redo coverage —
+                # including when the overflow lands on the last budgeted
+                # sweep (bounded extension so it cannot loop forever)
                 emult[0] = max(
                     emult[0] * 1.5,
                     1.1 * int(st.n_unique) / max(int(mesh.tcap), 1),
                 )
+                if budget < opts.max_sweeps + 4:
+                    budget += 1
             rec = dict(
                 iter=it,
                 sweep=sweep,
@@ -257,6 +269,7 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
                 and nops <= opts.converge_frac * max(rec["ne"], 1)
             ):
                 break
+            sweep += 1
 
     mesh = compact(mesh)
     h1 = quality.quality_histogram(mesh)

@@ -59,15 +59,27 @@ def split_long_edges(
         mesh.vert[a], mesh.vert[b], mesh.met[a], mesh.met[b]
     )
 
-    surf = common.surface_edge_mask(mesh, edges, emask)
+    # one sort-merge pass maps every tria edge to its unique-edge slot;
+    # surface / required-tria masks and the tria-split step below all
+    # derive from it (keeps the hot path at a single tria-edge match)
+    fcap = mesh.fcap
+    edge_keys = jnp.where(emask[:, None], edges, -1)
+    tri_keys = common.tria_edge_keys(mesh)  # [3*FC, 2], pair order 01,12,02
+    eid3 = common.match_rows(edge_keys, tri_keys).reshape(fcap, 3)
+
+    def mark_edges(tri_mask):
+        tgt = jnp.where(tri_mask[:, None] & (eid3 >= 0), eid3, ecap)
+        return (
+            jnp.zeros(ecap, bool).at[tgt.reshape(-1)].set(True, mode="drop")
+        )
+
+    surf = mark_edges(mesh.trmask)
     feat = common.feature_edge_index(mesh, edges, emask)
     feat_tag = jnp.where(feat >= 0, mesh.edtag[feat], 0)
     # edges of REQUIRED triangles are frozen too, not just required feature
     # edges (RequiredTriangles discipline, reference src/tag_pmmg.c)
-    req_tri = mesh.trmask & ((mesh.trtag & tags.REQUIRED) != 0)
-    in_req_tri = common.sorted_membership(
-        common.tria_edge_keys(mesh, mask=req_tri),
-        jnp.where(emask[:, None], edges, -1),
+    in_req_tri = mark_edges(
+        mesh.trmask & ((mesh.trtag & tags.REQUIRED) != 0)
     )
     frozen = (
         ((mesh.vtag[a] & tags.PARBDY) != 0) & ((mesh.vtag[b] & tags.PARBDY) != 0)
@@ -161,11 +173,7 @@ def split_long_edges(
     tref = mesh.tref.at[tgt_t].set(mesh.tref, mode="drop")
     tmask = mesh.tmask.at[tgt_t].set(has, mode="drop")
 
-    # --- split trias -------------------------------------------------------
-    fcap = mesh.fcap
-    edge_keys = jnp.where(emask[:, None], edges, -1)
-    tri_keys = common.tria_edge_keys(mesh)  # [3*FC, 2], pair order 01,12,02
-    eid3 = common.match_rows(edge_keys, tri_keys).reshape(fcap, 3)
+    # --- split trias (reuses eid3 from candidate selection) ---------------
     w3 = (eid3 >= 0) & win[jnp.maximum(eid3, 0)] & mesh.trmask[:, None]
     fhas = jnp.any(w3, axis=1)
     fk = jnp.argmax(w3, axis=1)

@@ -1,0 +1,370 @@
+"""Initial mesh distribution: split a centralized mesh into device shards.
+
+Host-side counterpart of the reference's centralized scatter
+(`src/distributemesh_pmmg.c`: `PMMG_distribute_mesh:1109` — bcast, metis
+partition, `PMMG_mark_localMesh:506`, `PMMG_permuteMesh:445`,
+`PMMG_create_communicators:739`). Here the mesh lives in host numpy once
+(I/O side), is cut by a partition array, and becomes a stacked device
+pytree of per-shard Meshes (leading axis = shard) plus a static
+communicator index table.
+
+Communicator model (reference `src/libparmmgtypes.h:249-307` re-expressed):
+the internal/external communicator pair becomes ONE static gather table
+`comm_idx[s, r, k]` = local vertex slot, in shard s, of the k-th vertex
+shared between shards s and r (ordered by global id, so slot k on both
+sides names the same physical vertex; -1 pads). Halo exchange is then a
+pure `all_to_all` + masked scatter (`parallel/comm.py`) — no tags, no
+pack/unpack, no MPI datatypes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import adjacency, tags
+from ..core.mesh import FACE_VERTS, Mesh
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShardComm:
+    """Static node-communicator tables for a D-shard mesh."""
+
+    comm_idx: jax.Array   # [D, D, I] local vertex slot of k-th shared
+    #                       vertex with the other shard, -1 pad
+    counts: jax.Array     # [D, D] int32 number of shared vertices per pair
+    l2g: jax.Array        # [D, PC] int32 global vertex id per local slot
+    #                       (-1 on dead slots)
+    owner: jax.Array      # [D, PC] bool: this shard owns the vertex (the
+    #                       lowest-id shard sharing it) — dedup for
+    #                       reductions, reference PMMG_count_nodes_par role
+
+    @property
+    def nshard(self) -> int:
+        return self.comm_idx.shape[0]
+
+    @property
+    def icap(self) -> int:
+        return self.comm_idx.shape[2]
+
+
+def split_mesh(
+    mesh: Mesh, part: np.ndarray, nparts: int, headroom: float = 1.5
+) -> Tuple[Mesh, ShardComm]:
+    """Split a host/device Mesh into `nparts` shards per tet partition.
+
+    Returns (stacked Mesh with leading shard axis, ShardComm). Vertices on
+    inter-shard interfaces are tagged PARBDY in every shard that holds
+    them (freeze discipline, reference `src/tag_pmmg.c:267`); boundary
+    trias follow the shard of their adjacent tet; feature edges replicate
+    into every shard containing both endpoints.
+    """
+    mesh = adjacency.build_adjacency(mesh)
+    part = np.asarray(part)
+    tmask = np.asarray(mesh.tmask)
+    adja = np.asarray(mesh.adja)
+    tet = np.asarray(mesh.tet)
+    vert = np.asarray(mesh.vert)
+    vref_g = np.asarray(mesh.vref)
+    vtag_g = np.asarray(mesh.vtag)
+    tref_g = np.asarray(mesh.tref)
+    met_g = np.asarray(mesh.met)
+    ls_g = np.asarray(mesh.ls)
+    disp_g = np.asarray(mesh.disp)
+    fields_g = np.asarray(mesh.fields)
+    tria = np.asarray(mesh.tria)
+    trmask = np.asarray(mesh.trmask)
+    trref_g = np.asarray(mesh.trref)
+    trtag_g = np.asarray(mesh.trtag)
+    edge = np.asarray(mesh.edge)
+    edmask = np.asarray(mesh.edmask)
+    edref_g = np.asarray(mesh.edref)
+    edtag_g = np.asarray(mesh.edtag)
+
+    live_t = np.nonzero(tmask)[0]
+    if (part[live_t] < 0).any() or (part[live_t] >= nparts).any():
+        raise ValueError("partition must assign every valid tet to a shard")
+
+    # --- interface vertices: shards-per-vertex incidence (vectorized) ------
+    npcap = vert.shape[0]
+    pairs = np.unique(
+        np.stack(
+            [tet[live_t].ravel(), np.repeat(part[live_t], 4)], axis=1
+        ),
+        axis=0,
+    )
+    v_nshards = np.bincount(pairs[:, 0], minlength=npcap)
+    v_owner = np.full(npcap, nparts, np.int64)
+    np.minimum.at(v_owner, pairs[:, 0], pairs[:, 1])
+
+    # --- tria -> owning tet shard (boundary faces have a unique tet) -------
+    fv = tet[:, np.asarray(FACE_VERTS)].reshape(-1, 3)
+    fkey = np.sort(fv, axis=1)
+    ftet = np.repeat(np.arange(tet.shape[0]), 4)
+    fvalid = np.repeat(tmask, 4)
+
+    tria_live = np.nonzero(trmask)[0]
+    tkey = np.sort(tria[tria_live], axis=1)
+
+    # row-wise unique matching (no bit packing: immune to vertex counts
+    # beyond any fixed field width)
+    vsel = np.nonzero(fvalid)[0]
+    fk = fkey[vsel]
+    allrows = np.concatenate([fk, tkey]) if len(tkey) else fk
+    _, inv = np.unique(allrows, axis=0, return_inverse=True)
+    fid, qid = inv[: len(fk)], inv[len(fk):]
+    face_tet = np.full(inv.max() + 1 if len(inv) else 1, -1, np.int64)
+    face_tet[fid] = ftet[vsel]
+    tria_shard = np.full(tria.shape[0], -1)
+    if len(tkey):
+        hit = face_tet[qid] >= 0
+        if not hit.all():
+            bad = tria_live[~hit][:5]
+            raise ValueError(f"boundary trias {bad} match no valid tet face")
+        tria_shard[tria_live] = part[face_tet[qid]]
+
+    # --- interface faces become PARBDY triangles in each side shard --------
+    # (the reference materializes parallel faces as MG_PARBDY boundary
+    # triangles per group so the remesher treats them as frozen surface;
+    # src/tag_pmmg.c:267 discipline)
+    nb = adja // 4
+    ifc_mask = (adja >= 0) & tmask[:, None]
+    ifc_mask &= part[np.maximum(nb, 0)] != part[:, None]
+    ifc_t, ifc_f = np.nonzero(ifc_mask)
+    ifc_verts = tet[ifc_t[:, None], np.asarray(FACE_VERTS)[ifc_f]]  # [K,3]
+    ifc_shard = part[ifc_t]
+    IFC_TAG = tags.PARBDY | tags.REQUIRED | tags.NOSURF | tags.BDY
+
+    # --- per-shard extraction ---------------------------------------------
+    shard_data = []
+    for s in range(nparts):
+        t_ids = live_t[part[live_t] == s]
+        gids = np.unique(tet[t_ids])  # sorted: local order = gid order
+        ltet = np.searchsorted(gids, tet[t_ids])
+        f_ids = np.nonzero(tria_shard == s)[0]
+        own_ifc = ifc_verts[ifc_shard == s]
+        ltria = np.concatenate(
+            [
+                np.searchsorted(gids, tria[f_ids]).reshape(-1, 3),
+                np.searchsorted(gids, own_ifc).reshape(-1, 3),
+            ]
+        )
+        ltrref = np.concatenate(
+            [trref_g[f_ids], np.zeros(len(own_ifc), np.int64)]
+        )
+        ltrtag = np.concatenate(
+            [trtag_g[f_ids], np.full(len(own_ifc), IFC_TAG, np.int64)]
+        )
+        e_live = np.nonzero(edmask)[0]
+        in_s = np.isin(edge[e_live], gids).all(axis=1)
+        e_keep = e_live[in_s]
+        ledge = (
+            np.searchsorted(gids, edge[e_keep])
+            if len(e_keep)
+            else np.zeros((0, 2), np.int64)
+        )
+        # PARBDY: vertices seen by more than one shard
+        lvtag = vtag_g[gids].copy()
+        par = v_nshards[gids] > 1
+        lvtag[par] |= tags.PARBDY
+        lvtag[par & ((lvtag & tags.BDY) != 0)] |= tags.PARBDYBDY
+        shard_data.append(
+            dict(
+                gids=gids,
+                verts=vert[gids],
+                vrefs=vref_g[gids],
+                vtags=lvtag,
+                tets=ltet,
+                trefs=tref_g[t_ids],
+                trias=ltria,
+                trrefs=ltrref,
+                trtags=ltrtag,
+                edges=ledge,
+                edrefs=edref_g[e_keep],
+                edtags=edtag_g[e_keep],
+                met=met_g[gids],
+                ls=ls_g[gids] if ls_g.shape[1] else None,
+                disp=disp_g[gids] if disp_g.shape[1] else None,
+                fields=fields_g[gids] if fields_g.shape[1] else None,
+            )
+        )
+
+    # --- uniform capacities ------------------------------------------------
+    def cap(n):
+        return max(8, int(np.ceil(n * headroom)))
+
+    pcap = cap(max(len(d["gids"]) for d in shard_data))
+    tcap = cap(max(len(d["tets"]) for d in shard_data))
+    fcap = cap(max(max(len(d["trias"]), 1) for d in shard_data))
+    ecap = cap(max(max(len(d["edges"]), 1) for d in shard_data))
+
+    meshes = [
+        Mesh.from_numpy(
+            d["verts"],
+            d["tets"],
+            vrefs=d["vrefs"],
+            vtags=d["vtags"],
+            trefs=d["trefs"],
+            trias=d["trias"],
+            trrefs=d["trrefs"],
+            trtags=d["trtags"],
+            edges=d["edges"],
+            edrefs=d["edrefs"],
+            edtags=d["edtags"],
+            met=d["met"] if mesh.met_set else None,
+            ls=d["ls"],
+            disp=d["disp"],
+            fields=d["fields"],
+            field_ncomp=mesh.field_ncomp,
+            pcap=pcap,
+            tcap=tcap,
+            fcap=fcap,
+            ecap=ecap,
+            dtype=mesh.dtype,
+        )
+        for d in shard_data
+    ]
+    meshes = [adjacency.build_adjacency(m) for m in meshes]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *meshes
+    )
+
+    # --- communicator tables ----------------------------------------------
+    pair_shared: dict = {}
+    icap = 1
+    for s in range(nparts):
+        for r in range(s + 1, nparts):
+            shared = np.intersect1d(
+                shard_data[s]["gids"], shard_data[r]["gids"]
+            )  # sorted by gid -> identical order both sides
+            if len(shared):
+                pair_shared[(s, r)] = shared
+                icap = max(icap, len(shared))
+
+    comm_idx = np.full((nparts, nparts, icap), -1, np.int32)
+    counts = np.zeros((nparts, nparts), np.int32)
+    for (s, r), shared in pair_shared.items():
+        ls_idx = np.searchsorted(shard_data[s]["gids"], shared)
+        lr_idx = np.searchsorted(shard_data[r]["gids"], shared)
+        comm_idx[s, r, : len(shared)] = ls_idx
+        comm_idx[r, s, : len(shared)] = lr_idx
+        counts[s, r] = counts[r, s] = len(shared)
+
+    l2g = np.full((nparts, pcap), -1, np.int32)
+    owner = np.zeros((nparts, pcap), bool)
+    for s, d in enumerate(shard_data):
+        n = len(d["gids"])
+        l2g[s, :n] = d["gids"]
+        owner[s, :n] = v_owner[d["gids"]] == s
+
+    comm = ShardComm(
+        comm_idx=jnp.asarray(comm_idx),
+        counts=jnp.asarray(counts),
+        l2g=jnp.asarray(l2g),
+        owner=jnp.asarray(owner),
+    )
+    return stacked, comm
+
+
+def unstack_mesh(stacked: Mesh) -> List[Mesh]:
+    """Stacked [D,...] Mesh -> list of per-shard host Meshes."""
+    d = stacked.vert.shape[0]
+    return [
+        jax.tree_util.tree_map(lambda a: a[s], stacked) for s in range(d)
+    ]
+
+
+def merge_shards(stacked: Mesh, comm: ShardComm) -> Mesh:
+    """Gather all shards into one centralized host Mesh, deduplicating
+    interface vertices by global id (the reference's
+    `PMMG_merge_parmesh:1571` / `PMMG_mergeParmesh_rcvParMeshes` matched
+    shared nodes via int-comm indices; global ids make this a plain
+    scatter)."""
+    parts = unstack_mesh(stacked)
+    l2g = np.asarray(comm.l2g)
+    nglob = int(l2g.max()) + 1
+    vert = np.zeros((nglob, 3), np.asarray(parts[0].vert).dtype)
+    vref = np.zeros(nglob, np.int32)
+    vtag = np.zeros(nglob, np.int32)
+    met = np.zeros((nglob, parts[0].met.shape[1]), vert.dtype)
+    ls = np.zeros((nglob, parts[0].ls.shape[1]), vert.dtype)
+    disp = np.zeros((nglob, parts[0].disp.shape[1]), vert.dtype)
+    fields = np.zeros((nglob, parts[0].fields.shape[1]), vert.dtype)
+    seen = np.zeros(nglob, bool)
+    all_tets, all_trefs, all_trias, all_trrefs, all_trtags = [], [], [], [], []
+    all_edges, all_edrefs, all_edtags = [], [], []
+    for s, m in enumerate(parts):
+        vm = np.asarray(m.vmask)
+        g = l2g[s]
+        valid = vm & (g >= 0)
+        gi = g[valid]
+        vert[gi] = np.asarray(m.vert)[valid]
+        vref[gi] = np.asarray(m.vref)[valid]
+        # drop the interface bookkeeping bits when centralizing
+        vtag[gi] = np.asarray(m.vtag)[valid] & ~(
+            tags.PARBDY | tags.PARBDYBDY | tags.OLDPARBDY
+        )
+        met[gi] = np.asarray(m.met)[valid]
+        if ls.shape[1]:
+            ls[gi] = np.asarray(m.ls)[valid]
+        if disp.shape[1]:
+            disp[gi] = np.asarray(m.disp)[valid]
+        if fields.shape[1]:
+            fields[gi] = np.asarray(m.fields)[valid]
+        seen[gi] = True
+        tm = np.asarray(m.tmask)
+        all_tets.append(g[np.asarray(m.tet)[tm]])
+        all_trefs.append(np.asarray(m.tref)[tm])
+        # drop pure-parallel interface trias (PARBDY+NOSURF): they are
+        # interior faces of the centralized mesh, not real boundary
+        trtag_s = np.asarray(m.trtag)
+        pure_par = ((trtag_s & tags.PARBDY) != 0) & (
+            (trtag_s & tags.NOSURF) != 0
+        )
+        fm = np.asarray(m.trmask) & ~pure_par
+        all_trias.append(g[np.asarray(m.tria)[fm]])
+        all_trrefs.append(np.asarray(m.trref)[fm])
+        all_trtags.append(
+            trtag_s[fm]
+            & ~(tags.PARBDY | tags.PARBDYBDY | tags.NOSURF)
+        )
+        em = np.asarray(m.edmask)
+        all_edges.append(g[np.asarray(m.edge)[em]])
+        all_edrefs.append(np.asarray(m.edref)[em])
+        all_edtags.append(np.asarray(m.edtag)[em])
+    if not seen.all():
+        raise ValueError("merge: some global vertex ids were never filled")
+    edges = np.concatenate(all_edges) if all_edges else np.zeros((0, 2), int)
+    # dedup replicated feature edges
+    if len(edges):
+        ek = np.sort(edges, axis=1)
+        _, uniq = np.unique(ek, axis=0, return_index=True)
+        edges = edges[uniq]
+        edrefs = np.concatenate(all_edrefs)[uniq]
+        edtags = np.concatenate(all_edtags)[uniq]
+    else:
+        edrefs = edtags = np.zeros(0, int)
+    return Mesh.from_numpy(
+        vert,
+        np.concatenate(all_tets),
+        vrefs=vref,
+        vtags=vtag,
+        trefs=np.concatenate(all_trefs),
+        trias=np.concatenate(all_trias),
+        trrefs=np.concatenate(all_trrefs),
+        trtags=np.concatenate(all_trtags),
+        edges=edges,
+        edrefs=edrefs,
+        edtags=edtags,
+        met=met if parts[0].met_set else None,
+        ls=ls if ls.shape[1] else None,
+        disp=disp if disp.shape[1] else None,
+        fields=fields if fields.shape[1] else None,
+        field_ncomp=parts[0].field_ncomp,
+        dtype=parts[0].dtype,
+    )

@@ -1,0 +1,86 @@
+"""shard_map plumbing for the stacked per-shard mesh pytree.
+
+The reference's `PMMG_Grp` array-of-groups per rank becomes one stacked
+Mesh pytree with a leading shard axis, laid over a 1-D
+`jax.sharding.Mesh` of TPU devices; per-shard kernels run under
+`shard_map` and see a plain single-shard `Mesh` (SURVEY.md §7 "group =
+shard"). Multi-host scaling rides the same code path: the device mesh
+spans hosts and XLA routes the all_to_all over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh as DeviceMesh, NamedSharding, PartitionSpec as P
+
+from ..core.mesh import Mesh
+from .distribute import ShardComm
+
+AXIS = "shards"
+
+
+def device_mesh(n: int | None = None) -> DeviceMesh:
+    devs = jax.devices()
+    n = n or len(devs)
+    return DeviceMesh(np.array(devs[:n]), (AXIS,))
+
+
+def put_sharded(tree, dmesh: DeviceMesh):
+    """Place a stacked [D,...] pytree with its leading axis split over the
+    device mesh."""
+    sh = NamedSharding(dmesh, P(AXIS))
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tree)
+
+
+def _squeeze(tree):
+    return jax.tree_util.tree_map(lambda a: a[0], tree)
+
+
+def _unsqueeze(tree):
+    return jax.tree_util.tree_map(lambda a: a[None], tree)
+
+
+def shard_fn(fn: Callable, dmesh: DeviceMesh, out_stacked: bool = True):
+    """Wrap `fn(mesh: Mesh, comm_idx [D,I]) -> pytree` so it runs per
+    shard under shard_map over the stacked mesh. Scalar/unsharded outputs
+    of `fn` must already be replicated (e.g. psum-reduced). For extra
+    per-call arguments, close over them in `fn`."""
+
+    def body(stacked_blk: Mesh, comm_idx_blk):
+        mesh = _squeeze(stacked_blk)
+        out = fn(mesh, comm_idx_blk[0])
+        return _unsqueeze(out) if out_stacked else out
+
+    spec = P(AXIS)
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=dmesh,
+            in_specs=(spec, spec),
+            out_specs=spec if out_stacked else P(),
+        )
+    )
+
+
+def sharded_quality_histogram(stacked: Mesh, dmesh: DeviceMesh):
+    """Distributed quality histogram: per-shard histogram + cross-shard
+    reduction (reference `PMMG_qualhisto`, `src/quality_pmmg.c:156` — the
+    custom MPI_Op becomes `reduce_histograms`' pmin/psum)."""
+    from ..ops import quality
+
+    def body(blk: Mesh):
+        m = _squeeze(blk)
+        h = quality.quality_histogram(m)
+        return quality.reduce_histograms(h, AXIS)
+
+    f = jax.jit(
+        jax.shard_map(
+            body, mesh=dmesh, in_specs=(P(AXIS),), out_specs=P()
+        )
+    )
+    return f(stacked)
