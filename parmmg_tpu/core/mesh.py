@@ -81,6 +81,11 @@ class Mesh:
     ls: jax.Array     # [PC, 0|1] level-set
     disp: jax.Array   # [PC, 0|3] displacement
     fields: jax.Array  # [PC, K] concatenated user fields
+    # global vertex id (-1 = no global identity yet, e.g. vertices created
+    # by remeshing before the next global-numbering pass). Carried inside
+    # the mesh so compaction renumbers it consistently — the role of the
+    # reference's global node numbering (src/libparmmg.c:923)
+    vglob: jax.Array = None  # [PC] int32
     field_ncomp: Tuple[int, ...] = dataclasses.field(
         default=(), metadata=dict(static=True)
     )
@@ -151,6 +156,7 @@ class Mesh:
         disp: np.ndarray | None = None,
         fields: np.ndarray | None = None,
         field_ncomp: Tuple[int, ...] = (),
+        vglob: np.ndarray | None = None,
         pcap: int | None = None,
         tcap: int | None = None,
         fcap: int | None = None,
@@ -223,6 +229,15 @@ class Mesh:
             ls=jnp.asarray(_pad2(ls_np, pc, 0.0), dtype),
             disp=jnp.asarray(_pad2(disp_np, pc, 0.0), dtype),
             fields=jnp.asarray(_pad2(f_np, pc, 0.0), dtype),
+            vglob=jnp.asarray(
+                _pad2(
+                    np.full(npo, -1, np.int32)
+                    if vglob is None
+                    else np.asarray(vglob, np.int32),
+                    pc,
+                    -1,
+                )
+            ),
             field_ncomp=tuple(field_ncomp),
             met_set=met is not None,
         )
@@ -265,6 +280,7 @@ class Mesh:
             ls=np.asarray(self.ls)[vmask],
             disp=np.asarray(self.disp)[vmask],
             fields=np.asarray(self.fields)[vmask],
+            vglob=np.asarray(self.vglob)[vmask],
             field_ncomp=self.field_ncomp,
         )
         return out
@@ -311,6 +327,7 @@ class Mesh:
             ls=grow(self.ls, pc, 0.0),
             disp=grow(self.disp, pc, 0.0),
             fields=grow(self.fields, pc, 0.0),
+            vglob=grow(self.vglob, pc, -1),
         )
 
     def replace(self, **kw) -> "Mesh":
@@ -388,6 +405,7 @@ def compact(mesh: Mesh) -> Mesh:
         ls=scat_v(mesh.ls, 0.0),
         disp=scat_v(mesh.disp, 0.0),
         fields=scat_v(mesh.fields, 0.0),
+        vglob=scat_v(mesh.vglob, -1),
         tet=tet,
         tmask=tmask,
         tref=tref,
