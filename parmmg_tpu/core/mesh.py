@@ -89,6 +89,16 @@ class Mesh:
     field_ncomp: Tuple[int, ...] = dataclasses.field(
         default=(), metadata=dict(static=True)
     )
+
+    def __post_init__(self):
+        # a None data leaf would give this pytree a different treedef than
+        # from_numpy-built meshes (None = empty subtree), silently breaking
+        # tree_map/stacking — fail fast instead
+        if self.vglob is None:
+            raise TypeError(
+                "Mesh.vglob is required (int32 [PC], -1 where unset); "
+                "build meshes via Mesh.from_numpy or pass vglob explicitly"
+            )
     # whether `met` holds a user-prescribed metric (vs. the all-ones fill);
     # an explicit flag, not value sniffing — a legitimate uniform h=1.0
     # metric must not be mistaken for "unset"

@@ -93,7 +93,7 @@ def walk_locate(
     pts: jax.Array,
     seeds: jax.Array,
     max_steps: int = 64,
-    eps: float = 1e-9,
+    eps: float | None = None,
 ) -> LocateResult:
     """Simultaneous adjacency walk for all query points.
 
@@ -103,6 +103,11 @@ def walk_locate(
     reference's `PMMG_locatePointVol` — until inside, blocked at a boundary
     face, or out of steps.
     """
+    if eps is None:
+        # dtype-relative inside-tolerance: barycoord noise is ~1e-6 relative
+        # in f32, so an absolute 1e-9 would misreport walk failures there
+        # (reference PMMG_locatePointInTetra uses a relative epsilon too)
+        eps = max(1e-9, 100.0 * float(jnp.finfo(pts.dtype).eps))
     q = pts.shape[0]
     zero = jnp.zeros(q, bool)
 

@@ -140,6 +140,27 @@ def split_mesh(
     ifc_shard = part[ifc_t]
     IFC_TAG = tags.PARBDY | tags.REQUIRED | tags.NOSURF | tags.BDY
 
+    # an input boundary tria can lie on an interior face that becomes an
+    # inter-shard interface (opnbdy meshes): reuse that tria's ref/tags on
+    # BOTH sides (PARBDYBDY discipline, reference src/tag_pmmg.c:646)
+    # instead of duplicating a synthetic NOSURF tria next to it
+    ifc_ref = np.zeros(len(ifc_verts), np.int64)
+    ifc_tag = np.full(len(ifc_verts), IFC_TAG, np.int64)
+    if len(tkey) and len(ifc_verts):
+        ifc_key = np.sort(ifc_verts, axis=1)
+        allr = np.concatenate([ifc_key, tkey])
+        _, inv2 = np.unique(allr, axis=0, return_inverse=True)
+        fkid, tqid = inv2[: len(ifc_key)], inv2[len(ifc_key):]
+        slot = np.full(inv2.max() + 1, -1, np.int64)
+        slot[tqid] = tria_live
+        hit = slot[fkid]
+        m = hit >= 0
+        ifc_ref[m] = trref_g[hit[m]]
+        ifc_tag[m] = trtag_g[hit[m]] | (
+            tags.PARBDY | tags.PARBDYBDY | tags.REQUIRED | tags.BDY
+        )
+        tria_shard[hit[m]] = -1  # replicated via the interface list instead
+
     # --- per-shard extraction ---------------------------------------------
     shard_data = []
     for s in range(nparts):
@@ -147,19 +168,16 @@ def split_mesh(
         gids = np.unique(tet[t_ids])  # sorted: local order = gid order
         ltet = np.searchsorted(gids, tet[t_ids])
         f_ids = np.nonzero(tria_shard == s)[0]
-        own_ifc = ifc_verts[ifc_shard == s]
+        sel_ifc = ifc_shard == s
+        own_ifc = ifc_verts[sel_ifc]
         ltria = np.concatenate(
             [
                 np.searchsorted(gids, tria[f_ids]).reshape(-1, 3),
                 np.searchsorted(gids, own_ifc).reshape(-1, 3),
             ]
         )
-        ltrref = np.concatenate(
-            [trref_g[f_ids], np.zeros(len(own_ifc), np.int64)]
-        )
-        ltrtag = np.concatenate(
-            [trtag_g[f_ids], np.full(len(own_ifc), IFC_TAG, np.int64)]
-        )
+        ltrref = np.concatenate([trref_g[f_ids], ifc_ref[sel_ifc]])
+        ltrtag = np.concatenate([trtag_g[f_ids], ifc_tag[sel_ifc]])
         e_live = np.nonzero(edmask)[0]
         in_s = np.isin(edge[e_live], gids).all(axis=1)
         e_keep = e_live[in_s]
@@ -221,6 +239,7 @@ def split_mesh(
             disp=d["disp"],
             fields=d["fields"],
             field_ncomp=mesh.field_ncomp,
+            vglob=d["gids"],
             pcap=pcap,
             tcap=tcap,
             fcap=fcap,
