@@ -1,0 +1,309 @@
+"""Distributed iterative remesh-repartition driver — the engine.
+
+TPU-native counterpart of the reference's core runtime
+(`PMMG_parmmglib1`, reference `src/libparmmg1.c:550-896`): the mesh is
+partitioned into shards, each shard's interior is remeshed with frozen
+(PARBDY) interfaces by batched operator sweeps, metrics/fields are
+re-interpolated from a pre-remesh snapshot, communicator tables are
+rebuilt, and interfaces are displaced so frozen bands become interior at
+the next iteration.
+
+Re-design notes (vs the reference's per-rank group loop):
+ - all shards share one set of static capacities, so the per-shard remesh
+   is ONE vmapped sweep over the leading shard axis — under `jit` with a
+   sharded leading axis every device remeshes its shard simultaneously
+   (the role of each MPI rank calling `MMG5_mmg3d1_delone` on its own
+   groups, without host-side divergence).
+ - communicator rebuild does not need the reference's face-vertex hash
+   remap (`PMMG_update_face2intInterfaceTetra`, `src/libparmmg1.c:361`):
+   interface vertices are frozen and carry persistent global ids in
+   `Mesh.vglob`, which `compact()` renumbers consistently, so tables are
+   re-derived by matching gids (sorted order both sides).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import adjacency, tags
+from ..core.mesh import Mesh, compact
+from ..ops import analysis, interp, quality
+from ..parallel.distribute import (
+    ShardComm,
+    assign_global_ids,
+    merge_shards,
+    rebuild_comm,
+    split_mesh,
+    unstack_mesh,
+)
+from ..parallel.partition import sfc_partition
+from .adapt import (
+    AdaptOptions,
+    adapt as adapt_single,
+    estimate_target_ntet,
+    prepare_metric,
+    remesh_sweep,
+)
+
+
+# ---------------------------------------------------------------------------
+# stacked-mesh utilities (leading axis = shard)
+# ---------------------------------------------------------------------------
+
+def stacked_counts(st: Mesh) -> tuple[int, int, int, int]:
+    """Max live counts across shards (capacity planning is per the largest
+    shard, since capacities are uniform)."""
+    return (
+        int(jnp.max(jnp.sum(st.vmask, axis=1))),
+        int(jnp.max(jnp.sum(st.tmask, axis=1))),
+        int(jnp.max(jnp.sum(st.trmask, axis=1))),
+        int(jnp.max(jnp.sum(st.edmask, axis=1))),
+    )
+
+
+def grow_stacked(
+    st: Mesh,
+    pcap: int | None = None,
+    tcap: int | None = None,
+    fcap: int | None = None,
+    ecap: int | None = None,
+) -> Mesh:
+    """Grow capacities of a stacked mesh (pads axis 1, host-side) by
+    delegating to the single source of truth, `Mesh.with_capacity`, per
+    shard and restacking."""
+    grown = [
+        m.with_capacity(pcap, tcap, fcap, ecap) for m in unstack_mesh(st)
+    ]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *grown)
+
+
+def ensure_capacity_stacked(st: Mesh, opts: AdaptOptions) -> Mesh:
+    """Stacked analog of `models.adapt.ensure_capacity` (the reference's
+    memory-budget role, `src/zaldy_pmmg.c`): grow when any shard crosses
+    the utilization trigger."""
+    npo, nte, ntr, ned = stacked_counts(st)
+    g = opts.grow_factor
+
+    def target(n, cap):
+        if n > opts.grow_trigger * cap:
+            return max(int(n * g) + 8, int(cap * g))
+        return cap
+
+    caps = (
+        st.vert.shape[1], st.tet.shape[1], st.tria.shape[1], st.edge.shape[1]
+    )
+    want = (
+        target(npo, caps[0]),
+        target(nte, caps[1]),
+        target(ntr, caps[2]),
+        target(ned, caps[3]),
+    )
+    if want != caps:
+        st = grow_stacked(st, *want)
+    return st
+
+
+# ---------------------------------------------------------------------------
+# stacked remesh phase (one outer iteration's operator sweeps)
+# ---------------------------------------------------------------------------
+
+def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions):
+    fn = partial(
+        remesh_sweep,
+        ecap=ecap,
+        noinsert=opts.noinsert,
+        noswap=opts.noswap,
+        nomove=opts.nomove,
+    )
+    return jax.vmap(fn)(st)
+
+
+def remesh_phase(
+    st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
+    it: int,
+) -> Mesh:
+    """Operator sweeps to convergence on every shard at once (vmapped) —
+    the batched analog of the per-group `MMG5_mmg3d1_delone` calls in the
+    reference loop body (`src/libparmmg1.c:662-800`)."""
+    sweep = 0
+    budget = opts.max_sweeps
+    while sweep < budget:
+        st = ensure_capacity_stacked(st, opts)
+        ecap = int(st.tet.shape[1] * emult[0]) + 64
+        st, stats = _vsweep(st, ecap, opts)
+        n_unique = int(jnp.max(stats.n_unique))
+        overflow = n_unique > ecap
+        if overflow:
+            emult[0] = max(
+                emult[0] * 1.5,
+                1.1 * n_unique / max(int(st.tet.shape[1]), 1),
+            )
+            if budget < opts.max_sweeps + 4:
+                budget += 1
+        rec = dict(
+            iter=it,
+            sweep=sweep,
+            nsplit=int(jnp.sum(stats.nsplit)),
+            ncollapse=int(jnp.sum(stats.ncollapse)),
+            nswap=int(jnp.sum(stats.nswap)),
+            nmoved=int(jnp.sum(stats.nmoved)),
+            ne=int(jnp.sum(st.tmask)),
+            np=int(jnp.sum(st.vmask)),
+            capped=bool(jnp.any(stats.split_capped)),
+        )
+        history.append(rec)
+        if opts.verbose >= 2:
+            print(
+                f"  [dist] it {it} sweep {sweep}: +{rec['nsplit']} "
+                f"-{rec['ncollapse']} ~{rec['nswap']} mv{rec['nmoved']} "
+                f"-> ne={rec['ne']}"
+            )
+        nops = rec["nsplit"] + rec["ncollapse"] + rec["nswap"]
+        if (
+            not rec["capped"]
+            and not overflow
+            and nops <= opts.converge_frac * max(rec["ne"], 1)
+        ):
+            break
+        sweep += 1
+    return st
+
+
+def interp_phase(st: Mesh, old: Mesh) -> Mesh:
+    """Per-shard interpolation from the pre-remesh snapshot —
+    `PMMG_interpMetricsAndFields` (`src/interpmesh_pmmg.c:663`; purely
+    shard-local, see SURVEY §3.4). Host loop over shards so the rare
+    exhaustive-location fallback can compact its failed subset host-side
+    (the walk itself is one batched device kernel per shard)."""
+    news = unstack_mesh(st)
+    olds = unstack_mesh(old)
+    out = [
+        interp.interp_metrics_and_fields(n, o)[0]
+        for n, o in zip(news, olds)
+    ]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *out)
+
+
+# ---------------------------------------------------------------------------
+# the driver
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DistOptions(AdaptOptions):
+    """Distributed controls on top of the adaptation options (the
+    redistribution rows of `PMMG_Param`, reference `src/libparmmg.h:54-90`:
+    nobalancing, APImode, niter...)."""
+
+    nparts: int = 8
+    nobalancing: bool = False     # -nobalance: skip interface displacement
+    ifc_layers: int = 2           # advancing-front displacement depth
+    check_comm: bool = False      # chkcomm assert each iteration (debug)
+    # minimum elements per shard before distribution pays off — the group
+    # sizing role of PMMG_howManyGroups / PMMG_GRPSPL_DISTR_TARGET
+    # (reference src/grpsplit_pmmg.c:47, src/parmmg.h:218-227): a mesh
+    # smaller than nparts*min_shard_elts is first grown single-shard so
+    # frozen interfaces don't dominate the shards
+    min_shard_elts: int = 256
+
+
+def adapt_distributed(
+    mesh: Mesh,
+    opts: Optional[DistOptions] = None,
+):
+    """Adapt a centralized mesh on `opts.nparts` shards.
+
+    Returns (stacked Mesh, ShardComm, info). Drives the reference's
+    centralized entry semantics (`PMMG_parmmglib_centralized`,
+    `src/libparmmg.c:1444`): preprocess → distribute → niter × [remesh
+    with frozen interfaces → interpolate → rebuild comm] → global
+    numbering. Use `merge_adapted` for the centralized-output path.
+    """
+    opts = opts or DistOptions()
+    nparts = opts.nparts
+
+    # --- preprocess (reference PMMG_preprocessMesh, src/libparmmg.c:128) --
+    mesh = adjacency.build_adjacency(mesh)
+    mesh = analysis.analyze(mesh)
+    ecap0 = int(mesh.tcap * 1.6) + 64
+    mesh = prepare_metric(mesh, opts, ecap0)
+    h_in = quality.quality_histogram(mesh)
+
+    # a mesh too small for nparts shards is grown single-shard first, so
+    # interfaces stay a thin fraction of each shard (group sizing,
+    # reference PMMG_howManyGroups, src/grpsplit_pmmg.c:47)
+    while (
+        int(mesh.ntet) < nparts * opts.min_shard_elts
+        and not opts.noinsert
+    ):
+        pre_opts = dataclasses.replace(opts, niter=1, hgrad=None)
+        ne_before = int(mesh.ntet)
+        mesh, pre_info = adapt_single(mesh, pre_opts)
+        if int(mesh.ntet) <= ne_before:  # metric is satisfied: stop
+            break
+
+    # --- distribute (reference PMMG_distribute_mesh) ----------------------
+    part = np.asarray(jax.device_get(sfc_partition(mesh, nparts)))
+    stacked, comm = split_mesh(mesh, part, nparts)
+
+    # pre-size for the predicted unit mesh (per-shard max) so the sweep
+    # compiles once per growth bucket at most
+    ests = [
+        estimate_target_ntet(m) for m in unstack_mesh(stacked)
+    ]
+    est_ne = int(max(ests) * 1.35) + 64
+    if est_ne > stacked.tet.shape[1]:
+        stacked = grow_stacked(
+            stacked,
+            pcap=max(stacked.vert.shape[1], est_ne // 5 + 64),
+            tcap=est_ne,
+            fcap=max(stacked.tria.shape[1], est_ne // 4 + 64),
+            ecap=max(stacked.edge.shape[1], est_ne // 16 + 64),
+        )
+
+    history: List[dict] = []
+    emult = [1.6]
+    icap = None
+    for it in range(opts.niter):
+        # snapshot for interpolation (PMMG_update_oldGrps role,
+        # src/grpsplit_pmmg.c:1224) — needs fresh adjacency for the walk
+        old = jax.vmap(adjacency.build_adjacency)(stacked)
+
+        stacked = remesh_phase(stacked, opts, emult, history, it)
+        stacked = jax.vmap(compact)(stacked)
+
+        # comm rebuild from persistent gids (replaces the reference's
+        # face-hash remap at src/libparmmg1.c:361)
+        comm = rebuild_comm(stacked, icap)
+        icap = comm.icap  # keep table shape stable across iterations
+
+        # interpolate metric + fields from the snapshot
+        stacked = interp_phase(stacked, old)
+
+        if opts.check_comm:
+            from ..parallel import chkcomm
+            from ..parallel.shard import device_mesh
+
+            chkcomm.assert_comm_ok(
+                stacked, comm, device_mesh(nparts), tol=1e-6
+            )
+
+    stacked = assign_global_ids(stacked)
+    comm = rebuild_comm(stacked, icap)
+    h_out = quality.merge_stacked_histograms(
+        jax.vmap(quality.quality_histogram)(stacked)
+    )
+    info = dict(history=history, qual_in=h_in, qual_out=h_out)
+    return stacked, comm, info
+
+
+def merge_adapted(stacked: Mesh, comm: ShardComm) -> Mesh:
+    """Centralized-output path: merge adapted shards into one Mesh
+    (reference `PMMG_merge_parmesh`, `src/mergemesh_pmmg.c:1571`).
+    Requires `assign_global_ids` to have run (adapt_distributed does)."""
+    return merge_shards(stacked, comm)

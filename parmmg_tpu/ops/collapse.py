@@ -114,8 +114,11 @@ def collapse_short_edges(
     ball_new = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
         jnp.where(vol_new > _VOL_EPS, q_new, -inf), mode="drop"
     )
-    ok_geom = (ball_new >= 0.6 * ball_old) | (ball_new >= 0.3)
-    ok_geom = ok_geom & (ball_new > 0.0) & jnp.isfinite(ball_new)
+    # accept if the new ball keeps ~a third of the old worst quality (the
+    # class of criterion Mmg's colver uses) or is absolutely decent, with
+    # a hard floor against degenerate configurations
+    ok_geom = (ball_new >= 0.3 * ball_old) | (ball_new >= 0.3)
+    ok_geom = ok_geom & (ball_new > 0.02) & jnp.isfinite(ball_new)
     accept = win & ok_geom
     nrej_geom = jnp.sum((win & ~ok_geom).astype(jnp.int32))
 

@@ -31,21 +31,25 @@ def two_phase_winners(
     gather_arena(arena_values) -> [N]: per candidate, max over its cells.
 
     Phase 1 maxes the float priority per arena cell; phase 2 breaks exact
-    float ties by candidate index, compared in two 12-bit halves so indices
-    stay exactly representable in float32 (a single float32 cast collides
-    above 2^24 candidates — routine at TPU mesh scale). Returns [N] bool
-    winners — candidates that are the unique argmax in every arena cell
-    they touch.
+    float ties by a HASHED candidate index (Luby-MIS style). The hash is a
+    bijective odd-multiplier permutation of uint32 (no collisions), and it
+    matters: raw edge indices are spatially sorted, so on a uniform mesh
+    (all priorities equal) nearly every candidate would see a
+    higher-indexed neighbor in its arena and a sweep would select O(1)
+    winners instead of O(n/degree). The 32-bit hash is compared in two
+    16-bit halves so each half stays exactly representable in float32.
+    Returns [N] bool winners — candidates that are the unique argmax in
+    every arena cell they touch.
     """
     n = prio.shape[0]
     p = jnp.where(cand, prio, -jnp.inf)
     best = gather_arena(scatter_arena(p))
     is_top = cand & (p >= best) & jnp.isfinite(p)
-    idx = jnp.arange(n, dtype=jnp.int32)
-    hi = (idx >> 12).astype(jnp.float32)
+    idx = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(2654435761)
+    hi = (idx >> 16).astype(jnp.float32)
     best_hi = gather_arena(scatter_arena(jnp.where(is_top, hi, -1.0)))
     is_top = is_top & (hi >= best_hi)
-    lo = (idx & 0xFFF).astype(jnp.float32)
+    lo = (idx & 0xFFFF).astype(jnp.float32)
     best_lo = gather_arena(scatter_arena(jnp.where(is_top, lo, -1.0)))
     return is_top & (lo >= best_lo)
 

@@ -121,6 +121,27 @@ def reduce_histograms(h: QualityHisto, axis_name: str) -> QualityHisto:
     )
 
 
+def merge_stacked_histograms(h: QualityHisto) -> QualityHisto:
+    """Reduce a vmapped (leading-axis-stacked) QualityHisto to one global
+    histogram — the out-of-shard_map companion of `reduce_histograms`
+    (same `PMMG_min_iel_compute` argmin-with-location semantics)."""
+    ne = jnp.sum(h.ne)
+    qmin = jnp.min(h.qmin)
+    worst_shard = jnp.argmin(h.qmin).astype(jnp.int32)
+    return QualityHisto(
+        ne=ne,
+        qmin=qmin,
+        qmax=jnp.max(h.qmax),
+        qavg=jnp.sum(h.qavg * h.ne.astype(h.qavg.dtype))
+        / jnp.maximum(ne, 1).astype(h.qavg.dtype),
+        worst_elt=h.worst_elt[worst_shard],
+        nbad=jnp.sum(h.nbad),
+        ninverted=jnp.sum(h.ninverted),
+        counts=jnp.sum(h.counts, axis=0),
+        worst_shard=worst_shard,
+    )
+
+
 def format_histogram(h: QualityHisto, label: str = "MESH QUALITY") -> str:
     """Human-readable report in the spirit of the reference's stdout
     histogram (verbosity-gated in `PMMG_qualhisto`)."""

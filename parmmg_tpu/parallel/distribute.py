@@ -99,8 +99,6 @@ def split_mesh(
         axis=0,
     )
     v_nshards = np.bincount(pairs[:, 0], minlength=npcap)
-    v_owner = np.full(npcap, nparts, np.int64)
-    np.minimum.at(v_owner, pairs[:, 0], pairs[:, 1])
 
     # --- tria -> owning tet shard (boundary faces have a unique tet) -------
     fv = tet[:, np.asarray(FACE_VERTS)].reshape(-1, 3)
@@ -118,8 +116,12 @@ def split_mesh(
     allrows = np.concatenate([fk, tkey]) if len(tkey) else fk
     _, inv = np.unique(allrows, axis=0, return_inverse=True)
     fid, qid = inv[: len(fk)], inv[len(fk):]
-    face_tet = np.full(inv.max() + 1 if len(inv) else 1, -1, np.int64)
+    nrows = inv.max() + 1 if len(inv) else 1
+    face_tet = np.full(nrows, -1, np.int64)
     face_tet[fid] = ftet[vsel]
+    # inverse map: face-row -> tria slot (reused below for interface faces)
+    face_tria = np.full(nrows, -1, np.int64)
+    face_tria[qid] = tria_live
     tria_shard = np.full(tria.shape[0], -1)
     if len(tkey):
         hit = face_tet[qid] >= 0
@@ -143,21 +145,32 @@ def split_mesh(
     # an input boundary tria can lie on an interior face that becomes an
     # inter-shard interface (opnbdy meshes): reuse that tria's ref/tags on
     # BOTH sides (PARBDYBDY discipline, reference src/tag_pmmg.c:646)
-    # instead of duplicating a synthetic NOSURF tria next to it
+    # instead of duplicating a synthetic NOSURF tria next to it. NOSURF
+    # also marks the REQUIRED bit as split-added (the reference's
+    # MG_NOSURF convention) so merge can strip it without touching
+    # user-required trias.
     ifc_ref = np.zeros(len(ifc_verts), np.int64)
     ifc_tag = np.full(len(ifc_verts), IFC_TAG, np.int64)
     if len(tkey) and len(ifc_verts):
-        ifc_key = np.sort(ifc_verts, axis=1)
-        allr = np.concatenate([ifc_key, tkey])
-        _, inv2 = np.unique(allr, axis=0, return_inverse=True)
-        fkid, tqid = inv2[: len(ifc_key)], inv2[len(ifc_key):]
-        slot = np.full(inv2.max() + 1, -1, np.int64)
-        slot[tqid] = tria_live
-        hit = slot[fkid]
+        # interface faces are tet faces already matched above: look their
+        # tria up through the first pass's row ids instead of re-sorting
+        pos = np.searchsorted(vsel, ifc_t * 4 + ifc_f)
+        hit = face_tria[fid[pos]]
         m = hit >= 0
+        m &= (trtag_g[np.maximum(hit, 0)] & tags.NOSURF) == 0
         ifc_ref[m] = trref_g[hit[m]]
-        ifc_tag[m] = trtag_g[hit[m]] | (
-            tags.PARBDY | tags.PARBDYBDY | tags.REQUIRED | tags.BDY
+        # keep the ORIGINAL tria winding on both replicas (tet-face order
+        # differs per side and would flip the surface normal for one of
+        # them; merge dedup would then keep an arbitrary orientation)
+        ifc_verts[m] = tria[hit[m]]
+        # NOSURF marks the REQUIRED bit as split-added — only when the
+        # user did NOT already require the tria (else merge would strip a
+        # genuine user constraint)
+        user_req = (trtag_g[hit[m]] & tags.REQUIRED) != 0
+        ifc_tag[m] = (
+            trtag_g[hit[m]]
+            | (tags.PARBDY | tags.PARBDYBDY | tags.REQUIRED | tags.BDY)
+            | np.where(user_req, 0, tags.NOSURF)
         )
         tria_shard[hit[m]] = -1  # replicated via the interface list instead
 
@@ -252,42 +265,108 @@ def split_mesh(
     stacked = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs), *meshes
     )
+    # communicator tables from the seeded vglob + PARBDY tags — the same
+    # construction that re-derives them after every remesh (one code path,
+    # reference PMMG_create_communicators at distributemesh_pmmg.c:739)
+    return stacked, rebuild_comm(stacked)
 
-    # --- communicator tables ----------------------------------------------
-    pair_shared: dict = {}
-    icap = 1
-    for s in range(nparts):
-        for r in range(s + 1, nparts):
-            shared = np.intersect1d(
-                shard_data[s]["gids"], shard_data[r]["gids"]
-            )  # sorted by gid -> identical order both sides
+
+def _pow2_at_least(n: int) -> int:
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+
+def rebuild_comm(stacked: Mesh, icap: int | None = None) -> ShardComm:
+    """(Re-)derive `ShardComm` node tables from `Mesh.vglob`.
+
+    Used both for the initial split and after remeshing. The reference
+    remaps its communicators after each Mmg call via a face-vertex hash
+    (`src/libparmmg1.c:361`); here interface vertices are frozen and keep
+    their global ids through `compact()`, so the shared list of each shard
+    pair is the gid-intersection of PARBDY vertices — sorted by gid,
+    giving identical k-ordering on both sides (the invariant
+    `parallel/comm.py` halo exchange relies on). Host-side: tables are
+    static inputs rebuilt once per outer iteration.
+    """
+    vglob = np.asarray(stacked.vglob)
+    vmask = np.asarray(stacked.vmask)
+    vtag = np.asarray(stacked.vtag)
+    D, PC = vglob.shape
+
+    par = vmask & (vglob >= 0) & ((vtag & tags.PARBDY) != 0)
+    slot_lists = [np.nonzero(par[s])[0] for s in range(D)]
+    gid_lists = [vglob[s][slot_lists[s]] for s in range(D)]
+    for s in range(D):
+        o = np.argsort(gid_lists[s])
+        gid_lists[s] = gid_lists[s][o]
+        slot_lists[s] = slot_lists[s][o]
+
+    pair_shared = {}
+    need = 1
+    for s in range(D):
+        for r in range(s + 1, D):
+            shared = np.intersect1d(gid_lists[s], gid_lists[r])
             if len(shared):
                 pair_shared[(s, r)] = shared
-                icap = max(icap, len(shared))
+                need = max(need, len(shared))
+    if icap is None:
+        icap = _pow2_at_least(need)
+    elif need > icap:
+        raise ValueError(f"icap {icap} < largest shared list {need}")
 
-    comm_idx = np.full((nparts, nparts, icap), -1, np.int32)
-    counts = np.zeros((nparts, nparts), np.int32)
+    comm_idx = np.full((D, D, icap), -1, np.int32)
+    counts = np.zeros((D, D), np.int32)
     for (s, r), shared in pair_shared.items():
-        ls_idx = np.searchsorted(shard_data[s]["gids"], shared)
-        lr_idx = np.searchsorted(shard_data[r]["gids"], shared)
+        ls_idx = slot_lists[s][np.searchsorted(gid_lists[s], shared)]
+        lr_idx = slot_lists[r][np.searchsorted(gid_lists[r], shared)]
         comm_idx[s, r, : len(shared)] = ls_idx
         comm_idx[r, s, : len(shared)] = lr_idx
         counts[s, r] = counts[r, s] = len(shared)
 
-    l2g = np.full((nparts, pcap), -1, np.int32)
-    owner = np.zeros((nparts, pcap), bool)
-    for s, d in enumerate(shard_data):
-        n = len(d["gids"])
-        l2g[s, :n] = d["gids"]
-        owner[s, :n] = v_owner[d["gids"]] == s
+    # owner = lowest shard holding the gid (PMMG_count_nodes_par dedup role)
+    owner = vmask.copy()
+    if pair_shared:
+        all_g = np.concatenate(gid_lists)
+        all_s = np.concatenate(
+            [np.full(len(g), s) for s, g in enumerate(gid_lists)]
+        )
+        min_owner = np.full(all_g.max() + 1, D, np.int64)
+        np.minimum.at(min_owner, all_g, all_s)
+        for s in range(D):
+            sl = slot_lists[s]
+            owner[s, sl] = min_owner[gid_lists[s]] == s
 
-    comm = ShardComm(
+    return ShardComm(
         comm_idx=jnp.asarray(comm_idx),
         counts=jnp.asarray(counts),
-        l2g=jnp.asarray(l2g),
+        l2g=jnp.asarray(np.where(vmask, vglob, -1)),
         owner=jnp.asarray(owner),
     )
-    return stacked, comm
+
+
+def assign_global_ids(stacked: Mesh) -> Mesh:
+    """Give remeshing-created vertices (vglob == -1) fresh contiguous
+    global ids.
+
+    The reference numbers output vertices owner-first across ranks
+    (`PMMG_Compute_verticesGloNum`, `src/libparmmg.c:923`) — here every
+    new vertex is strictly interior to its shard (interfaces are frozen),
+    so numbering is an exclusive scan of per-shard new-vertex counts on
+    top of the current global max; no halo agreement is required.
+    """
+    vglob = np.asarray(stacked.vglob).copy()
+    vmask = np.asarray(stacked.vmask)
+    D = vglob.shape[0]
+    new = vmask & (vglob < 0)
+    base = int(vglob.max()) + 1 if (vglob >= 0).any() else 0
+    counts = new.sum(axis=1)
+    offs = base + np.concatenate([[0], np.cumsum(counts)[:-1]])
+    for s in range(D):
+        idx = np.nonzero(new[s])[0]
+        vglob[s, idx] = offs[s] + np.arange(len(idx))
+    return stacked.replace(vglob=jnp.asarray(vglob))
 
 
 def unstack_mesh(stacked: Mesh) -> List[Mesh]:
@@ -306,7 +385,16 @@ def merge_shards(stacked: Mesh, comm: ShardComm) -> Mesh:
     scatter)."""
     parts = unstack_mesh(stacked)
     l2g = np.asarray(comm.l2g)
-    nglob = int(l2g.max()) + 1
+    vmask_all = np.asarray(stacked.vmask)
+    if (vmask_all & (l2g < 0)).any():
+        raise ValueError(
+            "merge: live vertices without global ids — run "
+            "assign_global_ids after remeshing"
+        )
+    # the gid space may have gaps (collapsed-away original vertices):
+    # compress to dense output ids via the sorted set of live gids
+    live_gids = np.unique(l2g[vmask_all])
+    nglob = len(live_gids)
     vert = np.zeros((nglob, 3), np.asarray(parts[0].vert).dtype)
     vref = np.zeros(nglob, np.int32)
     vtag = np.zeros(nglob, np.int32)
@@ -319,8 +407,10 @@ def merge_shards(stacked: Mesh, comm: ShardComm) -> Mesh:
     all_edges, all_edrefs, all_edtags = [], [], []
     for s, m in enumerate(parts):
         vm = np.asarray(m.vmask)
-        g = l2g[s]
-        valid = vm & (g >= 0)
+        # dense output id per local slot (-1 on dead slots)
+        g = np.full(l2g.shape[1], -1, np.int64)
+        g[vm] = np.searchsorted(live_gids, l2g[s][vm])
+        valid = vm
         gi = g[valid]
         vert[gi] = np.asarray(m.vert)[valid]
         vref[gi] = np.asarray(m.vref)[valid]
@@ -339,25 +429,41 @@ def merge_shards(stacked: Mesh, comm: ShardComm) -> Mesh:
         tm = np.asarray(m.tmask)
         all_tets.append(g[np.asarray(m.tet)[tm]])
         all_trefs.append(np.asarray(m.tref)[tm])
-        # drop pure-parallel interface trias (PARBDY+NOSURF): they are
-        # interior faces of the centralized mesh, not real boundary
+        # drop synthetic interface trias (PARBDY+NOSURF, not PARBDYBDY):
+        # they are interior faces of the centralized mesh, not real
+        # boundary. PARBDYBDY trias are REAL boundary replicated on both
+        # sides — kept (and deduplicated below).
         trtag_s = np.asarray(m.trtag)
-        pure_par = ((trtag_s & tags.PARBDY) != 0) & (
-            (trtag_s & tags.NOSURF) != 0
+        pure_par = (
+            ((trtag_s & tags.PARBDY) != 0)
+            & ((trtag_s & tags.NOSURF) != 0)
+            & ((trtag_s & tags.PARBDYBDY) == 0)
         )
         fm = np.asarray(m.trmask) & ~pure_par
+        tt = trtag_s[fm] & ~(tags.PARBDY | tags.PARBDYBDY)
+        # REQUIRED that came with NOSURF was split-added (reference
+        # MG_NOSURF convention): strip both, keep user-required intact
+        syn = (tt & tags.NOSURF) != 0
+        tt = np.where(syn, tt & ~(tags.REQUIRED | tags.NOSURF), tt)
         all_trias.append(g[np.asarray(m.tria)[fm]])
         all_trrefs.append(np.asarray(m.trref)[fm])
-        all_trtags.append(
-            trtag_s[fm]
-            & ~(tags.PARBDY | tags.PARBDYBDY | tags.NOSURF)
-        )
+        all_trtags.append(tt)
         em = np.asarray(m.edmask)
         all_edges.append(g[np.asarray(m.edge)[em]])
         all_edrefs.append(np.asarray(m.edref)[em])
         all_edtags.append(np.asarray(m.edtag)[em])
     if not seen.all():
         raise ValueError("merge: some global vertex ids were never filled")
+    # dedup trias replicated into both side shards (PARBDYBDY discipline)
+    trias_m = np.concatenate(all_trias)
+    trrefs_m = np.concatenate(all_trrefs)
+    trtags_m = np.concatenate(all_trtags)
+    if len(trias_m):
+        tk = np.sort(trias_m, axis=1)
+        _, uniq = np.unique(tk, axis=0, return_index=True)
+        trias_m, trrefs_m, trtags_m = (
+            trias_m[uniq], trrefs_m[uniq], trtags_m[uniq]
+        )
     edges = np.concatenate(all_edges) if all_edges else np.zeros((0, 2), int)
     # dedup replicated feature edges
     if len(edges):
@@ -374,9 +480,9 @@ def merge_shards(stacked: Mesh, comm: ShardComm) -> Mesh:
         vrefs=vref,
         vtags=vtag,
         trefs=np.concatenate(all_trefs),
-        trias=np.concatenate(all_trias),
-        trrefs=np.concatenate(all_trrefs),
-        trtags=np.concatenate(all_trtags),
+        trias=trias_m,
+        trrefs=trrefs_m,
+        trtags=trtags_m,
         edges=edges,
         edrefs=edrefs,
         edtags=edtags,

@@ -262,3 +262,53 @@ def test_renumber_sfc(cube):
     s0 = {tuple(sorted(t)) for t in np.asarray(cube.tet)[np.asarray(cube.tmask)].tolist()}
     s1 = {tuple(sorted(t)) for t in np.asarray(m.tet)[np.asarray(m.tmask)].tolist()}
     assert s0 == s1
+
+
+def test_parbdybdy_tria_roundtrip():
+    """An input boundary tria lying ON an inter-shard interface face must
+    come back from split+merge exactly once, with its original tags — no
+    duplication, no leaked REQUIRED/NOSURF (reference PMMG_parbdyTria /
+    updateTag discipline, src/tag_pmmg.c:646)."""
+    from parmmg_tpu.core import adjacency, tags
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    mesh = unit_cube_mesh(4)
+    mesh = adjacency.build_adjacency(mesh)
+    # force a partition cut and plant a tria on an interior face that the
+    # cut turns into an interface face
+    part = np.asarray(jax.device_get(partition.sfc_partition(mesh, 2)))
+    adja = np.asarray(mesh.adja)
+    tet = np.asarray(mesh.tet)
+    tmask = np.asarray(mesh.tmask)
+    nb = adja // 4
+    ifc = (adja >= 0) & tmask[:, None] & (part[np.maximum(nb, 0)] != part[:, None])
+    t, f = np.argwhere(ifc)[0]
+    from parmmg_tpu.core.mesh import FACE_VERTS
+    tri = tet[t, FACE_VERTS[f]]
+    ntr0 = int(mesh.ntria)
+    tria = np.asarray(mesh.tria).copy()
+    trmask = np.asarray(mesh.trmask).copy()
+    trtag = np.asarray(mesh.trtag).copy()
+    trref = np.asarray(mesh.trref).copy()
+    assert ntr0 < tria.shape[0], "need tria headroom"
+    tria[ntr0] = tri
+    trmask[ntr0] = True
+    trtag[ntr0] = tags.BDY
+    trref[ntr0] = 7
+    mesh2 = mesh.replace(
+        tria=jnp.asarray(tria), trmask=jnp.asarray(trmask),
+        trtag=jnp.asarray(trtag), trref=jnp.asarray(trref),
+    )
+    stacked, comm = distribute.split_mesh(mesh2, part, 2)
+    back = distribute.merge_shards(stacked, comm)
+    bt = np.asarray(back.tria)[np.asarray(back.trmask)]
+    btag = np.asarray(back.trtag)[np.asarray(back.trmask)]
+    bref = np.asarray(back.trref)[np.asarray(back.trmask)]
+    tgt = set(map(tuple, [sorted(tri.tolist())]))
+    hits = [i for i, tr in enumerate(bt) if tuple(sorted(tr.tolist())) in tgt]
+    assert len(hits) == 1, f"tria must appear exactly once, got {len(hits)}"
+    i = hits[0]
+    assert bref[i] == 7
+    assert btag[i] & (tags.REQUIRED | tags.NOSURF | tags.PARBDY | tags.PARBDYBDY) == 0
+    assert btag[i] & tags.BDY
+    assert int(back.ntria) == ntr0 + 1
