@@ -1,0 +1,126 @@
+"""Benchmark: adapt a refined unit cube to a uniform size map and report
+remeshing throughput as ONE JSON line.
+
+Workload: cube n=10 (6,000 input tets) -> hsiz=0.05 (~110k output tets),
+the shape of the reference CI adaptation runs
+(`cmake/testing/pmmg_tests.cmake:30-50`, `-mesh-size`-class workloads).
+
+Baseline note (BASELINE.md): the reference ParMmg binary cannot be built
+in this environment (its Mmg/Metis dependencies are CMake
+ExternalProjects requiring network download, and no MPI toolchain is
+installed), so the recorded anchor is this framework's own steady-state
+throughput on the host CPU backend for the identical workload —
+an honest same-algorithm hardware comparison. vs_baseline therefore
+reads as "accelerator speedup over the CPU execution".
+
+Robustness: XLA compilation over the shared TPU tunnel has a highly
+variable latency (observed 1-10x swings), so the measurement runs in a
+subprocess with its own timeout and falls back to a smaller workload —
+the driver always gets a parseable line.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+# steady-state tets/sec of the default workload on the host CPU backend
+# (measured with a warm jit cache; see BASELINE.md "CPU anchor" row)
+CPU_ANCHOR_TPS = 2017.5
+# CPU anchor for the small fallback workload (n=8, hsiz=0.08)
+CPU_ANCHOR_TPS_SMALL = 6649.7
+
+
+def _workload(n, hsiz):
+    """Mesh pre-sized so the whole adaptation stays in ONE capacity
+    bucket: every kernel compiles exactly once (compile over the TPU
+    tunnel costs minutes; execution costs seconds)."""
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    est = int(12.0 / hsiz**3)
+    return unit_cube_mesh(
+        n,
+        tcap=int(est * 1.9),
+        pcap=max(int(est * 0.45), 4096),
+        fcap=max(int(est * 0.30), 4096),
+    )
+
+
+def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS):
+    import jax
+
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.ops import quality
+
+    opts = AdaptOptions(niter=niter, hsiz=hsiz, max_sweeps=max_sweeps, hgrad=None)
+
+    # warmup run: pays every jit compile; the timed run below hits the
+    # in-process executable cache (same static shapes by construction)
+    adapt(_workload(n, hsiz), opts)
+
+    mesh = _workload(n, hsiz)
+    t0 = time.perf_counter()
+    out, info = adapt(mesh, opts)
+    wall = time.perf_counter() - t0
+
+    ne = int(out.ntet)
+    h = quality.quality_histogram(out)
+    tps = ne / wall
+    return {
+        "metric": "tets_per_sec",
+        "value": round(tps, 1),
+        "unit": "tet/s",
+        "vs_baseline": round(tps / anchor, 3),
+        "ne": ne,
+        "wall_s": round(wall, 2),
+        "platform": jax.devices()[0].platform,
+        "qmin": round(float(h.qmin), 5),
+        "qavg": round(float(h.qavg), 5),
+    }
+
+
+_CONFIGS = [
+    # (args, per-attempt timeout seconds, extra env)
+    (dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 360, {}),
+    (dict(n=8, hsiz=0.08, anchor=CPU_ANCHOR_TPS_SMALL), 180, {}),
+    # last resort when the TPU tunnel is unusable: the same measurement
+    # on the host CPU backend, honestly labeled via the "platform" field
+    (dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 480,
+     {"JAX_PLATFORMS": "cpu"}),
+]
+
+
+def main():
+    if "--worker" in sys.argv:
+        cfg = json.loads(sys.argv[-1])
+        print(json.dumps(run(**cfg)), flush=True)
+        return
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for cfg, tmo, env_extra in _CONFIGS:
+        try:
+            env = dict(os.environ, **env_extra)
+            if env_extra.get("JAX_PLATFORMS") == "cpu":
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 json.dumps(cfg)],
+                capture_output=True, text=True, timeout=tmo, cwd=here,
+                env=env,
+            )
+            for line in reversed(out.stdout.strip().splitlines()):
+                if line.startswith("{"):
+                    print(line)
+                    return
+        except subprocess.TimeoutExpired:
+            continue
+    # every attempt timed out (tunnel unusable): still emit a line
+    print(json.dumps({
+        "metric": "tets_per_sec", "value": 0.0, "unit": "tet/s",
+        "vs_baseline": 0.0, "error": "all attempts timed out",
+    }))
+
+
+if __name__ == "__main__":
+    main()

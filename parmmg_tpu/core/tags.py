@@ -24,6 +24,9 @@ PARBDY = 1 << 6     # on an inter-shard (parallel) interface — frozen
 PARBDYBDY = 1 << 7  # parallel interface that is also a true boundary
 OLDPARBDY = 1 << 8  # was a parallel interface at the previous iteration
 NOSURF = 1 << 9     # required only because parallel, not user-required
+#                     (internal-only: input readers never set it; split
+#                     adds it, merge strips it together with the
+#                     REQUIRED it marks as split-added)
 OVERLAP = 1 << 10   # belongs to a halo/ghost overlap region
 
 # A vertex with any of these cannot be moved by smoothing:

@@ -188,6 +188,63 @@ def ensure_capacity(mesh: Mesh, opts: AdaptOptions) -> Mesh:
     return mesh
 
 
+def run_sweep_loop(
+    state,
+    opts: AdaptOptions,
+    emult: List[float],
+    history: List[dict],
+    it: int,
+    ensure_fn,
+    tcap_fn,
+    sweep_fn,
+):
+    """Shared sweep-to-convergence engine for the single-shard and
+    stacked (distributed) drivers: capacity growth between sweeps,
+    unique-edge-cap overflow handling (with bounded budget extension so
+    a late overflow cannot loop forever), history bookkeeping and the
+    converge_frac stopping rule.
+
+    `ensure_fn(state) -> state` grows capacities; `tcap_fn(state)` is the
+    tet capacity governing the unique-edge cap; `sweep_fn(state, ecap) ->
+    (state, rec)` runs one sweep and returns host-int stats with keys
+    nsplit/ncollapse/nswap/nmoved/ne/np (aggregated over shards where
+    applicable) plus n_unique (max) and capped (any).
+    """
+    sweep = 0
+    budget = opts.max_sweeps
+    while sweep < budget:
+        state = ensure_fn(state)
+        ecap = int(tcap_fn(state) * emult[0]) + 64
+        state, rec = sweep_fn(state, ecap)
+        overflow = rec["n_unique"] > ecap
+        if overflow:
+            # unique_edges dropped overflow edges this sweep (its
+            # documented contract): grow the cap and redo coverage
+            emult[0] = max(
+                emult[0] * 1.5,
+                1.1 * rec["n_unique"] / max(tcap_fn(state), 1),
+            )
+            if budget < opts.max_sweeps + 4:
+                budget += 1
+        rec.update(iter=it, sweep=sweep)
+        history.append(rec)
+        if opts.verbose >= 2:
+            print(
+                f"  it {it} sweep {sweep}: +{rec['nsplit']} split "
+                f"-{rec['ncollapse']} collapse {rec['nswap']} swap "
+                f"{rec['nmoved']} moved -> ne={rec['ne']}"
+            )
+        nops = rec["nsplit"] + rec["ncollapse"] + rec["nswap"]
+        if (
+            not rec["capped"]
+            and not overflow
+            and nops <= opts.converge_frac * max(rec["ne"], 1)
+        ):
+            break
+        sweep += 1
+    return state
+
+
 def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     """Adapt `mesh` to its metric. Returns (mesh, info dict).
 
@@ -197,13 +254,12 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     interpolation in the distributed driver."""
     opts = opts or AdaptOptions()
     # unique-edge capacity multiplier: ~1.19 edges/tet asymptotically, but
-    # pathological meshes can exceed 1.6x — grown on overflow (see below)
+    # pathological meshes can exceed 1.6x — grown on overflow
     emult = [1.6]
-    ecap_of = lambda m: int(m.tcap * emult[0]) + 64
 
     mesh = ensure_capacity(mesh, opts)
     mesh = analysis.analyze(mesh)
-    mesh = prepare_metric(mesh, opts, ecap_of(mesh))
+    mesh = prepare_metric(mesh, opts, int(mesh.tcap * emult[0]) + 64)
     h0 = quality.quality_histogram(mesh)
 
     # pre-size capacities for the predicted unit mesh so sweeps compile
@@ -218,58 +274,34 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
             ecap=max(mesh.ecap, est_ne // 16 + 64),
         )
 
+    def sweep_fn(m, ecap):
+        m, st = remesh_sweep(
+            m,
+            ecap,
+            noinsert=opts.noinsert,
+            noswap=opts.noswap,
+            nomove=opts.nomove,
+        )
+        rec = dict(
+            nsplit=int(st.nsplit),
+            ncollapse=int(st.ncollapse),
+            nswap=int(st.nswap),
+            nmoved=int(st.nmoved),
+            ne=int(m.ntet),
+            np=int(m.npoin),
+            n_unique=int(st.n_unique),
+            capped=bool(st.split_capped),
+        )
+        return m, rec
+
     history: List[dict] = []
     for it in range(opts.niter):
-        sweep = 0
-        budget = opts.max_sweeps
-        while sweep < budget:
-            mesh = ensure_capacity(mesh, opts)
-            ecap = ecap_of(mesh)
-            mesh, st = remesh_sweep(
-                mesh,
-                ecap,
-                noinsert=opts.noinsert,
-                noswap=opts.noswap,
-                nomove=opts.nomove,
-            )
-            overflow = int(st.n_unique) > ecap
-            if overflow:
-                # unique_edges dropped overflow edges this sweep (its
-                # documented contract): grow the cap and redo coverage —
-                # including when the overflow lands on the last budgeted
-                # sweep (bounded extension so it cannot loop forever)
-                emult[0] = max(
-                    emult[0] * 1.5,
-                    1.1 * int(st.n_unique) / max(int(mesh.tcap), 1),
-                )
-                if budget < opts.max_sweeps + 4:
-                    budget += 1
-            rec = dict(
-                iter=it,
-                sweep=sweep,
-                nsplit=int(st.nsplit),
-                ncollapse=int(st.ncollapse),
-                nswap=int(st.nswap),
-                nmoved=int(st.nmoved),
-                ne=int(mesh.ntet),
-                np=int(mesh.npoin),
-                capped=bool(st.split_capped),
-            )
-            history.append(rec)
-            if opts.verbose >= 2:
-                print(
-                    f"  it {it} sweep {sweep}: +{rec['nsplit']} split "
-                    f"-{rec['ncollapse']} collapse {rec['nswap']} swap "
-                    f"{rec['nmoved']} moved -> ne={rec['ne']}"
-                )
-            nops = rec["nsplit"] + rec["ncollapse"] + rec["nswap"]
-            if (
-                not rec["capped"]
-                and not overflow
-                and nops <= opts.converge_frac * max(rec["ne"], 1)
-            ):
-                break
-            sweep += 1
+        mesh = run_sweep_loop(
+            mesh, opts, emult, history, it,
+            ensure_fn=lambda m: ensure_capacity(m, opts),
+            tcap_fn=lambda m: m.tcap,
+            sweep_fn=sweep_fn,
+        )
 
     mesh = compact(mesh)
     h1 = quality.quality_histogram(mesh)

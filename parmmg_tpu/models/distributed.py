@@ -42,13 +42,14 @@ from ..parallel.distribute import (
     split_mesh,
     unstack_mesh,
 )
-from ..parallel.partition import sfc_partition
+from ..parallel.partition import displace_partition, sfc_partition
 from .adapt import (
     AdaptOptions,
     adapt as adapt_single,
     estimate_target_ntet,
     prepare_metric,
     remesh_sweep,
+    run_sweep_loop,
 )
 
 
@@ -81,6 +82,22 @@ def grow_stacked(
         m.with_capacity(pcap, tcap, fcap, ecap) for m in unstack_mesh(st)
     ]
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *grown)
+
+
+def _presize_for_target(st: Mesh) -> Mesh:
+    """Pre-size capacities for the predicted unit mesh (per-shard max) so
+    the sweep compiles once per growth bucket at most."""
+    ests = [estimate_target_ntet(m) for m in unstack_mesh(st)]
+    est_ne = int(max(ests) * 1.35) + 64
+    if est_ne > st.tet.shape[1]:
+        st = grow_stacked(
+            st,
+            pcap=max(st.vert.shape[1], est_ne // 5 + 64),
+            tcap=est_ne,
+            fcap=max(st.tria.shape[1], est_ne // 4 + 64),
+            ecap=max(st.edge.shape[1], est_ne // 16 + 64),
+        )
+    return st
 
 
 def ensure_capacity_stacked(st: Mesh, opts: AdaptOptions) -> Mesh:
@@ -130,49 +147,29 @@ def remesh_phase(
 ) -> Mesh:
     """Operator sweeps to convergence on every shard at once (vmapped) —
     the batched analog of the per-group `MMG5_mmg3d1_delone` calls in the
-    reference loop body (`src/libparmmg1.c:662-800`)."""
-    sweep = 0
-    budget = opts.max_sweeps
-    while sweep < budget:
-        st = ensure_capacity_stacked(st, opts)
-        ecap = int(st.tet.shape[1] * emult[0]) + 64
-        st, stats = _vsweep(st, ecap, opts)
-        n_unique = int(jnp.max(stats.n_unique))
-        overflow = n_unique > ecap
-        if overflow:
-            emult[0] = max(
-                emult[0] * 1.5,
-                1.1 * n_unique / max(int(st.tet.shape[1]), 1),
-            )
-            if budget < opts.max_sweeps + 4:
-                budget += 1
+    reference loop body (`src/libparmmg1.c:662-800`). Control flow is the
+    shared `run_sweep_loop` engine with cross-shard-aggregated stats."""
+
+    def sweep_fn(s, ecap):
+        s, stats = _vsweep(s, ecap, opts)
         rec = dict(
-            iter=it,
-            sweep=sweep,
             nsplit=int(jnp.sum(stats.nsplit)),
             ncollapse=int(jnp.sum(stats.ncollapse)),
             nswap=int(jnp.sum(stats.nswap)),
             nmoved=int(jnp.sum(stats.nmoved)),
-            ne=int(jnp.sum(st.tmask)),
-            np=int(jnp.sum(st.vmask)),
+            ne=int(jnp.sum(s.tmask)),
+            np=int(jnp.sum(s.vmask)),
+            n_unique=int(jnp.max(stats.n_unique)),
             capped=bool(jnp.any(stats.split_capped)),
         )
-        history.append(rec)
-        if opts.verbose >= 2:
-            print(
-                f"  [dist] it {it} sweep {sweep}: +{rec['nsplit']} "
-                f"-{rec['ncollapse']} ~{rec['nswap']} mv{rec['nmoved']} "
-                f"-> ne={rec['ne']}"
-            )
-        nops = rec["nsplit"] + rec["ncollapse"] + rec["nswap"]
-        if (
-            not rec["capped"]
-            and not overflow
-            and nops <= opts.converge_frac * max(rec["ne"], 1)
-        ):
-            break
-        sweep += 1
-    return st
+        return s, rec
+
+    return run_sweep_loop(
+        st, opts, emult, history, it,
+        ensure_fn=lambda s: ensure_capacity_stacked(s, opts),
+        tcap_fn=lambda s: int(s.tet.shape[1]),
+        sweep_fn=sweep_fn,
+    )
 
 
 def interp_phase(st: Mesh, old: Mesh) -> Mesh:
@@ -201,8 +198,15 @@ class DistOptions(AdaptOptions):
     nobalancing, APImode, niter...)."""
 
     nparts: int = 8
-    nobalancing: bool = False     # -nobalance: skip interface displacement
-    ifc_layers: int = 2           # advancing-front displacement depth
+    # -nobalance: skip the between-iteration resharding (interface
+    # displacement); frozen bands then stay frozen for all niter
+    nobalancing: bool = False
+    # advancing-front displacement depth per iteration (reference
+    # PMMG_MVIFCS_NLAYERS=2, src/parmmg.h:227)
+    ifc_layers: int = 2
+    # max shard-size imbalance before a rebalancing SFC re-cut replaces
+    # the displaced partition (reference PMMG_GRPS_RATIO, src/parmmg.h:221)
+    grps_ratio: float = 2.0
     check_comm: bool = False      # chkcomm assert each iteration (debug)
     # minimum elements per shard before distribution pays off — the group
     # sizing role of PMMG_howManyGroups / PMMG_GRPSPL_DISTR_TARGET
@@ -249,22 +253,10 @@ def adapt_distributed(
 
     # --- distribute (reference PMMG_distribute_mesh) ----------------------
     part = np.asarray(jax.device_get(sfc_partition(mesh, nparts)))
-    stacked, comm = split_mesh(mesh, part, nparts)
-
-    # pre-size for the predicted unit mesh (per-shard max) so the sweep
-    # compiles once per growth bucket at most
-    ests = [
-        estimate_target_ntet(m) for m in unstack_mesh(stacked)
-    ]
-    est_ne = int(max(ests) * 1.35) + 64
-    if est_ne > stacked.tet.shape[1]:
-        stacked = grow_stacked(
-            stacked,
-            pcap=max(stacked.vert.shape[1], est_ne // 5 + 64),
-            tcap=est_ne,
-            fcap=max(stacked.tria.shape[1], est_ne // 4 + 64),
-            ecap=max(stacked.edge.shape[1], est_ne // 16 + 64),
-        )
+    stacked, comm = split_mesh(
+        mesh, part, nparts, build_shard_adjacency=False
+    )
+    stacked = _presize_for_target(stacked)
 
     history: List[dict] = []
     emult = [1.6]
@@ -277,11 +269,6 @@ def adapt_distributed(
         stacked = remesh_phase(stacked, opts, emult, history, it)
         stacked = jax.vmap(compact)(stacked)
 
-        # comm rebuild from persistent gids (replaces the reference's
-        # face-hash remap at src/libparmmg1.c:361)
-        comm = rebuild_comm(stacked, icap)
-        icap = comm.icap  # keep table shape stable across iterations
-
         # interpolate metric + fields from the snapshot
         stacked = interp_phase(stacked, old)
 
@@ -289,9 +276,68 @@ def adapt_distributed(
             from ..parallel import chkcomm
             from ..parallel.shard import device_mesh
 
+            # comm rebuild from persistent gids (replaces the reference's
+            # face-hash remap at src/libparmmg1.c:361); outside this
+            # debug check the tables are rebuilt where next consumed —
+            # in the balancing branch and after the loop
+            comm = rebuild_comm(stacked, icap)
+            icap = comm.icap
             chkcomm.assert_comm_ok(
                 stacked, comm, device_mesh(nparts), tol=1e-6
             )
+
+        # --- load balancing / interface displacement ----------------------
+        # (reference PMMG_loadBalancing, src/loadbalancing_pmmg.c:44, in
+        # ifc-displacement mode src/moveinterfaces_pmmg.c:1306): the old
+        # per-tet colors advance `ifc_layers` layers across interfaces
+        # under a per-iteration priority permutation, so every band frozen
+        # this iteration is interior in the next. Host resharding via
+        # merge+split; skipped after the last iteration.
+        if not opts.nobalancing and it < opts.niter - 1 and nparts > 1:
+            stacked = assign_global_ids(stacked)
+            comm = rebuild_comm(stacked, icap)
+            shard_ne = [
+                int(m.ntet) for m in unstack_mesh(stacked)
+            ]
+            merged = adjacency.build_adjacency(merge_shards(stacked, comm))
+            # advancing-front displacement, bigger-group-wins with a
+            # fixed tie-break (round_id=0) so fronts move monotonically —
+            # each iteration's frozen band was interior, hence remeshed,
+            # in an earlier iteration. Provenance colors: merge
+            # concatenates live tets in shard order.
+            part = np.full(merged.tcap, -1, np.int64)
+            part[: sum(shard_ne)] = np.repeat(
+                np.arange(nparts), shard_ne
+            )
+            part = displace_partition(
+                part,
+                np.asarray(merged.adja),
+                np.asarray(merged.tmask),
+                nparts,
+                round_id=0,
+                layers=opts.ifc_layers,
+            )
+            # GRPS_RATIO discipline (reference src/parmmg.h:218-227):
+            # when accumulated displacement skews shard sizes past the
+            # ratio, rebalance with a fresh SFC cut instead. Its
+            # interfaces fall near earlier cut planes, whose bands were
+            # remeshed while displaced — adapted, merely re-frozen.
+            # Ratio is max-vs-mean: uniform capacities and per-device
+            # wall-clock are governed by the LARGEST shard (a floored
+            # tiny shard is waste, not cost — min-based ratios fire on
+            # every small-mesh run and cancel the displacement).
+            tm = np.asarray(merged.tmask)
+            counts = np.bincount(part[tm], minlength=nparts)
+            if counts.max() > opts.grps_ratio * counts.mean():
+                part = np.asarray(
+                    jax.device_get(sfc_partition(merged, nparts))
+                )
+            stacked, comm = split_mesh(
+                merged, part, nparts, assume_adjacency=True,
+                build_shard_adjacency=False,
+            )
+            icap = None  # interface sets changed; re-derive table shape
+            stacked = _presize_for_target(stacked)
 
     stacked = assign_global_ids(stacked)
     comm = rebuild_comm(stacked, icap)

@@ -27,8 +27,6 @@ from ..core import tags
 from ..core.mesh import Mesh
 from . import common
 
-_VOL_EPS = 1e-14
-
 
 class CollapseStats(NamedTuple):
     ncollapse: jax.Array
@@ -105,6 +103,10 @@ def collapse_short_edges(
     q_old = common.quality_of(mesh.vert, mesh.met, tet)
     q_new = common.quality_of(mesh.vert, mesh.met, new_tet)
     vol_new = common.vol_of(mesh.vert, new_tet)
+    # scale-relative positivity (common.POS_VOL_FRAC of the tet's own
+    # old volume)
+    vol_old = common.vol_of(mesh.vert, tet)
+    vol_floor = common.POS_VOL_FRAC * jnp.abs(vol_old)
 
     # --- geometric validity per winner ------------------------------------
     inf = jnp.inf
@@ -112,7 +114,7 @@ def collapse_short_edges(
         q_old, mode="drop"
     )
     ball_new = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
-        jnp.where(vol_new > _VOL_EPS, q_new, -inf), mode="drop"
+        jnp.where(vol_new > vol_floor, q_new, -inf), mode="drop"
     )
     # accept if the new ball keeps ~a third of the old worst quality (the
     # class of criterion Mmg's colver uses) or is absolutely decent, with

@@ -17,6 +17,22 @@ import jax.numpy as jnp
 from ..core.mesh import Mesh
 
 
+# positivity floor for tentative configurations: a new/retargeted/moved
+# tet must keep at least this fraction of its local reference volume —
+# scale-relative because absolute thresholds (the old 1e-14) sit below
+# f32 resolution at any mesh scale
+POS_VOL_FRAC = 1e-4
+
+
+def vol_tols(dtype):
+    """(positivity fraction, conservation tolerance) for volume
+    predicates. The positivity fraction is the dtype-independent
+    POS_VOL_FRAC (re-exported here so swap's two checks share one call);
+    only the conservation tolerance scales with the dtype's epsilon."""
+    eps = float(jnp.finfo(dtype).eps)
+    return POS_VOL_FRAC, max(1e-9, 256.0 * eps)
+
+
 def two_phase_winners(
     prio: jax.Array,
     cand: jax.Array,

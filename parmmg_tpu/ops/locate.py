@@ -44,7 +44,8 @@ def tet_barycoords(c: jax.Array, p: jax.Array) -> jax.Array:
     l2 = vol6(v0, v1, p, v3)
     l3 = vol6(v0, v1, v2, p)
     lam = jnp.stack([l0, l1, l2, l3], axis=-1)
-    denom = jnp.where(jnp.abs(v) > 1e-300, v, jnp.where(v >= 0, 1e-300, -1e-300))
+    tiny = jnp.asarray(jnp.finfo(p.dtype).tiny, p.dtype)  # f32-safe floor
+    denom = jnp.where(jnp.abs(v) > tiny, v, jnp.where(v >= 0, tiny, -tiny))
     return lam / denom[..., None]
 
 

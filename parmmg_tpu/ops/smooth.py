@@ -24,8 +24,6 @@ from ..core import tags
 from ..core.mesh import Mesh
 from . import common
 
-_VOL_EPS = 1e-14
-
 
 class SmoothStats(NamedTuple):
     nmoved: jax.Array
@@ -66,12 +64,15 @@ def smooth_vertices(
     )
 
     q_old = common.quality_of(vert0, mesh.met, mesh.tet)
+    # scale-relative inversion floor (common.POS_VOL_FRAC of the
+    # pre-move volume)
+    vol_floor = common.POS_VOL_FRAC * jnp.abs(common.vol_of(vert0, mesh.tet))
 
     def body(_, frozen):
         pos = jnp.where(frozen[:, None], vert0, target)
         q_new = common.quality_of(pos, mesh.met, mesh.tet)
         vol = common.vol_of(pos, mesh.tet)
-        bad = mesh.tmask & ((vol <= _VOL_EPS) | (q_new < qfactor * q_old))
+        bad = mesh.tmask & ((vol <= vol_floor) | (q_new < qfactor * q_old))
         freeze_v = jnp.zeros(pcap, bool)
         idx = jnp.where(bad[:, None], mesh.tet, pcap)
         freeze_v = freeze_v.at[idx.reshape(-1)].set(True, mode="drop")
@@ -83,7 +84,7 @@ def smooth_vertices(
     vol = common.vol_of(pos, mesh.tet)
     q_new = common.quality_of(pos, mesh.met, mesh.tet)
     still_bad = jnp.any(
-        mesh.tmask & ((vol <= _VOL_EPS) | (q_new < qfactor * q_old))
+        mesh.tmask & ((vol <= vol_floor) | (q_new < qfactor * q_old))
     )
     pos = jnp.where(still_bad, vert0, pos)
 

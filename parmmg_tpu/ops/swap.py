@@ -24,7 +24,6 @@ from ..core import tags
 from ..core.mesh import FACE_VERTS, Mesh
 from . import common
 
-_VOL_EPS = 1e-14
 GAIN = 1.02          # required relative quality improvement
 QTHRESH = 0.5        # only try to improve tets worse than this
 
@@ -122,13 +121,13 @@ def swap_32(
         jnp.broadcast_to(vol_all[:, None], (tcap, 6)).reshape(-1), mode="drop"
     )
     new_min = jnp.minimum(q1, q2)
-    conserve = jnp.abs((v1 + v2) - shell_vol) <= 1e-9 * jnp.maximum(
-        shell_vol, 1e-30
-    )
+    pos_frac, cons_tol = common.vol_tols(mesh.dtype)
+    vref = jnp.maximum(shell_vol, 1e-30)
+    conserve = jnp.abs((v1 + v2) - shell_vol) <= cons_tol * vref
     gain_ok = (
         (new_min > GAIN * shell_min_q)
-        & (v1 > _VOL_EPS)
-        & (v2 > _VOL_EPS)
+        & (v1 > pos_frac * vref)
+        & (v2 > pos_frac * vref)
         & conserve
     )
     # the new tets must not already exist
@@ -241,11 +240,14 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
     new_min = jnp.minimum(jnp.minimum(qs[0], qs[1]), qs[2])
     vol_old2 = common.vol_of(mesh.vert, tet)
     pair_vol = vol_old2[t_id] + vol_old2[t2c]
-    conserve = jnp.abs((vs[0] + vs[1] + vs[2]) - pair_vol) <= 1e-9 * jnp.maximum(
-        pair_vol, 1e-30
-    )
+    pos_frac, cons_tol = common.vol_tols(mesh.dtype)
+    vref = jnp.maximum(pair_vol, 1e-30)
+    conserve = jnp.abs((vs[0] + vs[1] + vs[2]) - pair_vol) <= cons_tol * vref
     vol_ok = (
-        (vs[0] > _VOL_EPS) & (vs[1] > _VOL_EPS) & (vs[2] > _VOL_EPS) & conserve
+        (vs[0] > pos_frac * vref)
+        & (vs[1] > pos_frac * vref)
+        & (vs[2] > pos_frac * vref)
+        & conserve
     )
 
     cand = (

@@ -54,7 +54,12 @@ class ShardComm:
 
 
 def split_mesh(
-    mesh: Mesh, part: np.ndarray, nparts: int, headroom: float = 1.5
+    mesh: Mesh,
+    part: np.ndarray,
+    nparts: int,
+    headroom: float = 1.5,
+    assume_adjacency: bool = False,
+    build_shard_adjacency: bool = True,
 ) -> Tuple[Mesh, ShardComm]:
     """Split a host/device Mesh into `nparts` shards per tet partition.
 
@@ -62,9 +67,15 @@ def split_mesh(
     inter-shard interfaces are tagged PARBDY in every shard that holds
     them (freeze discipline, reference `src/tag_pmmg.c:267`); boundary
     trias follow the shard of their adjacent tet; feature edges replicate
-    into every shard containing both endpoints.
+    into every shard containing both endpoints. Pass
+    `assume_adjacency=True` when `mesh.adja` is already fresh to skip the
+    full-mesh rebuild (it is the dominant host cost of resharding), and
+    `build_shard_adjacency=False` when the caller rebuilds per-shard
+    adjacency itself (the distributed driver does, for the interp
+    snapshot).
     """
-    mesh = adjacency.build_adjacency(mesh)
+    if not assume_adjacency:
+        mesh = adjacency.build_adjacency(mesh)
     part = np.asarray(part)
     tmask = np.asarray(mesh.tmask)
     adja = np.asarray(mesh.adja)
@@ -157,7 +168,6 @@ def split_mesh(
         pos = np.searchsorted(vsel, ifc_t * 4 + ifc_f)
         hit = face_tria[fid[pos]]
         m = hit >= 0
-        m &= (trtag_g[np.maximum(hit, 0)] & tags.NOSURF) == 0
         ifc_ref[m] = trref_g[hit[m]]
         # keep the ORIGINAL tria winding on both replicas (tet-face order
         # differs per side and would flip the surface normal for one of
@@ -261,7 +271,8 @@ def split_mesh(
         )
         for d in shard_data
     ]
-    meshes = [adjacency.build_adjacency(m) for m in meshes]
+    if build_shard_adjacency:
+        meshes = [adjacency.build_adjacency(m) for m in meshes]
     stacked = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs), *meshes
     )

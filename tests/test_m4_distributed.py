@@ -88,26 +88,48 @@ def test_global_ids_unique_and_complete(dist_result):
     assert (counts >= 2).all()
 
 
-def test_rebuild_comm_matches_split_tables():
-    """On an unremeshed split, rebuild_comm must reproduce the original
-    shared-vertex lists (same pairs, same counts, same geometric match)."""
+def test_rebuild_comm_matches_geometric_truth():
+    """The gid-derived comm tables must agree with a brute-force
+    COORDINATE match between shard pairs — an implementation-independent
+    ground truth (the role of the reference's geometric chkcomm,
+    `src/chkcomm_pmmg.c:815`)."""
     mesh = unit_cube_mesh(4)
     from parmmg_tpu.parallel.partition import sfc_partition
     from parmmg_tpu.core import adjacency
 
     mesh = adjacency.build_adjacency(mesh)
     part = np.asarray(jax.device_get(sfc_partition(mesh, 8)))
-    st, comm0 = split_mesh(mesh, part, 8)
-    comm1 = rebuild_comm(st)
-    assert np.array_equal(np.asarray(comm0.counts), np.asarray(comm1.counts))
-    # identical slot lists (both orderings are by gid)
-    c0, c1 = np.asarray(comm0.comm_idx), np.asarray(comm1.comm_idx)
-    k = min(c0.shape[2], c1.shape[2])
-    assert np.array_equal(c0[..., :k], c1[..., :k])
-    assert (c0[..., k:] == -1).all() and (c1[..., k:] == -1).all()
-    o0, o1 = np.asarray(comm0.owner), np.asarray(comm1.owner)
-    vm = np.asarray(st.vmask)
-    assert np.array_equal(o0 & vm, o1 & vm)
+    st, comm = split_mesh(mesh, part, 8)
+    comm_idx = np.asarray(comm.comm_idx)
+    counts = np.asarray(comm.counts)
+    vert = np.asarray(st.vert)
+    vmask = np.asarray(st.vmask)
+    D = vert.shape[0]
+    for s in range(D):
+        for r in range(s + 1, D):
+            # ground truth: exact coordinate intersection of live vertices
+            vs = {tuple(v) for v in vert[s][vmask[s]].tolist()}
+            vr = {tuple(v) for v in vert[r][vmask[r]].tolist()}
+            shared_coords = vs & vr
+            assert counts[s, r] == len(shared_coords), (s, r)
+            k = counts[s, r]
+            # the table's matched slots carry the same coordinates in the
+            # same k-order on both sides
+            cs = vert[s][comm_idx[s, r, :k]]
+            cr = vert[r][comm_idx[r, s, :k]]
+            assert np.array_equal(cs, cr), (s, r)
+            assert {tuple(v) for v in cs.tolist()} == shared_coords
+            assert (comm_idx[s, r, k:] == -1).all()
+    # owner: exactly one shard owns each shared vertex
+    owner = np.asarray(comm.owner)
+    l2g = np.asarray(comm.l2g)
+    live = vmask & (l2g >= 0)
+    gids = l2g[live]
+    own_count = np.zeros(gids.max() + 1, np.int64)
+    np.add.at(own_count, l2g[live & owner], 1)
+    present = np.zeros(gids.max() + 1, bool)
+    present[gids] = True
+    assert (own_count[present] == 1).all()
 
 
 def test_quality_parity_away_from_interfaces(dist_result):
@@ -150,3 +172,35 @@ def test_merge_after_coarsening():
     assert _total_volume(merged) == pytest.approx(1.0, rel=1e-5)
     # coarsening actually happened
     assert int(merged.ntet) < int(mesh.ntet)
+
+
+def test_interface_displacement_refines_frozen_bands():
+    """With displacement (default), bands frozen in one iteration are
+    interior in the next — the count of metric-overlong edges left in the
+    output must drop far below the frozen-interfaces (-nobalance) run
+    (reference PMMG_part_moveInterfaces, src/moveinterfaces_pmmg.c:1306)."""
+    from parmmg_tpu.core import adjacency
+    from parmmg_tpu.core import metric as mm
+
+    def nlong(mesh):
+        mesh = adjacency.build_adjacency(mesh)
+        edges, emask, _, _ = adjacency.unique_edges(
+            mesh, int(mesh.tcap * 2) + 64
+        )
+        a, b = edges[:, 0], edges[:, 1]
+        l = mm.edge_length(
+            mesh.vert[a], mesh.vert[b], mesh.met[a], mesh.met[b]
+        )
+        return int((np.asarray(jnp.where(emask, l, 0.0)) > 1.5).sum())
+
+    mesh = unit_cube_mesh(8)
+    counts = {}
+    for nobal in (True, False):
+        opts = DistOptions(
+            nparts=8, niter=3, hsiz=0.1, max_sweeps=8,
+            min_shard_elts=16, nobalancing=nobal,
+        )
+        st, comm, info = adapt_distributed(mesh, opts)
+        counts[nobal] = nlong(merge_adapted(st, comm))
+    # displacement must clear the majority of the frozen long edges
+    assert counts[False] < 0.5 * counts[True], counts
