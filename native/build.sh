@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native Medit tokenizer (see medit_tok.cpp).
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -shared -fPIC -o libmedit_tok.so medit_tok.cpp
+echo "built $(pwd)/libmedit_tok.so"

@@ -1,0 +1,213 @@
+"""CLI: `python -m parmmg_tpu input.mesh [-sol met.sol] [options] [-out out.mesh]`.
+
+The `parmmg` executable role (reference `src/parmmg.c:60` with the flag
+set of `PMMG_parsar`, `src/libparmmg_tools.c:108-163`), on the TPU
+framework: load → adapt (single-shard or distributed over -nparts
+shards) → save, printing the reference-style quality histograms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m parmmg_tpu",
+        description="TPU-native parallel tetrahedral remesher "
+        "(capability parity with the ParMmg CLI)",
+    )
+    p.add_argument("input", help="input .mesh (Medit ASCII)")
+    p.add_argument("-out", "-o", dest="out", default=None,
+                   help="output mesh name (default <input>.o.mesh)")
+    p.add_argument("-sol", "-met", dest="sol", default=None,
+                   help="metric .sol file")
+    p.add_argument("-v", dest="verbose", type=int, default=1,
+                   help="verbosity level")
+    # remeshing controls (Mmg-forwarded flags)
+    p.add_argument("-hsiz", type=float, default=None,
+                   help="constant target edge size")
+    p.add_argument("-hmin", type=float, default=None)
+    p.add_argument("-hmax", type=float, default=None)
+    p.add_argument("-hgrad", type=float, default=None,
+                   help="size gradation ratio (<=0 disables)")
+    p.add_argument("-hausd", type=float, default=None,
+                   help="Hausdorff bound for boundary approximation")
+    p.add_argument("-ar", dest="angle", type=float, default=45.0,
+                   help="ridge-detection dihedral angle (degrees)")
+    p.add_argument("-nr", dest="no_angle", action="store_true",
+                   help="disable angle detection")
+    p.add_argument("-optim", action="store_true",
+                   help="keep mesh-implied sizes, only improve quality")
+    p.add_argument("-noinsert", action="store_true")
+    p.add_argument("-noswap", action="store_true")
+    p.add_argument("-nomove", action="store_true")
+    p.add_argument("-nosurf", action="store_true",
+                   help="freeze the boundary surface exactly")
+    # parallel controls
+    p.add_argument("-niter", type=int, default=3,
+                   help="outer remesh-repartition iterations")
+    p.add_argument("-nparts", type=int, default=1,
+                   help="number of shards (devices); 1 = single-chip")
+    p.add_argument("-nobalance", dest="nobalancing", action="store_true",
+                   help="disable interface displacement between iterations")
+    p.add_argument("-nlayers", dest="ifc_layers", type=int, default=2,
+                   help="interface-displacement advancing-front depth")
+    p.add_argument("-groups-ratio", dest="grps_ratio", type=float,
+                   default=2.0, help="max shard imbalance before SFC recut")
+    p.add_argument("-mesh-size", dest="mesh_size", type=int, default=None,
+                   help="accepted for parity (remesher target size)")
+    p.add_argument("-pure-partitioning", action="store_true",
+                   help="partition + save only, no remeshing")
+    p.add_argument("-distributed-output", dest="dist_out",
+                   action="store_true",
+                   help="save per-shard name.<rank>.mesh files")
+    p.add_argument("-centralized-output", dest="cent_out",
+                   action="store_true")
+    p.add_argument("-distributed-input", dest="dist_in",
+                   action="store_true",
+                   help="input is per-shard name.<rank>.mesh files")
+    p.add_argument("-ls", type=float, nargs="?", const=0.0, default=None,
+                   help="level-set discretization at the given isovalue")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import numpy as np
+
+    from .io import medit
+    from .models.adapt import AdaptOptions, adapt
+    from .models.distributed import (
+        DistOptions,
+        adapt_distributed,
+        adapt_stacked_input,
+        merge_adapted,
+    )
+    from .ops import quality
+    from .utils.timing import Timers
+
+    timers = Timers(enabled=args.verbose >= 1)
+    out = args.out or (os.path.splitext(args.input)[0] + ".o.mesh")
+    angle = None if args.no_angle else args.angle
+    hgrad = (
+        None if (args.hgrad is not None and args.hgrad <= 0)
+        else (args.hgrad if args.hgrad is not None else 1.3)
+    )
+
+    opts = DistOptions(
+        niter=args.niter,
+        hsiz=args.hsiz, hmin=args.hmin, hmax=args.hmax,
+        hgrad=hgrad, hausd=args.hausd, angle=angle,
+        optim=args.optim,
+        noinsert=args.noinsert, noswap=args.noswap,
+        nomove=args.nomove, nosurf=args.nosurf,
+        verbose=args.verbose,
+        nparts=args.nparts,
+        nobalancing=args.nobalancing,
+        ifc_layers=args.ifc_layers,
+        grps_ratio=args.grps_ratio,
+    )
+
+    with timers.phase("input"):
+        if args.dist_in:
+            stacked, comm = medit.load_mesh_distributed(
+                args.input, args.nparts, metpath=args.sol
+            )
+            mesh = None
+        else:
+            mesh = medit.load_mesh(args.input, args.sol)
+
+    if args.ls is not None:
+        try:
+            from .models.levelset import discretize_levelset
+        except ImportError:
+            # capability parity with the reference, which gates -ls off
+            # (`src/libparmmg.c:73-76`: "level-set discretization is not
+            # yet available with parallel remeshing")
+            print("  ## Error: level-set discretization is not yet "
+                  "available with parallel remeshing. Exit program.",
+                  file=sys.stderr)
+            return 1
+        with timers.phase("level-set"):
+            if mesh is None:
+                print("level-set mode requires centralized input",
+                      file=sys.stderr)
+                return 1
+            mesh = discretize_levelset(mesh, isovalue=args.ls)
+
+    if args.pure_partitioning:
+        import jax
+
+        from .parallel.distribute import split_mesh
+        from .parallel.partition import sfc_partition
+
+        with timers.phase("partitioning"):
+            part = np.asarray(
+                jax.device_get(sfc_partition(mesh, args.nparts))
+            )
+            stacked, comm = split_mesh(mesh, part, args.nparts)
+        with timers.phase("output"):
+            medit.save_mesh_distributed(stacked, comm, out,
+                                        with_met=mesh.met_set)
+        timers.report()
+        return 0
+
+    with timers.phase("remeshing"):
+        if args.dist_in:
+            stacked, comm, info = adapt_stacked_input(stacked, comm, opts)
+            mesh_out = None
+        elif args.nparts > 1:
+            stacked, comm, info = adapt_distributed(mesh, opts)
+            mesh_out = None
+        else:
+            aopts = AdaptOptions(
+                niter=opts.niter, hsiz=opts.hsiz, hmin=opts.hmin,
+                hmax=opts.hmax, hgrad=opts.hgrad, hausd=opts.hausd,
+                angle=opts.angle, optim=opts.optim,
+                noinsert=opts.noinsert, noswap=opts.noswap,
+                nomove=opts.nomove, nosurf=opts.nosurf,
+                verbose=opts.verbose,
+            )
+            mesh_out, info = adapt(mesh, aopts)
+
+    if args.verbose >= 1:
+        print(quality.format_histogram(info["qual_in"],
+                                       "INPUT MESH QUALITY"))
+        print(quality.format_histogram(info["qual_out"],
+                                       "OUTPUT MESH QUALITY"))
+
+    with timers.phase("output"):
+        distributed_out = args.dist_out or (
+            (args.dist_in or args.nparts > 1) and not args.cent_out
+            and args.dist_out
+        )
+        vtk = out.endswith((".vtu", ".pvtu"))
+        if mesh_out is None and (args.dist_out and not args.cent_out):
+            if vtk:
+                from .io import vtk as vtk_io
+
+                vtk_io.save_pvtu(stacked, comm, out)
+            else:
+                medit.save_mesh_distributed(stacked, comm, out,
+                                            with_met=True)
+        else:
+            if mesh_out is None:
+                mesh_out = merge_adapted(stacked, comm)
+            if vtk:
+                from .io import vtk as vtk_io
+
+                vtk_io.save_vtu(mesh_out, out)
+            else:
+                medit.save_mesh(mesh_out, out)
+                medit.save_met(mesh_out,
+                               os.path.splitext(out)[0] + ".sol")
+    timers.report()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

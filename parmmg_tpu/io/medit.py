@@ -56,6 +56,8 @@ _ENT_SECTIONS = {
 def _tokenize(path: str) -> List[str]:
     from . import native_io
 
+    if not os.path.exists(path):  # uniform error across both backends
+        raise FileNotFoundError(f"mesh file not found: {path}")
     if native_io.available():
         return native_io.tokenize(path)
     with open(path) as f:
@@ -377,3 +379,59 @@ def shard_filename(path: str, rank: int) -> str:
     """`name.mesh -> name.<rank>.mesh` (reference `PMMG_insert_rankIndex:387`)."""
     base, ext = os.path.splitext(path)
     return f"{base}.{rank}{ext}"
+
+
+def save_mesh_distributed(stacked: Mesh, comm, path: str,
+                          with_met: bool = False) -> None:
+    """Write per-shard `name.<rank>.mesh` files with the parallel
+    interface as `ParallelVertexCommunicators` sections — the
+    distributed-output path of the reference
+    (`PMMG_saveMesh_distributed`, `src/inout_pmmg.c:798`). The node
+    tables come from the live `ShardComm` (colors = neighbor shard ids,
+    global ids from `l2g`), so a later `load_mesh_distributed` restores
+    an equivalent ShardComm: the checkpoint/resume loop of SURVEY §5."""
+    from ..parallel.distribute import unstack_mesh
+
+    comm_idx = np.asarray(comm.comm_idx)
+    counts = np.asarray(comm.counts)
+    l2g = np.asarray(comm.l2g)
+    D = comm_idx.shape[0]
+    for s, m in enumerate(unstack_mesh(stacked)):
+        node_comms = []
+        for r in range(D):
+            c = int(counts[s, r])
+            if r == s or c == 0:
+                continue
+            loc = comm_idx[s, r, :c]
+            node_comms.append((r, loc, l2g[s][loc]))
+        save_mesh(m, shard_filename(path, s), node_comms=node_comms)
+        if with_met:
+            base, _ = os.path.splitext(shard_filename(path, s))
+            save_met(m, base + ".sol")
+
+
+def load_mesh_distributed(path: str, nparts: int, metpath: str | None = None,
+                          **kw):
+    """Read per-shard `name.<rank>.mesh` files (+ optional per-shard
+    metric sols) and rebuild (stacked Mesh, ShardComm) — the reference's
+    `PMMG_loadMesh_distributed` + communicator build
+    (`src/inout_pmmg.c:440`, `src/libparmmg.c:206-314`)."""
+    from ..parallel.distribute import stack_loaded_shards
+
+    raws = [read_mesh(shard_filename(path, s)) for s in range(nparts)]
+    stacked, comm = stack_loaded_shards(raws, **kw)
+    if metpath is not None:
+        import jax.numpy as jnp
+
+        mets = []
+        for s in range(nparts):
+            vals, types = read_sol(shard_filename(metpath, s))
+            ncomp = _SOL_NCOMP[types[0]]
+            met = np.ones((stacked.met.shape[1], ncomp))
+            met[: len(vals)] = vals[:, :ncomp]
+            mets.append(met)
+        stacked = stacked.replace(
+            met=jnp.asarray(np.stack(mets), stacked.vert.dtype),
+            met_set=True,
+        )
+    return stacked, comm

@@ -26,6 +26,18 @@ def _load():
         return _LIB
     _TRIED = True
     path = _lib_path()
+    if not os.path.exists(path):
+        # first use: try a quiet in-tree build (g++ is part of the
+        # supported toolchain); any failure falls back to pure Python
+        import subprocess
+
+        try:
+            subprocess.run(
+                ["sh", os.path.join(os.path.dirname(path), "build.sh")],
+                capture_output=True, timeout=120, check=False,
+            )
+        except Exception:
+            pass
     if os.path.exists(path):
         try:
             lib = ctypes.CDLL(path)

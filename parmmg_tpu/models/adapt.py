@@ -35,9 +35,16 @@ class AdaptOptions:
     hmin: Optional[float] = None
     hmax: Optional[float] = None
     hgrad: Optional[float] = 1.3    # size gradation (-hgrad), None = off
+    # Hausdorff bound for boundary modification (-hausd); None = auto,
+    # 0.01 x bounding-box diagonal (the reference scales the mesh to a
+    # unit box and uses hausd=0.01, MMG5_HAUSD default)
+    hausd: Optional[float] = None
+    # feature-detection dihedral angle in degrees (-ar); None = -nr
+    # (no angle detection)
+    angle: Optional[float] = 45.0
     optim: bool = False         # keep implied sizes (-optim)
     noinsert: bool = False      # -noinsert: no splits
-    nosurf: bool = False        # reserved (surface freeze)
+    nosurf: bool = False        # -nosurf: freeze the boundary surface
     noswap: bool = False        # -noswap
     nomove: bool = False        # -nomove
     # convergence: stop sweeping when ops this sweep < frac * ntet
@@ -57,13 +64,18 @@ class SweepStats(NamedTuple):
     split_capped: jax.Array
 
 
-@partial(jax.jit, static_argnames=("ecap", "noinsert", "noswap", "nomove"))
+@partial(
+    jax.jit,
+    static_argnames=("ecap", "noinsert", "noswap", "nomove", "nosurf"),
+)
 def remesh_sweep(
     mesh: Mesh,
     ecap: int,
     noinsert: bool = False,
     noswap: bool = False,
     nomove: bool = False,
+    nosurf: bool = False,
+    hausd: float = 0.01,
 ):
     """One fused sweep: split → collapse → swaps → smooth.
 
@@ -72,14 +84,18 @@ def remesh_sweep(
     mesh = compact(mesh)
     edges, emask, t2e, n_unique = adjacency.unique_edges(mesh, ecap)
     if not noinsert:
-        mesh, s_split = split.split_long_edges(mesh, edges, emask, t2e)
+        mesh, s_split = split.split_long_edges(
+            mesh, edges, emask, t2e, nosurf=nosurf
+        )
         mesh = compact(mesh)
         edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
         n_unique = jnp.maximum(n_unique, nu)
     else:
         s_split = split.SplitStats(jnp.int32(0), jnp.int32(0), jnp.bool_(False))
 
-    mesh, s_col = collapse.collapse_short_edges(mesh, edges, emask, t2e)
+    mesh, s_col = collapse.collapse_short_edges(
+        mesh, edges, emask, t2e, hausd=hausd, nosurf=nosurf
+    )
     mesh = compact(mesh)
     edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
     n_unique = jnp.maximum(n_unique, nu)
@@ -98,7 +114,7 @@ def remesh_sweep(
         nswap = jnp.int32(0)
 
     if not nomove:
-        mesh, s_sm = smooth.smooth_vertices(mesh, edges, emask)
+        mesh, s_sm = smooth.smooth_vertices(mesh, edges, emask, nosurf=nosurf)
         nmoved = s_sm.nmoved
     else:
         nmoved = jnp.int32(0)
@@ -111,6 +127,18 @@ def remesh_sweep(
         n_unique=n_unique,
         split_capped=s_split.capped,
     )
+
+
+def resolve_hausd(mesh: Mesh, opts: AdaptOptions) -> float:
+    """-hausd value, defaulting to 0.01 x bounding-box diagonal (the
+    reference applies Mmg's default hausd=0.01 on the unit-scaled mesh,
+    `MMG5_scaleMesh` at `src/libparmmg1.c:727`)."""
+    if opts.hausd is not None:
+        return float(opts.hausd)
+    lo = jnp.min(jnp.where(mesh.vmask[:, None], mesh.vert, jnp.inf), axis=0)
+    hi = jnp.max(jnp.where(mesh.vmask[:, None], mesh.vert, -jnp.inf), axis=0)
+    diag = float(jax.device_get(jnp.linalg.norm(hi - lo)))
+    return 0.01 * (diag if diag > 0 else 1.0)
 
 
 def prepare_metric(mesh: Mesh, opts: AdaptOptions, ecap: int) -> Mesh:
@@ -130,7 +158,7 @@ def prepare_metric(mesh: Mesh, opts: AdaptOptions, ecap: int) -> Mesh:
         ).astype(mesh.dtype)
     met = metric_mod.apply_hbounds(met, opts.hmin, opts.hmax)
     mesh = mesh.replace(met=met, met_set=True)
-    if opts.hgrad is not None and met.shape[1] == 1:
+    if opts.hgrad is not None:
         # honor unique_edges' overflow contract: retry with a larger cap
         # so gradation sees every edge
         while True:
@@ -138,7 +166,12 @@ def prepare_metric(mesh: Mesh, opts: AdaptOptions, ecap: int) -> Mesh:
             if int(nu) <= ecap:
                 break
             ecap = int(int(nu) * 1.1) + 64
-        met = metric_mod.gradate_iso(
+        gradate = (
+            metric_mod.gradate_iso
+            if met.shape[1] == 1
+            else metric_mod.gradate_aniso
+        )
+        met = gradate(
             mesh.vert, mesh.met, edges, emask, hgrad=opts.hgrad
         )
         mesh = mesh.replace(met=met)
@@ -258,8 +291,9 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     emult = [1.6]
 
     mesh = ensure_capacity(mesh, opts)
-    mesh = analysis.analyze(mesh)
+    mesh = analysis.analyze(mesh, ang=opts.angle)
     mesh = prepare_metric(mesh, opts, int(mesh.tcap * emult[0]) + 64)
+    hausd = resolve_hausd(mesh, opts)
     h0 = quality.quality_histogram(mesh)
 
     # pre-size capacities for the predicted unit mesh so sweeps compile
@@ -281,6 +315,8 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
             noinsert=opts.noinsert,
             noswap=opts.noswap,
             nomove=opts.nomove,
+            nosurf=opts.nosurf,
+            hausd=hausd,
         )
         rec = dict(
             nsplit=int(st.nsplit),
@@ -305,8 +341,5 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
 
     mesh = compact(mesh)
     h1 = quality.quality_histogram(mesh)
-    if opts.verbose >= 1:
-        print(quality.format_histogram(h0, "INPUT MESH QUALITY"))
-        print(quality.format_histogram(h1, "OUTPUT MESH QUALITY"))
     info = dict(history=history, qual_in=h0, qual_out=h1)
     return mesh, info

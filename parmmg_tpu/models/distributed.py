@@ -49,6 +49,7 @@ from .adapt import (
     estimate_target_ntet,
     prepare_metric,
     remesh_sweep,
+    resolve_hausd,
     run_sweep_loop,
 )
 
@@ -130,20 +131,22 @@ def ensure_capacity_stacked(st: Mesh, opts: AdaptOptions) -> Mesh:
 # stacked remesh phase (one outer iteration's operator sweeps)
 # ---------------------------------------------------------------------------
 
-def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions):
+def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions, hausd: float):
     fn = partial(
         remesh_sweep,
         ecap=ecap,
         noinsert=opts.noinsert,
         noswap=opts.noswap,
         nomove=opts.nomove,
+        nosurf=opts.nosurf,
+        hausd=hausd,
     )
     return jax.vmap(fn)(st)
 
 
 def remesh_phase(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
-    it: int,
+    it: int, hausd: float = 0.01,
 ) -> Mesh:
     """Operator sweeps to convergence on every shard at once (vmapped) —
     the batched analog of the per-group `MMG5_mmg3d1_delone` calls in the
@@ -151,7 +154,7 @@ def remesh_phase(
     shared `run_sweep_loop` engine with cross-shard-aggregated stats."""
 
     def sweep_fn(s, ecap):
-        s, stats = _vsweep(s, ecap, opts)
+        s, stats = _vsweep(s, ecap, opts, hausd)
         rec = dict(
             nsplit=int(jnp.sum(stats.nsplit)),
             ncollapse=int(jnp.sum(stats.ncollapse)),
@@ -233,9 +236,10 @@ def adapt_distributed(
 
     # --- preprocess (reference PMMG_preprocessMesh, src/libparmmg.c:128) --
     mesh = adjacency.build_adjacency(mesh)
-    mesh = analysis.analyze(mesh)
+    mesh = analysis.analyze(mesh, ang=opts.angle)
     ecap0 = int(mesh.tcap * 1.6) + 64
     mesh = prepare_metric(mesh, opts, ecap0)
+    hausd = resolve_hausd(mesh, opts)
     h_in = quality.quality_histogram(mesh)
 
     # a mesh too small for nparts shards is grown single-shard first, so
@@ -259,92 +263,218 @@ def adapt_distributed(
     stacked = _presize_for_target(stacked)
 
     history: List[dict] = []
-    emult = [1.6]
-    icap = None
-    for it in range(opts.niter):
-        # snapshot for interpolation (PMMG_update_oldGrps role,
-        # src/grpsplit_pmmg.c:1224) — needs fresh adjacency for the walk
-        old = jax.vmap(adjacency.build_adjacency)(stacked)
-
-        stacked = remesh_phase(stacked, opts, emult, history, it)
-        stacked = jax.vmap(compact)(stacked)
-
-        # interpolate metric + fields from the snapshot
-        stacked = interp_phase(stacked, old)
-
-        if opts.check_comm:
-            from ..parallel import chkcomm
-            from ..parallel.shard import device_mesh
-
-            # comm rebuild from persistent gids (replaces the reference's
-            # face-hash remap at src/libparmmg1.c:361); outside this
-            # debug check the tables are rebuilt where next consumed —
-            # in the balancing branch and after the loop
-            comm = rebuild_comm(stacked, icap)
-            icap = comm.icap
-            chkcomm.assert_comm_ok(
-                stacked, comm, device_mesh(nparts), tol=1e-6
-            )
-
-        # --- load balancing / interface displacement ----------------------
-        # (reference PMMG_loadBalancing, src/loadbalancing_pmmg.c:44, in
-        # ifc-displacement mode src/moveinterfaces_pmmg.c:1306): the old
-        # per-tet colors advance `ifc_layers` layers across interfaces
-        # under a per-iteration priority permutation, so every band frozen
-        # this iteration is interior in the next. Host resharding via
-        # merge+split; skipped after the last iteration.
-        if not opts.nobalancing and it < opts.niter - 1 and nparts > 1:
-            stacked = assign_global_ids(stacked)
-            comm = rebuild_comm(stacked, icap)
-            shard_ne = [
-                int(m.ntet) for m in unstack_mesh(stacked)
-            ]
-            merged = adjacency.build_adjacency(merge_shards(stacked, comm))
-            # advancing-front displacement, bigger-group-wins with a
-            # fixed tie-break (round_id=0) so fronts move monotonically —
-            # each iteration's frozen band was interior, hence remeshed,
-            # in an earlier iteration. Provenance colors: merge
-            # concatenates live tets in shard order.
-            part = np.full(merged.tcap, -1, np.int64)
-            part[: sum(shard_ne)] = np.repeat(
-                np.arange(nparts), shard_ne
-            )
-            part = displace_partition(
-                part,
-                np.asarray(merged.adja),
-                np.asarray(merged.tmask),
-                nparts,
-                round_id=0,
-                layers=opts.ifc_layers,
-            )
-            # GRPS_RATIO discipline (reference src/parmmg.h:218-227):
-            # when accumulated displacement skews shard sizes past the
-            # ratio, rebalance with a fresh SFC cut instead. Its
-            # interfaces fall near earlier cut planes, whose bands were
-            # remeshed while displaced — adapted, merely re-frozen.
-            # Ratio is max-vs-mean: uniform capacities and per-device
-            # wall-clock are governed by the LARGEST shard (a floored
-            # tiny shard is waste, not cost — min-based ratios fire on
-            # every small-mesh run and cancel the displacement).
-            tm = np.asarray(merged.tmask)
-            counts = np.bincount(part[tm], minlength=nparts)
-            if counts.max() > opts.grps_ratio * counts.mean():
-                part = np.asarray(
-                    jax.device_get(sfc_partition(merged, nparts))
-                )
-            stacked, comm = split_mesh(
-                merged, part, nparts, assume_adjacency=True,
-                build_shard_adjacency=False,
-            )
-            icap = None  # interface sets changed; re-derive table shape
-            stacked = _presize_for_target(stacked)
-
-    stacked = assign_global_ids(stacked)
-    comm = rebuild_comm(stacked, icap)
+    stacked, comm, status = _iteration_loop(stacked, opts, hausd, history)
     h_out = quality.merge_stacked_histograms(
         jax.vmap(quality.quality_histogram)(stacked)
     )
-    info = dict(history=history, qual_in=h_in, qual_out=h_out)
+    info = dict(history=history, qual_in=h_in, qual_out=h_out,
+                status=status)
+    return stacked, comm, info
+
+
+def _finite_ok(stacked: Mesh) -> bool:
+    """Cheap sanity reduce at iteration boundaries (the role of the
+    reference's per-phase `MPI_Allreduce(ier, MIN)` agreement,
+    `src/libparmmg1.c:812,831`): all live coordinates/metrics finite."""
+    v_ok = jnp.all(
+        jnp.where(stacked.vmask[..., None], jnp.isfinite(stacked.vert), True)
+    )
+    m_ok = jnp.all(
+        jnp.where(stacked.vmask[..., None], jnp.isfinite(stacked.met), True)
+    )
+    return bool(jax.device_get(v_ok & m_ok))
+
+
+def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
+                    history: List[dict], icap0: int | None = None):
+    """The niter remesh/interpolate/rebalance iterations shared by the
+    centralized (`adapt_distributed`) and distributed-input
+    (`adapt_stacked_input`) entry points — the `PMMG_parmmglib1` body
+    (`src/libparmmg1.c:636-896`). Returns (stacked, comm, status) with
+    global ids assigned and comm tables rebuilt.
+
+    Graded failure (`failed_handling`, `src/libparmmg1.c:970-1011` and
+    `PMMG_SUCCESS/LOWFAILURE/STRONGFAILURE`, `src/libparmmgtypes.h:45-66`):
+    a phase failure inside an iteration falls back to the snapshot taken
+    at that iteration's start — still a conformal, saveable mesh — and
+    returns LOWFAILURE instead of raising; only an unusable initial state
+    raises through (STRONGFAILURE is the caller's exception path).
+    """
+    nparts = opts.nparts
+    emult = [1.6]
+    icap = icap0
+    comm = None
+    status = tags.ReturnStatus.SUCCESS
+    last_good = stacked
+    for it in range(opts.niter):
+        try:
+            stacked, comm, icap = _one_iteration(
+                stacked, opts, hausd, history, it, comm, icap, emult,
+                nparts,
+            )
+            if not _finite_ok(stacked):
+                raise FloatingPointError(
+                    f"non-finite coordinates/metric after iteration {it}"
+                )
+            last_good = stacked
+        except (FloatingPointError, ValueError, RuntimeError,
+                OverflowError) as e:
+            # numeric/capacity failures degrade gracefully; programming
+            # errors (TypeError, trace errors, ...) propagate — hiding
+            # them as LOWFAILURE would mask defects
+            history.append(dict(iter=it, failure=str(e)))
+            stacked = last_good
+            status = tags.ReturnStatus.LOWFAILURE
+            icap = None
+            break
+
+    stacked = assign_global_ids(stacked)
+    comm = rebuild_comm(stacked, icap)
+    return stacked, comm, status
+
+
+def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
+                   nparts):
+    # snapshot for interpolation (PMMG_update_oldGrps role,
+    # src/grpsplit_pmmg.c:1224) — needs fresh adjacency for the walk
+    old = jax.vmap(adjacency.build_adjacency)(stacked)
+
+    stacked = remesh_phase(stacked, opts, emult, history, it, hausd)
+    stacked = jax.vmap(compact)(stacked)
+
+    # interpolate metric + fields from the snapshot
+    stacked = interp_phase(stacked, old)
+
+    if opts.check_comm:
+        from ..parallel import chkcomm
+        from ..parallel.shard import device_mesh
+
+        # comm rebuild from persistent gids (replaces the reference's
+        # face-hash remap at src/libparmmg1.c:361); outside this
+        # debug check the tables are rebuilt where next consumed —
+        # in the balancing branch and after the loop
+        comm = rebuild_comm(stacked, icap)
+        icap = comm.icap
+        chkcomm.assert_comm_ok(
+            stacked, comm, device_mesh(nparts), tol=1e-6
+        )
+
+    # --- load balancing / interface displacement ----------------------
+    # (reference PMMG_loadBalancing, src/loadbalancing_pmmg.c:44, in
+    # ifc-displacement mode src/moveinterfaces_pmmg.c:1306): the old
+    # per-tet colors advance `ifc_layers` layers across interfaces
+    # under a per-iteration priority permutation, so every band frozen
+    # this iteration is interior in the next. Host resharding via
+    # merge+split; skipped after the last iteration.
+    if not opts.nobalancing and it < opts.niter - 1 and nparts > 1:
+        stacked = assign_global_ids(stacked)
+        comm = rebuild_comm(stacked, icap)
+        shard_ne = [
+            int(m.ntet) for m in unstack_mesh(stacked)
+        ]
+        merged = adjacency.build_adjacency(merge_shards(stacked, comm))
+        # advancing-front displacement, bigger-group-wins with a
+        # fixed tie-break (round_id=0) so fronts move monotonically —
+        # each iteration's frozen band was interior, hence remeshed,
+        # in an earlier iteration. Provenance colors: merge
+        # concatenates live tets in shard order.
+        part = np.full(merged.tcap, -1, np.int64)
+        part[: sum(shard_ne)] = np.repeat(
+            np.arange(nparts), shard_ne
+        )
+        part = displace_partition(
+            part,
+            np.asarray(merged.adja),
+            np.asarray(merged.tmask),
+            nparts,
+            round_id=0,
+            layers=opts.ifc_layers,
+        )
+        # GRPS_RATIO discipline (reference src/parmmg.h:218-227):
+        # when accumulated displacement skews shard sizes past the
+        # ratio, rebalance with a fresh SFC cut instead. Its
+        # interfaces fall near earlier cut planes, whose bands were
+        # remeshed while displaced — adapted, merely re-frozen.
+        # Ratio is max-vs-mean: uniform capacities and per-device
+        # wall-clock are governed by the LARGEST shard (a floored
+        # tiny shard is waste, not cost — min-based ratios fire on
+        # every small-mesh run and cancel the displacement).
+        tm = np.asarray(merged.tmask)
+        counts = np.bincount(part[tm], minlength=nparts)
+        if counts.max() > opts.grps_ratio * counts.mean():
+            part = np.asarray(
+                jax.device_get(sfc_partition(merged, nparts))
+            )
+        stacked, comm = split_mesh(
+            merged, part, nparts, assume_adjacency=True,
+            build_shard_adjacency=False,
+        )
+        icap = None  # interface sets changed; re-derive table shape
+        stacked = _presize_for_target(stacked)
+
+    return stacked, comm, icap
+
+
+def adapt_stacked_input(
+    stacked: Mesh,
+    comm: Optional[ShardComm],
+    opts: Optional[DistOptions] = None,
+):
+    """Adapt a mesh supplied already-distributed (per-shard stacked Mesh
+    with PARBDY interface tags and `vglob` seeded on interface vertices)
+    — the reference's distributed entry
+    (`PMMG_parmmglib_distributed` + `PMMG_preprocessMesh_distributed`,
+    `src/libparmmg.c:1519,206`). Use `parallel.distribute.
+    stack_loaded_shards` / `io.medit.load_mesh_distributed` to build the
+    input from per-rank files.
+
+    Returns (stacked, comm, info) like `adapt_distributed`.
+    """
+    opts = opts or DistOptions()
+    opts = dataclasses.replace(opts, nparts=stacked.vert.shape[0])
+
+    # per-shard preprocess: adjacency + analysis + metric (the reference
+    # preprocesses each rank's mesh then runs PMMG_analys; cross-shard
+    # feature agreement is conservative — interface entities are frozen
+    # and NOSURF interface trias are excluded from dihedral detection)
+    shards = []
+    ecap0 = int(stacked.tet.shape[1] * 1.6) + 64
+    for m in unstack_mesh(stacked):
+        m = analysis.analyze(m, ang=opts.angle)
+        m = prepare_metric(m, opts, ecap0)
+        shards.append(m)
+    fcaps = {m.fcap for m in shards}
+    ecaps = {m.ecap for m in shards}
+    if len(fcaps) > 1 or len(ecaps) > 1:  # analysis growth diverged
+        fc, ec = max(fcaps), max(ecaps)
+        shards = [m.with_capacity(fcap=fc, ecap=ec) for m in shards]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *shards)
+    if opts.hausd is not None:
+        hausd = float(opts.hausd)
+    else:  # global bounding box across shards
+        w = stacked.vmask[..., None]
+        lo = jnp.min(jnp.where(w, stacked.vert, jnp.inf), axis=(0, 1))
+        hi = jnp.max(jnp.where(w, stacked.vert, -jnp.inf), axis=(0, 1))
+        diag = float(jax.device_get(jnp.linalg.norm(hi - lo)))
+        hausd = 0.01 * (diag if diag > 0 else 1.0)
+    h_in = quality.merge_stacked_histograms(
+        jax.vmap(quality.quality_histogram)(stacked)
+    )
+
+    stacked = _presize_for_target(stacked)
+    history: List[dict] = []
+    # the supplied comm's tables stay valid in shape (interfaces are
+    # frozen, shared lists can only shrink): reuse its capacity so the
+    # rebuilt tables keep a stable static shape across iterations
+    stacked, comm, status = _iteration_loop(
+        stacked, opts, hausd, history,
+        icap0=comm.icap if comm is not None else None,
+    )
+    h_out = quality.merge_stacked_histograms(
+        jax.vmap(quality.quality_histogram)(stacked)
+    )
+    info = dict(history=history, qual_in=h_in, qual_out=h_out,
+                status=status)
     return stacked, comm, info
 
 

@@ -1,17 +1,28 @@
 """Batched edge collapse: coarsen every metric-short edge in parallel.
 
-Counterpart of the coarsening half of Mmg's kernel (`MMG5_mmg3d1_delone` via
-reference `src/libparmmg1.c:739`). A candidate short edge (src→dst) removes
-vertex src and retargets its ball onto dst. Independent-set selection uses
-the union of tets touching either endpoint as the conflict arena, which
-guarantees (a) each vertex joins at most one collapse per sweep and (b)
-simultaneous application is safe. Validity = positive volumes + bounded
-quality loss; topological safety (Mmg's link condition) is enforced by a
-vectorized duplicate-tet detector on the tentative configuration.
+Counterpart of the coarsening half of Mmg's kernel (`MMG5_mmg3d1_delone`
+via reference `src/libparmmg1.c:739`), including the boundary discipline
+of `MMG5_colver`/`chkcol_bdy`: a candidate short edge (src→dst) removes
+vertex src and retargets its ball onto dst. Independent-set selection
+uses the union of tets touching either endpoint as the conflict arena,
+which guarantees (a) each vertex joins at most one collapse per sweep and
+(b) simultaneous application is safe. Validity = positive volumes +
+bounded quality loss; topological safety (Mmg's link condition) is
+enforced by a vectorized duplicate-tet detector on the tentative
+configuration.
 
-Round-1 scope: interior vertices only — boundary/ridge collapses arrive
-with the surface-analysis milestone (Hausdorff control), so the boundary
-surface is preserved exactly.
+Boundary discipline (batched re-design of `chkcol_bdy`):
+ - vertex classes order collapsibility: free interior > regular surface >
+   feature-line (ridge/ref) vertex; corners, required, non-manifold and
+   parallel-interface vertices are never removed (`MG_CORNER`/`MG_REQ`/
+   `MG_PARBDY` semantics, reference `src/tag_pmmg.c`).
+ - a surface vertex may only slide along a *surface* edge, a feature
+   vertex only along a *feature* edge — the collapse stays on the
+   geometry it discretizes.
+ - surface fidelity: every retargeted boundary tria must keep its
+   orientation within the dihedral threshold (no folds, no new ridges)
+   and the removed vertex must stay within `hausd` of the new surface
+   (the Hausdorff control of Mmg's `-hausd`).
 """
 
 from __future__ import annotations
@@ -26,6 +37,14 @@ from ..core import metric as metric_mod
 from ..core import tags
 from ..core.mesh import Mesh
 from . import common
+from .analysis import surf_tria_mask
+
+_FEAT_BITS = tags.RIDGE | tags.REF | tags.NOM
+# vertices that can never be removed
+_HARD = tags.REQUIRED | tags.CORNER | tags.PARBDY | tags.NOM | tags.OVERLAP
+# normal-deviation bound for retargeted surface trias (cos 45deg — the
+# angle-detection threshold: a collapse must not create a new ridge)
+_COS_SURF = 0.70710678
 
 
 class CollapseStats(NamedTuple):
@@ -33,32 +52,67 @@ class CollapseStats(NamedTuple):
     ncand: jax.Array
     nrej_geom: jax.Array   # rejected by volume/quality
     nrej_topo: jax.Array   # rejected by duplicate-tet (link) check
+    nrej_surf: jax.Array   # rejected by surface fidelity (fold/hausd)
+    nsurf: jax.Array       # accepted collapses that moved the surface
 
 
-@partial(jax.jit, static_argnames=("lshrt",), donate_argnums=0)
+@partial(jax.jit, static_argnames=("lshrt", "nosurf"), donate_argnums=0)
 def collapse_short_edges(
     mesh: Mesh,
     edges: jax.Array,
     emask: jax.Array,
     t2e: jax.Array,
     lshrt: float = float(metric_mod.LSHRT),
+    hausd: float = 0.01,
+    nosurf: bool = False,
 ):
     """One collapse sweep. Mesh must be compacted; adjacency left stale."""
     ecap = edges.shape[0]
-    tcap, pcap = mesh.tcap, mesh.pcap
+    tcap, pcap, fcap = mesh.tcap, mesh.pcap, mesh.fcap
     tet, tmask = mesh.tet, mesh.tmask
 
     a, b = edges[:, 0], edges[:, 1]
     l = metric_mod.edge_length(
         mesh.vert[a], mesh.vert[b], mesh.met[a], mesh.met[b]
     )
-    interior = mesh.vmask & (
-        (mesh.vtag & (tags.UNCOLLAPSIBLE | tags.BDY | tags.OVERLAP)) == 0
+
+    # --- vertex classes ---------------------------------------------------
+    vt = mesh.vtag
+    hard = (vt & _HARD) != 0
+    bdy_v = (vt & tags.BDY) != 0
+    feat_v = (vt & _FEAT_BITS) != 0
+    free_i = mesh.vmask & ~hard & ~bdy_v
+    surf_v = mesh.vmask & ~hard & bdy_v & ~feat_v
+    ridge_v = mesh.vmask & ~hard & bdy_v & feat_v
+    score = (
+        3 * free_i.astype(jnp.int32)
+        + 2 * surf_v.astype(jnp.int32)
+        + ridge_v.astype(jnp.int32)
     )
-    ra, rb = interior[a], interior[b]
-    cand = emask & (l < lshrt) & (ra | rb)
-    src = jnp.where(ra, a, b)
-    dst = jnp.where(ra, b, a)
+    if nosurf:
+        score = jnp.where(free_i, 3, 0)
+
+    # --- edge classes -----------------------------------------------------
+    smask = surf_tria_mask(mesh)
+    tri_keys = common.tria_edge_keys(mesh, smask)
+    surf_e = common.sorted_membership(
+        tri_keys, jnp.where(emask[:, None], edges, -1)
+    )
+    feat = common.feature_edge_index(mesh, edges, emask)
+    feat_tag = jnp.where(feat >= 0, mesh.edtag[jnp.maximum(feat, 0)], 0)
+    feat_e = (feat_tag & _FEAT_BITS) != 0
+
+    sa, sb = score[a], score[b]
+    src_is_a = sa >= sb
+    src = jnp.where(src_is_a, a, b)
+    dst = jnp.where(src_is_a, b, a)
+    s_src = jnp.maximum(sa, sb)
+    legal = (
+        (s_src == 3)
+        | ((s_src == 2) & surf_e)
+        | ((s_src == 1) & feat_e)
+    )
+    cand = emask & (l < lshrt) & legal
     ncand = jnp.sum(cand.astype(jnp.int32))
 
     # --- arena selection: tets containing src or dst ----------------------
@@ -77,18 +131,176 @@ def collapse_short_edges(
         )
         return jnp.maximum(ub[src], ub[dst])
 
-    # shorter edge = higher priority
-    win = common.two_phase_winners(-l, cand, scatter_arena, gather_arena)
+    # win-independent quantities, hoisted out of the evaluation
+    q_old = common.quality_of(mesh.vert, mesh.met, tet)
+    vol_old = common.vol_of(mesh.vert, tet)
+    # scale-relative positivity (common.POS_VOL_FRAC of the tet's own
+    # old volume)
+    vol_floor = common.POS_VOL_FRAC * jnp.abs(vol_old)
 
-    # per-vertex winner map (each vertex touched by <= 1 winner)
+    def raw_normal(tri):
+        p0, p1, p2 = mesh.vert[tri[:, 0]], mesh.vert[tri[:, 1]], mesh.vert[tri[:, 2]]
+        return jnp.cross(p1 - p0, p2 - p0)
+
+    r_old = raw_normal(mesh.tria)
+    n_old = jnp.linalg.norm(r_old, axis=1)
+    req_tria = (mesh.trtag & tags.REQUIRED) != 0
     eidx = jnp.arange(ecap, dtype=jnp.int32)
+
+    def eval_winners(win):
+        """Validity of a winner set with pairwise-disjoint arenas.
+
+        Returns (accept, rej_geom, rej_surf, rej_topo [bool sets], aux
+        intermediates for the apply step)."""
+        # per-vertex winner map (each vertex touched by <= 1 winner)
+        wv = jnp.full(pcap, -1, jnp.int32)
+        wv = wv.at[jnp.where(win, src, pcap)].max(eidx, mode="drop")
+        wv = wv.at[jnp.where(win, dst, pcap)].max(eidx, mode="drop")
+
+        # per-tet winner and role
+        wt4 = wv[tet]                                   # [TC,4]
+        e_t = jnp.max(wt4, axis=1)                      # winner edge or -1
+        has = (e_t >= 0) & tmask
+        e_ts = jnp.maximum(e_t, 0)
+        src_t, dst_t = src[e_ts], dst[e_ts]
+        has_src = jnp.any(tet == src_t[:, None], axis=1) & has
+        has_dst = jnp.any(tet == dst_t[:, None], axis=1) & has
+        is_shell = has_src & has_dst
+        is_ball = has_src & ~is_shell
+
+        new_tet = jnp.where(
+            (tet == src_t[:, None]) & is_ball[:, None], dst_t[:, None], tet
+        )
+        q_new = common.quality_of(mesh.vert, mesh.met, new_tet)
+        vol_new = common.vol_of(mesh.vert, new_tet)
+
+        # --- geometric validity per winner --------------------------------
+        inf = jnp.inf
+        ball_old = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
+            q_old, mode="drop"
+        )
+        ball_new = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
+            jnp.where(vol_new > vol_floor, q_new, -inf), mode="drop"
+        )
+        # accept if the new ball keeps ~a third of the old worst quality
+        # (the class of criterion Mmg's colver uses) or is absolutely
+        # decent, with a hard floor against degenerate configurations
+        ok_geom = (ball_new >= 0.3 * ball_old) | (ball_new >= 0.3)
+        ok_geom = ok_geom & (ball_new > 0.02) & jnp.isfinite(ball_new)
+        rej_geom = win & ~ok_geom
+        accept = win & ok_geom
+
+        # --- surface fidelity for boundary collapses (chkcol_bdy role) ----
+        # per-tria winner/role mirrors the tet logic
+        wf3 = wv[mesh.tria]                              # [FC,3]
+        e_f = jnp.max(wf3, axis=1)
+        fhas = (e_f >= 0) & mesh.trmask
+        e_fs = jnp.maximum(e_f, 0)
+        src_f, dst_f = src[e_fs], dst[e_fs]
+        f_has_src = jnp.any(mesh.tria == src_f[:, None], axis=1) & fhas
+        f_has_dst = jnp.any(mesh.tria == dst_f[:, None], axis=1) & fhas
+        f_shell = f_has_src & f_has_dst                  # deleted trias
+        f_ball = f_has_src & ~f_shell                    # retargeted trias
+        new_tria = jnp.where(
+            (mesh.tria == src_f[:, None]) & f_ball[:, None],
+            dst_f[:, None], mesh.tria,
+        )
+
+        r_new = raw_normal(new_tria)
+        n_new = jnp.linalg.norm(r_new, axis=1)
+        dotn = jnp.einsum("fi,fi->f", r_old, r_new) / jnp.maximum(
+            n_old * n_new, 1e-30
+        )
+        # Hausdorff: removed vertex must stay within hausd of the plane
+        # of every retargeted tria (point-to-plane, the batched stand-in
+        # for Mmg's point-to-surface distance)
+        unit_new = r_new / jnp.maximum(n_new, 1e-30)[:, None]
+        dist = jnp.abs(
+            jnp.einsum(
+                "fi,fi->f", unit_new,
+                mesh.vert[src_f] - mesh.vert[new_tria[:, 0]],
+            )
+        )
+        degen = n_new < 1e-12 * jnp.maximum(n_old, 1e-30)
+        tria_bad = f_ball & ((dotn < _COS_SURF) | (dist > hausd) | degen)
+        # REQUIRED trias are immutable: any touched required tria kills it
+        bad_surf = jnp.zeros(ecap, bool)
+        bad_surf = bad_surf.at[
+            jnp.where(tria_bad | (fhas & req_tria), e_f, ecap)
+        ].max(True, mode="drop")
+        rej_surf = accept & bad_surf
+        accept = accept & ~bad_surf
+
+        # --- topological check: tentative apply + duplicate detection -----
+        app_t = is_ball & accept[e_ts]
+        del_t = is_shell & accept[e_ts]
+        tet_tent = jnp.where(app_t[:, None], new_tet, tet)
+        valid_tent = tmask & ~del_t
+        dup = common.duplicate_tets(tet_tent, valid_tent)
+        bad_e = jnp.zeros(ecap, bool).at[
+            jnp.where(dup & has, e_t, ecap)
+        ].max(True, mode="drop")
+        rej_topo = accept & bad_e
+        accept = accept & ~bad_e
+        aux = (e_ts, is_ball, is_shell, new_tet, e_fs, f_ball, f_shell,
+               new_tria, wv)
+        return accept, rej_geom, rej_surf, rej_topo, aux
+
+    # Select → evaluate → commit, iterated. One round of the
+    # 2-vertex-ball arena MIS is far too sparse for bulk coarsening (a
+    # candidate must be the strict minimum of its whole 2-hop
+    # neighborhood), so winners claim their arena tets and further
+    # selection rounds pick among candidates whose arenas are untouched.
+    # Disjoint arenas keep simultaneous application safe: any vertex
+    # shared by two collapses would put a claimed tet in both arenas, so
+    # each tet and each vertex still joins at most one winner. Rejected
+    # winners release their claim so they stop starving their
+    # neighborhoods (the serial kernel simply moves to the next edge;
+    # this is the batched equivalent).
+    def touched_edges(tflag):
+        vb = jnp.zeros(pcap, bool)
+        idx = jnp.where((tflag & tmask)[:, None], tet, pcap)
+        vb = vb.at[idx.reshape(-1)].set(True, mode="drop")
+        return vb[src] | vb[dst]
+
+    def claim_tets(w):
+        vb = jnp.zeros(pcap, bool)
+        vb = vb.at[jnp.where(w, src, pcap)].set(True, mode="drop")
+        vb = vb.at[jnp.where(w, dst, pcap)].set(True, mode="drop")
+        return jnp.any(vb[tet], axis=1) & tmask
+
+    def sel_body(_, carry):
+        w_acc, claimed, rej = carry
+        c = cand & ~touched_edges(claimed) & ~w_acc & ~rej
+        w = common.two_phase_winners(-l, c, scatter_arena, gather_arena)
+        return w_acc | w, claimed | claim_tets(w), rej
+
+    def outer_body(_, carry):
+        win_acc, rej_g, rej_s, rej_t, claimed = carry
+        rej = rej_g | rej_s | rej_t
+        trial, _, _ = jax.lax.fori_loop(
+            0, 4, sel_body, (win_acc, claimed, rej)
+        )
+        acc, rg, rs, rt, _ = eval_winners(trial)
+        return acc, rej_g | rg, rej_s | rs, rej_t | rt, claim_tets(acc)
+
+    zero_e = jnp.zeros(ecap, bool)
+    win_acc, rej_g, rej_s, rej_t, _ = jax.lax.fori_loop(
+        0, 3, outer_body,
+        (zero_e, zero_e, zero_e, zero_e, jnp.zeros(tcap, bool)),
+    )
+    # Cheap final pass: winners were fully validated inside the loop;
+    # re-derive only the apply intermediates (scatter/compare, no
+    # quality/surface re-evaluation) plus one duplicate guard on exactly
+    # the applied configuration — removing rejected winners restores
+    # their shell tets, which could in principle re-collide with a
+    # survivor's retarget.
+    win = win_acc
     wv = jnp.full(pcap, -1, jnp.int32)
     wv = wv.at[jnp.where(win, src, pcap)].max(eidx, mode="drop")
     wv = wv.at[jnp.where(win, dst, pcap)].max(eidx, mode="drop")
-
-    # per-tet winner and role
-    wt4 = wv[tet]                                   # [TC,4]
-    e_t = jnp.max(wt4, axis=1)                      # winner edge or -1
+    wt4 = wv[tet]
+    e_t = jnp.max(wt4, axis=1)
     has = (e_t >= 0) & tmask
     e_ts = jnp.maximum(e_t, 0)
     src_t, dst_t = src[e_ts], dst[e_ts]
@@ -96,45 +308,33 @@ def collapse_short_edges(
     has_dst = jnp.any(tet == dst_t[:, None], axis=1) & has
     is_shell = has_src & has_dst
     is_ball = has_src & ~is_shell
-
     new_tet = jnp.where(
         (tet == src_t[:, None]) & is_ball[:, None], dst_t[:, None], tet
     )
-    q_old = common.quality_of(mesh.vert, mesh.met, tet)
-    q_new = common.quality_of(mesh.vert, mesh.met, new_tet)
-    vol_new = common.vol_of(mesh.vert, new_tet)
-    # scale-relative positivity (common.POS_VOL_FRAC of the tet's own
-    # old volume)
-    vol_old = common.vol_of(mesh.vert, tet)
-    vol_floor = common.POS_VOL_FRAC * jnp.abs(vol_old)
-
-    # --- geometric validity per winner ------------------------------------
-    inf = jnp.inf
-    ball_old = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
-        q_old, mode="drop"
+    wf3 = wv[mesh.tria]
+    e_f = jnp.max(wf3, axis=1)
+    fhas = (e_f >= 0) & mesh.trmask
+    e_fs = jnp.maximum(e_f, 0)
+    src_f, dst_f = src[e_fs], dst[e_fs]
+    f_has_src = jnp.any(mesh.tria == src_f[:, None], axis=1) & fhas
+    f_has_dst = jnp.any(mesh.tria == dst_f[:, None], axis=1) & fhas
+    f_shell = f_has_src & f_has_dst
+    f_ball = f_has_src & ~f_shell
+    new_tria = jnp.where(
+        (mesh.tria == src_f[:, None]) & f_ball[:, None],
+        dst_f[:, None], mesh.tria,
     )
-    ball_new = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
-        jnp.where(vol_new > vol_floor, q_new, -inf), mode="drop"
+    dup = common.duplicate_tets(
+        jnp.where((is_ball & win[e_ts])[:, None], new_tet, tet),
+        tmask & ~(is_shell & win[e_ts]),
     )
-    # accept if the new ball keeps ~a third of the old worst quality (the
-    # class of criterion Mmg's colver uses) or is absolutely decent, with
-    # a hard floor against degenerate configurations
-    ok_geom = (ball_new >= 0.3 * ball_old) | (ball_new >= 0.3)
-    ok_geom = ok_geom & (ball_new > 0.02) & jnp.isfinite(ball_new)
-    accept = win & ok_geom
-    nrej_geom = jnp.sum((win & ~ok_geom).astype(jnp.int32))
-
-    # --- topological check: tentative apply + duplicate detection ---------
-    app_t = is_ball & accept[e_ts]
-    del_t = is_shell & accept[e_ts]
-    tet_tent = jnp.where(app_t[:, None], new_tet, tet)
-    valid_tent = tmask & ~del_t
-    dup = common.duplicate_tets(tet_tent, valid_tent)
-    bad_e = jnp.zeros(ecap, bool).at[jnp.where(dup & has, e_t, ecap)].max(
-        True, mode="drop"
-    )
-    nrej_topo = jnp.sum((accept & bad_e).astype(jnp.int32))
-    accept = accept & ~bad_e
+    bad_e = jnp.zeros(ecap, bool).at[
+        jnp.where(dup & has, e_t, ecap)
+    ].max(True, mode="drop")
+    accept = win & ~bad_e
+    nrej_geom = jnp.sum(rej_g.astype(jnp.int32))
+    nrej_surf = jnp.sum(rej_s.astype(jnp.int32))
+    nrej_topo = jnp.sum((rej_t | bad_e).astype(jnp.int32))
 
     # --- final apply -------------------------------------------------------
     app_t = is_ball & accept[e_ts]
@@ -144,10 +344,39 @@ def collapse_short_edges(
     vmask_out = mesh.vmask.at[jnp.where(accept, src, pcap)].set(
         False, mode="drop"
     )
-    ncollapse = jnp.sum(accept.astype(jnp.int32))
+    # trias: delete shells, retarget balls
+    app_f = f_ball & accept[e_fs]
+    del_f = f_shell & accept[e_fs]
+    tria_out = jnp.where(app_f[:, None], new_tria, mesh.tria)
+    trmask_out = mesh.trmask & ~del_f
+    # feature edges: same discipline
+    we2 = wv[mesh.edge]                              # [EC,2]
+    e_e = jnp.max(we2, axis=1)
+    ehas = (e_e >= 0) & mesh.edmask
+    e_es = jnp.maximum(e_e, 0)
+    src_e, dst_e = src[e_es], dst[e_es]
+    g_has_src = jnp.any(mesh.edge == src_e[:, None], axis=1) & ehas
+    g_has_dst = jnp.any(mesh.edge == dst_e[:, None], axis=1) & ehas
+    g_shell = g_has_src & g_has_dst
+    g_ball = g_has_src & ~g_shell
+    new_edge = jnp.where(
+        (mesh.edge == src_e[:, None]) & g_ball[:, None],
+        dst_e[:, None], mesh.edge,
+    )
+    app_g = g_ball & accept[e_es]
+    del_g = g_shell & accept[e_es]
+    edge_out = jnp.where(app_g[:, None], new_edge, mesh.edge)
+    edmask_out = mesh.edmask & ~del_g
 
-    out = mesh.replace(tet=tet_out, tmask=tmask_out, vmask=vmask_out)
+    ncollapse = jnp.sum(accept.astype(jnp.int32))
+    nsurf = jnp.sum((accept & (s_src < 3)).astype(jnp.int32))
+
+    out = mesh.replace(
+        tet=tet_out, tmask=tmask_out, vmask=vmask_out,
+        tria=tria_out, trmask=trmask_out,
+        edge=edge_out, edmask=edmask_out,
+    )
     return out, CollapseStats(
         ncollapse=ncollapse, ncand=ncand, nrej_geom=nrej_geom,
-        nrej_topo=nrej_topo,
+        nrej_topo=nrej_topo, nrej_surf=nrej_surf, nsurf=nsurf,
     )

@@ -108,6 +108,62 @@ def _run_match(keys: jax.Array, query: jax.Array):
     return hit[k:] & ~invalid[k:], jnp.where(invalid[k:], -1, idx[k:])
 
 
+def _run_match2(keys: jax.Array, query: jax.Array):
+    """Like `_run_match` but returns, per query row, the FIRST and LAST
+    matching key-row indices plus the match count (for entities that can
+    legitimately appear twice among the keys, e.g. internal tria faces
+    owned by two tets)."""
+    k, c = keys.shape
+    q = query.shape[0]
+    n = k + q
+    rows = jnp.concatenate([keys, query], axis=0).astype(jnp.int32)
+    invalid = jnp.any(rows < 0, axis=1)
+    slot = jnp.arange(n, dtype=jnp.int32)
+    uniq = jnp.concatenate(
+        [(-(slot[:, None] + 2)), jnp.zeros((n, c - 1), jnp.int32)], axis=1
+    )
+    rows = jnp.where(invalid[:, None], uniq, rows)
+    order = jnp.lexsort(tuple(rows[:, i] for i in reversed(range(c)))).astype(
+        jnp.int32
+    )
+    sr = rows[order]
+    newgrp = jnp.concatenate(
+        [jnp.ones(1, bool), jnp.any(sr[1:] != sr[:-1], axis=1)]
+    )
+    gid = (jnp.cumsum(newgrp.astype(jnp.int32)) - 1).astype(jnp.int32)
+    from_key = order < k
+    cnt = jnp.zeros(n, jnp.int32).at[gid].add(from_key.astype(jnp.int32))
+    big = jnp.int32(n)
+    minidx = (
+        jnp.full(n, big, jnp.int32)
+        .at[gid]
+        .min(jnp.where(from_key, order, big))
+    )
+    maxidx = (
+        jnp.full(n, -1, jnp.int32)
+        .at[gid]
+        .max(jnp.where(from_key, order, -1))
+    )
+    # per-sorted-position values, scattered back to original row order;
+    # the invalid mask lives in the ORIGINAL domain and applies last
+    cnt_sorted = cnt[gid]
+    lo = jnp.where(cnt_sorted > 0, minidx[gid], -1)
+    hi = jnp.where(cnt_sorted > 0, maxidx[gid], -1)
+    out_lo = jnp.full(n, -1, jnp.int32).at[order].set(lo)
+    out_hi = jnp.full(n, -1, jnp.int32).at[order].set(hi)
+    out_cnt = jnp.zeros(n, jnp.int32).at[order].set(cnt_sorted)
+    out_lo = jnp.where(invalid, -1, out_lo)
+    out_hi = jnp.where(invalid, -1, out_hi)
+    out_cnt = jnp.where(invalid, 0, out_cnt)
+    return out_lo[k:], out_hi[k:], out_cnt[k:]
+
+
+def match_rows2(keys: jax.Array, query: jax.Array):
+    """(first_idx, last_idx, count) of each query row among `keys` rows
+    (-1/-1/0 when absent; rows with negative entries never match)."""
+    return _run_match2(keys, query)
+
+
 def sorted_membership(keys: jax.Array, query: jax.Array) -> jax.Array:
     """[Q] bool: does each query row appear among `keys` rows? Rows with
     any negative entry never match."""

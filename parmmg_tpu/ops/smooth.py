@@ -1,15 +1,20 @@
 """Batched vertex smoothing (relaxation toward neighbor centroid).
 
-Counterpart of Mmg's vertex-move operator inside `MMG5_mmg3d1_delone`
-(reference `src/libparmmg1.c:739`): free interior vertices relax toward the
-centroid of their edge-neighbors (Jacobi, under-relaxed). Validity is
-restored iteratively: tets that would invert or degrade too much freeze all
-their vertices back to the original positions; the freeze loop runs a fixed
-number of rounds (XLA-friendly) with a global revert as the final safety
-net, so the sweep never worsens the worst element below the bound.
-
-Round-1 scope: interior vertices only (boundary smoothing joins the
-surface-analysis milestone).
+Counterpart of Mmg's vertex-move operators inside `MMG5_mmg3d1_delone`
+(reference `src/libparmmg1.c:739`): `movintpt` for free interior vertices,
+`movbdyregpt` for regular surface vertices (tangential motion only), and
+`movbdyridpt` for feature-line vertices (motion along the feature).
+Free interior vertices relax toward the centroid of their edge-neighbors
+(Jacobi, under-relaxed); surface vertices relax toward the centroid of
+their *surface* neighbors with the normal component of the displacement
+removed (first-order geometry preservation); ridge vertices toward the
+centroid of their *feature* neighbors. Validity is restored iteratively:
+tets that would invert or degrade too much — and surface trias whose
+normal would swing past the dihedral threshold (no folds, no new ridges)
+— freeze all their vertices back to the original positions; the freeze
+loop runs a fixed number of rounds (XLA-friendly) with a global revert as
+the final safety net, so the sweep never worsens the worst element below
+the bound.
 """
 
 from __future__ import annotations
@@ -23,6 +28,11 @@ import jax.numpy as jnp
 from ..core import tags
 from ..core.mesh import Mesh
 from . import common
+from .analysis import surf_tria_mask, vertex_normals
+
+_FEAT_BITS = tags.RIDGE | tags.REF | tags.NOM
+_HARD = tags.REQUIRED | tags.CORNER | tags.PARBDY | tags.NOM | tags.OVERLAP
+_COS_SURF = 0.70710678
 
 
 class SmoothStats(NamedTuple):
@@ -30,7 +40,11 @@ class SmoothStats(NamedTuple):
     nfrozen: jax.Array  # movable vertices frozen by validity rounds
 
 
-@partial(jax.jit, static_argnames=("relax", "rounds", "qfactor"), donate_argnums=0)
+@partial(
+    jax.jit,
+    static_argnames=("relax", "rounds", "qfactor", "nosurf"),
+    donate_argnums=0,
+)
 def smooth_vertices(
     mesh: Mesh,
     edges: jax.Array,
@@ -38,57 +52,121 @@ def smooth_vertices(
     relax: float = 0.5,
     rounds: int = 4,
     qfactor: float = 0.5,
+    nosurf: bool = False,
 ):
     """One smoothing sweep; returns (mesh, SmoothStats)."""
     pcap = mesh.pcap
     vert0 = mesh.vert
     dtype = vert0.dtype
 
-    movable = mesh.vmask & (
-        (mesh.vtag & (tags.IMMOVABLE | tags.BDY | tags.OVERLAP)) == 0
-    )
+    vt = mesh.vtag
+    hard = (vt & _HARD) != 0
+    bdy_v = (vt & tags.BDY) != 0
+    feat_v = (vt & _FEAT_BITS) != 0
+    free_i = mesh.vmask & ~hard & ~bdy_v
+    surf_v = mesh.vmask & ~hard & bdy_v & ~feat_v
+    ridge_v = mesh.vmask & ~hard & bdy_v & feat_v
+    if nosurf:
+        surf_v = jnp.zeros_like(surf_v)
+        ridge_v = jnp.zeros_like(ridge_v)
+    movable = free_i | surf_v | ridge_v
 
+    # --- edge classes -----------------------------------------------------
     a, b = edges[:, 0], edges[:, 1]
-    w = emask.astype(dtype)
-    acc = jnp.zeros((pcap, 3), dtype)
-    acc = acc.at[a].add(vert0[b] * w[:, None], mode="drop")
-    acc = acc.at[b].add(vert0[a] * w[:, None], mode="drop")
-    cnt = jnp.zeros(pcap, dtype)
-    cnt = cnt.at[a].add(w, mode="drop")
-    cnt = cnt.at[b].add(w, mode="drop")
-    centroid = acc / jnp.maximum(cnt, 1.0)[:, None]
-    target = jnp.where(
-        (movable & (cnt > 0))[:, None],
-        (1.0 - relax) * vert0 + relax * centroid,
-        vert0,
+    smask = surf_tria_mask(mesh)
+    tri_keys = common.tria_edge_keys(mesh, smask)
+    surf_e = common.sorted_membership(
+        tri_keys, jnp.where(emask[:, None], edges, -1)
     )
+    feat = common.feature_edge_index(mesh, edges, emask)
+    feat_tag = jnp.where(feat >= 0, mesh.edtag[jnp.maximum(feat, 0)], 0)
+    feat_e = (feat_tag & _FEAT_BITS) != 0
+
+    def centroid_over(sel):
+        w = (emask & sel).astype(dtype)
+        acc = jnp.zeros((pcap, 3), dtype)
+        acc = acc.at[a].add(vert0[b] * w[:, None], mode="drop")
+        acc = acc.at[b].add(vert0[a] * w[:, None], mode="drop")
+        cnt = jnp.zeros(pcap, dtype)
+        cnt = cnt.at[a].add(w, mode="drop")
+        cnt = cnt.at[b].add(w, mode="drop")
+        return acc / jnp.maximum(cnt, 1.0)[:, None], cnt
+
+    cent_all, cnt_all = centroid_over(jnp.ones_like(emask))
+    cent_surf, cnt_surf = centroid_over(surf_e)
+    cent_feat, cnt_feat = centroid_over(feat_e)
+
+    # interior: plain centroid
+    d_int = cent_all - vert0
+    # surface: tangential part of the surface-neighbor displacement
+    # (movbdyregpt role — normal component removed against the vertex
+    # normal so the vertex slides on the surface)
+    vn = vertex_normals(mesh)
+    d_s = cent_surf - vert0
+    d_surf = d_s - jnp.sum(d_s * vn, axis=1, keepdims=True) * vn
+    # feature line: centroid of the (typically two) feature neighbors —
+    # exact for straight ridges, second-order error on curved ones
+    d_feat = cent_feat - vert0
+
+    disp = jnp.where(
+        free_i[:, None] & (cnt_all > 0)[:, None], d_int, 0.0
+    )
+    disp = jnp.where(surf_v[:, None] & (cnt_surf > 0)[:, None], d_surf, disp)
+    disp = jnp.where(ridge_v[:, None] & (cnt_feat > 0)[:, None], d_feat, disp)
+    target = vert0 + relax * disp
 
     q_old = common.quality_of(vert0, mesh.met, mesh.tet)
     # scale-relative inversion floor (common.POS_VOL_FRAC of the
     # pre-move volume)
     vol_floor = common.POS_VOL_FRAC * jnp.abs(common.vol_of(vert0, mesh.tet))
 
-    def body(_, frozen):
-        pos = jnp.where(frozen[:, None], vert0, target)
+    # surface-fold guard: original tria normals to compare against
+    tri = mesh.tria
+
+    def tria_normals_at(pos):
+        p0, p1, p2 = pos[tri[:, 0]], pos[tri[:, 1]], pos[tri[:, 2]]
+        return jnp.cross(p1 - p0, p2 - p0)
+
+    r_old = tria_normals_at(vert0)
+    nr_old = jnp.linalg.norm(r_old, axis=1)
+
+    def bad_entities(pos):
         q_new = common.quality_of(pos, mesh.met, mesh.tet)
         vol = common.vol_of(pos, mesh.tet)
-        bad = mesh.tmask & ((vol <= vol_floor) | (q_new < qfactor * q_old))
+        bad_t = mesh.tmask & ((vol <= vol_floor) | (q_new < qfactor * q_old))
+        r_new = tria_normals_at(pos)
+        nr_new = jnp.linalg.norm(r_new, axis=1)
+        dotn = jnp.einsum("fi,fi->f", r_old, r_new) / jnp.maximum(
+            nr_old * nr_new, 1e-30
+        )
+        bad_f = smask & (
+            (dotn < _COS_SURF) | (nr_new < 1e-12 * jnp.maximum(nr_old, 1e-30))
+        )
+        return bad_t, bad_f
+
+    def body(_, frozen):
+        pos = jnp.where(frozen[:, None], vert0, target)
+        bad_t, bad_f = bad_entities(pos)
         freeze_v = jnp.zeros(pcap, bool)
-        idx = jnp.where(bad[:, None], mesh.tet, pcap)
+        idx = jnp.where(bad_t[:, None], mesh.tet, pcap)
         freeze_v = freeze_v.at[idx.reshape(-1)].set(True, mode="drop")
+        idxf = jnp.where(bad_f[:, None], tri, pcap)
+        freeze_v = freeze_v.at[idxf.reshape(-1)].set(True, mode="drop")
         return frozen | freeze_v
 
     frozen = jax.lax.fori_loop(0, rounds, body, ~movable)
 
     pos = jnp.where(frozen[:, None], vert0, target)
-    vol = common.vol_of(pos, mesh.tet)
-    q_new = common.quality_of(pos, mesh.met, mesh.tet)
-    still_bad = jnp.any(
-        mesh.tmask & ((vol <= vol_floor) | (q_new < qfactor * q_old))
-    )
+    bad_t, bad_f = bad_entities(pos)
+    still_bad = jnp.any(bad_t) | jnp.any(bad_f)
     pos = jnp.where(still_bad, vert0, pos)
 
-    moved = movable & ~frozen & ~still_bad & (cnt > 0)
+    has_nbrs = (
+        (free_i & (cnt_all > 0))
+        | (surf_v & (cnt_surf > 0))
+        | (ridge_v & (cnt_feat > 0))
+    )
+    moved = movable & ~frozen & ~still_bad & has_nbrs
     return mesh.replace(vert=pos), SmoothStats(
         nmoved=jnp.sum(moved.astype(jnp.int32)),
         nfrozen=jnp.sum((movable & frozen).astype(jnp.int32)),

@@ -24,6 +24,7 @@ from ..core import metric as metric_mod
 from ..core import tags
 from ..core.mesh import EDGE_VERTS, Mesh
 from . import common
+from .analysis import surf_tria_mask, vertex_normals
 
 
 class SplitStats(NamedTuple):
@@ -36,13 +37,14 @@ class SplitStats(NamedTuple):
 _INHERIT = tags.BDY | tags.RIDGE | tags.REF | tags.REQUIRED
 
 
-@partial(jax.jit, static_argnames=("llong",), donate_argnums=0)
+@partial(jax.jit, static_argnames=("llong", "nosurf"), donate_argnums=0)
 def split_long_edges(
     mesh: Mesh,
     edges: jax.Array,
     emask: jax.Array,
     t2e: jax.Array,
     llong: float = float(metric_mod.LLONG),
+    nosurf: bool = False,
 ):
     """One split sweep. Mesh must be compacted (valid slots are prefixes).
 
@@ -84,6 +86,11 @@ def split_long_edges(
     frozen = (
         ((mesh.vtag[a] & tags.PARBDY) != 0) & ((mesh.vtag[b] & tags.PARBDY) != 0)
     ) | ((feat_tag & tags.REQUIRED) != 0) | in_req_tri
+    if nosurf:
+        # -nosurf: the boundary surface is exactly preserved — no
+        # insertions on surface edges either (Mmg tags the whole boundary
+        # MG_REQ under nosurf)
+        frozen = frozen | surf
     cand = emask & (l > llong) & ~frozen
     ncand = jnp.sum(cand.astype(jnp.int32))
 
@@ -128,9 +135,64 @@ def split_long_edges(
     # new vertex slot per winner edge
     vnew = jnp.where(win, np0 + rank_v, -1).astype(jnp.int32)
 
-    # --- new vertex data ---------------------------------------------------
+    # per-tet winner mapping (shared by midpoint validation + tet split)
+    w6 = jnp.where(live_e, win[safe_t2e], False)  # [TC,6]
+    has = jnp.any(w6, axis=1) & mesh.tmask
+    k = jnp.argmax(w6, axis=1)                    # local edge slot
+    e_of_t = safe_t2e[jnp.arange(tcap), k]
+    ev_j = jnp.asarray(EDGE_VERTS)
+    li = ev_j[k, 0]
+    lj = ev_j[k, 1]
+    rows = jnp.arange(tcap)
+
+    # --- new vertex position ----------------------------------------------
     pa, pb = mesh.vert[a], mesh.vert[b]
     mid = 0.5 * (pa + pb)
+    if not nosurf:
+        # Curvature-corrected midpoint for plain surface edges — the
+        # cubic Bezier tangent rule of Mmg's `MMG5_BezierTgt` patch
+        # evaluated at t=1/2: mid + ((e.nb)nb - (e.na)na)/8, which places
+        # the point on the circle through the endpoints with the endpoint
+        # normals. Feature edges and feature endpoints keep the linear
+        # midpoint (their blended vertex normals are meaningless), and
+        # any incident tet that the offset would squash below the
+        # positivity floor reverts that edge to the linear midpoint.
+        vn = vertex_normals(mesh)
+        surf_real = mark_edges(surf_tria_mask(mesh) & mesh.trmask)
+        na_, nb_ = vn[a], vn[b]
+        has_n = (jnp.sum(na_ * na_, axis=1) > 0.5) & (
+            jnp.sum(nb_ * nb_, axis=1) > 0.5
+        )
+        featv = (
+            (mesh.vtag[a] | mesh.vtag[b])
+            & (tags.RIDGE | tags.REF | tags.CORNER | tags.NOM | tags.PARBDY)
+        ) != 0
+        plain = surf_real & has_n & ~featv & (feat < 0)
+        e_vec = pb - pa
+        corr = (
+            jnp.einsum("ei,ei->e", e_vec, nb_)[:, None] * nb_
+            - jnp.einsum("ei,ei->e", e_vec, na_)[:, None] * na_
+        ) / 8.0
+        mid_c = mid + corr
+        # per-tet validity of the offset midpoint
+        c = mesh.vert[mesh.tet]                   # [TC,4,3]
+        newp = mid_c[e_of_t]                      # [TC,3]
+        cA = c.at[rows, lj].set(newp)
+        cB = c.at[rows, li].set(newp)
+
+        def _vol(cc):
+            d1 = cc[:, 1] - cc[:, 0]
+            d2 = cc[:, 2] - cc[:, 0]
+            d3 = cc[:, 3] - cc[:, 0]
+            return jnp.einsum("ti,ti->t", jnp.cross(d1, d2), d3) / 6.0
+
+        vol_p = jnp.abs(_vol(c))
+        floor = common.POS_VOL_FRAC * vol_p
+        okt = (_vol(cA) > floor) & (_vol(cB) > floor)
+        bad_off = jnp.zeros(ecap, bool).at[
+            jnp.where(has & ~okt, e_of_t, ecap)
+        ].max(True, mode="drop")
+        mid = jnp.where((plain & ~bad_off)[:, None], mid_c, mid)
     ma = mesh.met[a]
     mets = jnp.stack([ma, mesh.met[b]], axis=-2)  # [E,2,C]
     half = jnp.full(ecap, 0.5, mesh.vert.dtype)
@@ -152,15 +214,7 @@ def split_long_edges(
     vmask = mesh.vmask.at[tgt_v].set(True, mode="drop")
 
     # --- split tets --------------------------------------------------------
-    w6 = jnp.where(live_e, win[safe_t2e], False)  # [TC,6]
-    has = jnp.any(w6, axis=1) & mesh.tmask
-    k = jnp.argmax(w6, axis=1)                    # local edge slot
-    e_of_t = safe_t2e[jnp.arange(tcap), k]
     nv_of_t = vnew[e_of_t]
-    ev_j = jnp.asarray(EDGE_VERTS)
-    li = ev_j[k, 0]
-    lj = ev_j[k, 1]
-    rows = jnp.arange(tcap)
     # child A in place: vertex lj -> newv
     tetA = mesh.tet.at[rows, lj].set(
         jnp.where(has, nv_of_t, mesh.tet[rows, lj])

@@ -380,6 +380,113 @@ def assign_global_ids(stacked: Mesh) -> Mesh:
     return stacked.replace(vglob=jnp.asarray(vglob))
 
 
+def stack_loaded_shards(
+    raws,
+    dtype=None,
+    headroom: float = 1.5,
+):
+    """Per-rank loaded `io.medit.RawMesh` objects (with
+    `ParallelCommunicator*` sections) → (stacked Mesh, ShardComm).
+
+    The distributed-input preprocessing of the reference
+    (`PMMG_preprocessMesh_distributed`, `src/libparmmg.c:206-314`):
+    interface vertices get PARBDY tags and a shared global numbering,
+    interface trias (face-comm mode) are tagged frozen, and the node
+    tables are derived. Vertex identity across ranks comes from the
+    stored global ids when present (node-comm mode,
+    `PMMG_loadCommunicator`, `src/inout_pmmg.c:74`), else from exact
+    coordinate matching (the `coorcell_pmmg.c` role) — per-rank files
+    print coordinates identically on both sides, so exact match is
+    well-defined.
+    """
+    D = len(raws)
+    loc_ids: List[np.ndarray] = []
+    gids: List[np.ndarray | None] = []
+    ifc_trias: List[np.ndarray] = []
+    for raw in raws:
+        if raw.node_comms:
+            loc = np.concatenate([np.asarray(c[1], np.int64)
+                                  for c in raw.node_comms])
+            gid = np.concatenate([np.asarray(c[2], np.int64)
+                                  for c in raw.node_comms])
+            loc, first = np.unique(loc, return_index=True)
+            loc_ids.append(loc)
+            gids.append(gid[first] if (gid >= 0).all() and len(gid) else None)
+            ifc_trias.append(np.zeros(0, np.int64))
+        elif raw.face_comms:
+            tr = np.concatenate([np.asarray(c[1], np.int64)
+                                 for c in raw.face_comms])
+            ifc_trias.append(np.unique(tr))
+            loc_ids.append(np.unique(raw.trias[np.unique(tr)].reshape(-1)))
+            gids.append(None)
+        else:
+            loc_ids.append(np.zeros(0, np.int64))
+            gids.append(None)
+            ifc_trias.append(np.zeros(0, np.int64))
+
+    if any(g is None and len(l) for g, l in zip(gids, loc_ids)):
+        # derive shared numbering by exact coordinate matching
+        coords = np.concatenate(
+            [raws[s].verts[loc_ids[s]] for s in range(D)], axis=0
+        )
+        uniq, inv = np.unique(coords, axis=0, return_inverse=True)
+        off = 0
+        gids = []
+        for s in range(D):
+            n = len(loc_ids[s])
+            gids.append(inv[off:off + n].astype(np.int64))
+            off += n
+
+    # uniform capacities
+    def cap(n):
+        return max(8, int(np.ceil(n * headroom)))
+
+    pc = cap(max(len(r.verts) for r in raws))
+    tc = cap(max(len(r.tets) for r in raws))
+    fc = cap(max(len(r.trias) for r in raws))
+    ec = cap(max(max(len(r.edges), 8) for r in raws))
+
+    from ..io.medit import raw_to_mesh
+
+    shards = []
+    for s, raw in enumerate(raws):
+        m = raw_to_mesh(
+            raw, pcap=pc, tcap=tc, fcap=fc, ecap=ec,
+            **({} if dtype is None else dict(dtype=dtype)),
+        )
+        vtag = np.asarray(m.vtag).copy()
+        vtag[loc_ids[s]] |= tags.PARBDY
+        vglob = np.full(pc, -1, np.int32)
+        vglob[loc_ids[s]] = gids[s]
+        trtag = np.asarray(m.trtag).copy()
+        if len(ifc_trias[s]):
+            trtag[ifc_trias[s]] |= (
+                tags.PARBDY | tags.REQUIRED | tags.NOSURF | tags.BDY
+            )
+        m = m.replace(
+            vtag=jnp.asarray(vtag),
+            vglob=jnp.asarray(vglob),
+            trtag=jnp.asarray(trtag),
+        )
+        from ..core.adjacency import build_adjacency
+
+        shards.append(build_adjacency(m))
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *shards)
+    # PARBDYBDY: interface vertices that also lie on the true boundary
+    from ..ops.analysis import mark_boundary
+
+    marked = [mark_boundary(m) for m in unstack_mesh(stacked)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *marked)
+    both = (
+        ((stacked.vtag & tags.PARBDY) != 0)
+        & ((stacked.vtag & tags.BDY) != 0)
+    )
+    stacked = stacked.replace(
+        vtag=jnp.where(both, stacked.vtag | tags.PARBDYBDY, stacked.vtag)
+    )
+    return stacked, rebuild_comm(stacked)
+
+
 def unstack_mesh(stacked: Mesh) -> List[Mesh]:
     """Stacked [D,...] Mesh -> list of per-shard host Meshes."""
     d = stacked.vert.shape[0]

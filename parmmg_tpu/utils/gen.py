@@ -102,6 +102,46 @@ def unit_cube(n: int, perturb: float = 0.0, seed: int = 0):
     )
 
 
+def unit_ball(n: int):
+    """Tetrahedral mesh of the unit ball, by the norm-swap map of the
+    structured cube: p -> p * (||p||_inf / ||p||_2) on the [-1,1]^3 cube.
+    The map is radial (cube surface -> unit sphere), keeps the Kuhn tets
+    positively oriented for the sizes used in tests, and gives a smooth
+    curved boundary with no true ridges — the fixture class the reference
+    CI gets from its sphere meshes (`cmake/testing/pmmg_tests.cmake:71-150`).
+
+    Returns dict(verts, tets, trias, trrefs, vrefs).
+    """
+    raw = unit_cube(n)
+    v = raw["verts"] * 2.0 - 1.0  # [-1,1]^3
+    linf = np.max(np.abs(v), axis=1)
+    l2 = np.linalg.norm(v, axis=1)
+    scale = np.where(l2 > 1e-12, linf / np.maximum(l2, 1e-12), 1.0)
+    raw["verts"] = v * scale[:, None]
+    raw["trrefs"] = np.ones_like(raw["trrefs"])  # one smooth surface
+    return raw
+
+
+def unit_ball_mesh(n: int, dtype=None, headroom: float = 1.5, **kw):
+    """unit_ball as a device Mesh with adjacency built."""
+    import jax.numpy as jnp
+
+    from ..core import adjacency
+    from ..core.mesh import Mesh
+
+    raw = unit_ball(n)
+    m = Mesh.from_numpy(
+        raw["verts"],
+        raw["tets"],
+        trias=raw["trias"],
+        trrefs=raw["trrefs"],
+        dtype=dtype or jnp.float32,
+        headroom=headroom,
+        **kw,
+    )
+    return adjacency.build_adjacency(m)
+
+
 def unit_cube_mesh(n: int, dtype=None, perturb: float = 0.0, seed: int = 0,
                    headroom: float = 1.5, **kw):
     """unit_cube as a device Mesh with adjacency built."""

@@ -1,0 +1,58 @@
+"""Phase timers + verbosity ladder.
+
+The tracing/observability role of the reference's `mytime`/`chrono`/
+`printim` phase timers (`src/parmmg.c:91-92`, per-phase at
+`src/libparmmg.c:334-425`, per-iteration gated by verbosity at
+`src/libparmmg1.c:637-660`) and the `PMMG_VERB_*` ladder
+(`src/parmmg.h:128-163`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List
+
+
+class Verb:
+    """Verbosity levels (PMMG_VERB_* analog)."""
+
+    NO = -1        # silent
+    VERSION = 0    # banner only
+    QUAL = 1       # quality histograms + phase times
+    STEPS = 2      # main phases
+    ITWAVES = 3    # per-iteration / per-sweep detail
+    DEBUG = 4
+
+
+class Timers:
+    """Named phase timers with nesting, printed like `printim`."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.records: List[tuple] = []   # (depth, name, seconds)
+        self.totals: Dict[str, float] = {}
+        self._depth = 0
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        self._depth += 1
+        try:
+            yield
+        finally:
+            self._depth -= 1
+            dt = time.perf_counter() - t0
+            self.records.append((self._depth, name, dt))
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+
+    def report(self, file=None) -> str:
+        """Phase-time summary (the `-endcod` style summary of
+        `src/parmmg.c:42`)."""
+        lines = ["", "  -- PHASE TIMES (s)"]
+        for depth, name, dt in self.records:
+            lines.append(f"     {'  ' * depth}{name:<28s} {dt:10.3f}")
+        out = "\n".join(lines)
+        if self.enabled:
+            print(out, file=file)
+        return out

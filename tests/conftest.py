@@ -25,6 +25,10 @@ for _accel in ("axon", "tpu", "cuda", "rocm"):
     _xb._backend_factories.pop(_accel, None)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+# NOTE: jax_compilation_cache_dir is deliberately NOT set — this
+# jaxlib's executable (de)serialization segfaults on the CPU backend
+# (observed in both the write path and get_executable_and_time), so the
+# persistent compile cache is unsafe here.
 
 import pathlib  # noqa: E402
 
@@ -32,6 +36,21 @@ import pytest  # noqa: E402
 
 REF_EX0 = pathlib.Path("/root/reference/libexamples/adaptation_example0")
 REF_EX1 = pathlib.Path("/root/reference/libexamples/adaptation_example1")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Workaround for a jaxlib CPU-compiler segfault: after many large
+    programs have been compiled in one process, the NEXT big compile can
+    crash inside `backend_compile_and_load` (reproducible at the first
+    test_m5_surface compile when the whole suite runs in one process;
+    the same test passes standalone). Dropping the executable caches
+    between modules keeps the compiler state small."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
 
 
 @pytest.fixture(scope="session")

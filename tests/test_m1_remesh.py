@@ -19,10 +19,10 @@ from parmmg_tpu.utils import conformity
 ECAP = 40000
 
 
-def load_cube(path, hsiz=None):
+def load_cube(path, hsiz=None, features=True):
     m = medit.load_mesh(path, dtype=jnp.float64)
     m = m.with_capacity(4000, 16000, 4000, 64)
-    m = analysis.analyze(m)
+    m = analysis.analyze(m, features=features)
     if hsiz is not None:
         m = m.replace(met=jnp.full((m.pcap, 1), hsiz, m.dtype))
     return m
@@ -178,8 +178,9 @@ def test_adapt_noinsert_nomove(cube_mesh_path):
 def test_split_feature_edge_reversed_rows(cube_mesh_path):
     """Feature edges stored as (hi, lo) must split into both halves
     (regression: the append used the canonical hi endpoint instead of the
-    stored row's own second vertex)."""
-    m = load_cube(cube_mesh_path, hsiz=0.2)
+    stored row's own second vertex). Feature detection is off so the
+    planted edge is the only feature edge."""
+    m = load_cube(cube_mesh_path, hsiz=0.2, features=False)
     # pick a real tet edge and store it hi-before-lo as a feature edge
     e, em, t2e, _ = edges_of(m)
     eid = int(np.nonzero(np.asarray(em))[0][0])

@@ -1,0 +1,187 @@
+"""Surface analysis tests: ridge/corner detection, normals, synthesis.
+
+Models the reference's analysis acceptance criteria: the cube's 12 edges
+are dihedral ridges and its 8 corners singular (`MMG5_setdhd`/`MMG5_singul`
+semantics re-derived, reference `src/analys_pmmg.c:2001,1679`), while a
+smooth sphere has no features at the default 45-degree threshold.
+"""
+
+import numpy as np
+import pytest
+
+from parmmg_tpu.core import tags
+from parmmg_tpu.ops import analysis
+from parmmg_tpu.utils.gen import unit_ball_mesh, unit_cube_mesh
+
+
+@pytest.fixture(scope="module")
+def cube():
+    return analysis.analyze(unit_cube_mesh(3))
+
+
+def test_cube_ridges_are_the_12_edges(cube):
+    ed = np.asarray(cube.edtag)
+    em = np.asarray(cube.edmask)
+    ridge = ((ed & tags.RIDGE) != 0) & em
+    # n=3: each of the 12 cube edges is 3 segments
+    assert ridge.sum() == 36
+    # every ridge edge segment lies on a cube edge: two coordinates at {0,1}
+    ev = np.asarray(cube.edge)[ridge]
+    pts = np.asarray(cube.vert)[ev].reshape(-1, 3)
+    on_extreme = (np.abs(pts) < 1e-9) | (np.abs(pts - 1.0) < 1e-9)
+    assert (on_extreme.sum(axis=1) >= 2).all()
+
+
+def test_cube_corners(cube):
+    vt = np.asarray(cube.vtag)
+    vm = np.asarray(cube.vmask)
+    corner = ((vt & tags.CORNER) != 0) & vm
+    assert corner.sum() == 8
+    pts = np.asarray(cube.vert)[corner]
+    on_extreme = (np.abs(pts) < 1e-9) | (np.abs(pts - 1.0) < 1e-9)
+    assert (on_extreme.sum(axis=1) == 3).all()
+
+
+def test_cube_ridge_vertices(cube):
+    vt = np.asarray(cube.vtag)
+    vm = np.asarray(cube.vmask)
+    ridge_v = ((vt & tags.RIDGE) != 0) & vm
+    # 12 edges x 2 interior verts + 8 corners
+    assert ridge_v.sum() == 32
+    # feature vertices are also boundary
+    assert ((vt[ridge_v] & tags.BDY) != 0).all()
+
+
+def test_sphere_has_no_features():
+    m = analysis.analyze(unit_ball_mesh(6))
+    ed = np.asarray(m.edtag)
+    em = np.asarray(m.edmask)
+    vt = np.asarray(m.vtag)
+    vm = np.asarray(m.vmask)
+    assert (((ed & tags.RIDGE) != 0) & em).sum() == 0
+    assert (((vt & tags.CORNER) != 0) & vm).sum() == 0
+
+
+def test_ref_change_edges():
+    # cube face refs differ side-to-side, so cube edges are REF edges too
+    m = analysis.analyze(unit_cube_mesh(2))
+    ed = np.asarray(m.edtag)
+    em = np.asarray(m.edmask)
+    ref = ((ed & tags.REF) != 0) & em
+    assert ref.sum() == 24  # 12 edges x 2 segments at n=2
+
+
+def test_vertex_normals_point_outward():
+    m = analysis.analyze(unit_ball_mesh(6))
+    vn = np.asarray(analysis.vertex_normals(m))
+    vm = np.asarray(m.vmask)
+    bdy = ((np.asarray(m.vtag) & tags.BDY) != 0) & vm
+    p = np.asarray(m.vert)[bdy]
+    n = vn[bdy]
+    # outward radial: normal aligns with position on the sphere
+    r = p / np.linalg.norm(p, axis=1, keepdims=True)
+    dots = np.sum(n * r, axis=1)
+    assert dots.min() > 0.7
+    # interior verts get zero normal
+    inte = vm & ~bdy
+    assert np.abs(vn[inte]).max() == 0.0
+
+
+def test_tria_normals_oriented_regardless_of_winding():
+    m = unit_cube_mesh(2)
+    # scramble tria winding
+    tria = np.asarray(m.tria).copy()
+    trmask = np.asarray(m.trmask)
+    flip = np.arange(len(tria)) % 2 == 0
+    tria[flip] = tria[flip][:, [1, 0, 2]]
+    m = m.replace(tria=m.tria.at[:].set(tria))
+    unit, area, ok = analysis.tria_normals(m)
+    unit = np.asarray(unit)
+    ok = np.asarray(ok) & trmask
+    # every z=0-face tria normal must point to -z despite winding
+    c = np.asarray(m.vert)[tria]
+    on_bottom = ok & np.all(np.abs(c[..., 2]) < 1e-9, axis=1)
+    assert on_bottom.sum() > 0
+    assert (unit[on_bottom][:, 2] < -0.99).all()
+
+
+def test_synthesize_missing_trias():
+    import jax.numpy as jnp
+
+    from parmmg_tpu.core.mesh import Mesh
+    from parmmg_tpu.utils.gen import unit_cube
+
+    raw = unit_cube(2)
+    m = Mesh.from_numpy(raw["verts"], raw["tets"])  # no trias given
+    m = analysis.analyze(m)
+    # 6 faces x 2*n^2 trias
+    assert int(m.ntria) == 48
+    # idempotent: re-running does not duplicate
+    m2 = analysis.analyze(m)
+    assert int(m2.ntria) == 48
+    # and the synthesized cube still gets its 12 ridge edges (here each
+    # edge is 2 segments)
+    ed = np.asarray(m2.edtag)
+    em = np.asarray(m2.edmask)
+    assert (((ed & tags.RIDGE) != 0) & em).sum() == 24
+
+
+def test_internal_interface_not_fake_ridged():
+    """A flat internal material interface (trias with two owner tets of
+    different refs) must get consistently oriented normals — per-tria
+    arbitrary owner choice would make neighbors antiparallel and tag the
+    whole flat interface as ridges/corners, freezing it solid."""
+    import jax.numpy as jnp
+
+    from parmmg_tpu.core.mesh import FACE_VERTS, Mesh
+    from parmmg_tpu.utils.gen import unit_cube
+
+    raw = unit_cube(2)
+    verts, tets = raw["verts"], raw["tets"]
+    bary_z = verts[tets].mean(axis=1)[:, 2]
+    trefs = np.where(bary_z < 0.5, 1, 2)
+    # internal trias: tet faces lying in the z=0.5 plane
+    fv = tets[:, FACE_VERTS].reshape(-1, 3)
+    on_mid = np.all(np.abs(verts[fv][:, :, 2] - 0.5) < 1e-12, axis=1)
+    mid = np.unique(np.sort(fv[on_mid], axis=1), axis=0)
+    trias = np.concatenate([raw["trias"], mid])
+    trrefs = np.concatenate(
+        [raw["trrefs"], np.full(len(mid), 9, np.int64)]
+    )
+    m = Mesh.from_numpy(verts, tets, trefs=trefs, trias=trias,
+                        trrefs=trrefs)
+    m = analysis.analyze(m)
+    vt = np.asarray(m.vtag)
+    vm = np.asarray(m.vmask)
+    # the interface's interior vertex (center of the cube face plane,
+    # (0.5,0.5,0.5)) must be neither CORNER nor RIDGE
+    center = np.all(np.abs(np.asarray(m.vert) - 0.5) < 1e-12, axis=1) & vm
+    assert center.sum() == 1
+    assert (vt[center] & (tags.CORNER | tags.RIDGE)) == 0
+    # but it is a REF-surface vertex (internal interface detected)
+    assert (vt[center] & tags.BDY) != 0
+
+
+def test_nonmanifold_fan_detection():
+    import jax.numpy as jnp
+
+    from parmmg_tpu.core.mesh import Mesh
+
+    # two tets sharing face (0,1,3), plus a dangling tria on edge (0,1):
+    # that edge is then in 3+ surface trias -> non-manifold fan
+    verts = np.array(
+        [
+            [0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1],
+            [0, -1, 0], [0.5, 0.3, -1.0],
+        ],
+        float,
+    )
+    tets = np.array([[0, 1, 2, 3], [0, 1, 3, 4]])
+    m = Mesh.from_numpy(verts, tets, trias=np.array([[0, 1, 5]]))
+    m = analysis.analyze(m)
+    ed = np.asarray(m.edtag)
+    em = np.asarray(m.edmask)
+    ev = np.asarray(m.edge)
+    nom = ((ed & tags.NOM) != 0) & em
+    keys = {tuple(sorted(e)) for e in ev[nom]}
+    assert (0, 1) in keys

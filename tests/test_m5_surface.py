@@ -1,0 +1,117 @@
+"""Boundary adaptation tests: the surface can be coarsened, refined and
+smoothed while staying within the Hausdorff bound — the capability of
+Mmg's boundary operators (`MMG5_colver` bdy path / `movbdyregpt` /
+`MMG5_BezierTgt` midpoints) that the reference forwards with `-hausd`,
+plus the `-nosurf` freeze mode."""
+
+import numpy as np
+import pytest
+
+from parmmg_tpu.core import tags
+from parmmg_tpu.models.adapt import AdaptOptions, adapt
+from parmmg_tpu.ops import quality
+from parmmg_tpu.utils import conformity
+from parmmg_tpu.utils.gen import unit_ball_mesh
+
+HAUSD = 0.05
+
+
+def surface_radii(m):
+    vm = np.asarray(m.vmask)
+    vt = np.asarray(m.vtag)
+    bdy = ((vt & tags.BDY) != 0) & vm
+    return np.linalg.norm(np.asarray(m.vert)[bdy], axis=1)
+
+
+def test_ball_coarsen_boundary():
+    """Coarsening a sphere must remove surface vertices (768 input
+    boundary trias cannot satisfy h=0.45) while keeping every surviving
+    surface vertex within hausd of the unit sphere."""
+    m = unit_ball_mesh(8)
+    ntria_in = int(m.ntria)
+    out, _ = adapt(m, AdaptOptions(hsiz=0.45, niter=1, max_sweeps=8,
+                                   hausd=HAUSD))
+    assert int(out.ntet) < 3072 * 0.75
+    assert int(out.ntria) < ntria_in * 0.8  # the boundary itself coarsened
+    rep = conformity.check_mesh(out)
+    assert rep.ok, str(rep)
+    r = surface_radii(out)
+    assert r.min() > 1.0 - HAUSD and r.max() < 1.0 + HAUSD
+
+
+def test_ball_refine_keeps_curvature():
+    """Refining a sphere splits boundary edges with curvature-corrected
+    midpoints: new surface points stay near radius 1, not on the chords
+    (plain midpoints would sag to ~0.976 at this size)."""
+    m = unit_ball_mesh(6)
+    out, _ = adapt(m, AdaptOptions(hsiz=0.22, niter=1, max_sweeps=6,
+                                   hausd=HAUSD))
+    assert int(out.ntet) > 1296 * 2
+    rep = conformity.check_mesh(out)
+    assert rep.ok, str(rep)
+    r = surface_radii(out)
+    assert r.min() > 0.985
+    h = quality.quality_histogram(out)
+    assert float(h.qmin) > 0.01
+
+
+@pytest.mark.parametrize("hsiz", [0.45, 0.2])
+def test_nosurf_freezes_boundary(hsiz):
+    """-nosurf: the boundary surface must be exactly preserved, under
+    both coarsening and refinement."""
+    from parmmg_tpu.ops import analysis
+
+    # analyze a fresh copy for the before-snapshot (analysis kernels
+    # donate their input buffers)
+    bdy_in = np.sort(
+        np.round(surface_radii(analysis.analyze(unit_ball_mesh(6))), 12)
+    )
+    assert len(bdy_in) > 0
+    m = unit_ball_mesh(6)
+    tri_in = int(m.ntria)
+    out, _ = adapt(
+        m, AdaptOptions(hsiz=hsiz, niter=1, max_sweeps=6, nosurf=True)
+    )
+    assert int(out.ntria) == tri_in  # no boundary tria created/destroyed
+    bdy_out = np.sort(np.round(surface_radii(out), 12))
+    assert len(bdy_out) == len(bdy_in)
+    np.testing.assert_allclose(np.asarray(bdy_out), np.asarray(bdy_in))
+    rep = conformity.check_mesh(out)
+    assert rep.ok, str(rep)
+
+
+def test_cube_ridges_preserved_under_coarsening():
+    """Coarsening the cube must keep its 12 edges straight and its 8
+    corners in place (ridge/corner discipline of tag_pmmg.c)."""
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    m = unit_cube_mesh(6)  # 1296 tets, h~0.17
+    out, _ = adapt(m, AdaptOptions(hsiz=0.4, niter=1, max_sweeps=8,
+                                   hausd=HAUSD))
+    assert int(out.ntet) < 1296 * 0.6
+    rep = conformity.check_mesh(out)
+    assert rep.ok, str(rep)
+    vm = np.asarray(out.vmask)
+    vt = np.asarray(out.vtag)
+    p = np.asarray(out.vert)
+    # corners still present and exactly at the cube corners
+    corner = ((vt & tags.CORNER) != 0) & vm
+    assert corner.sum() == 8
+    cp = p[corner]
+    assert np.allclose(np.sort(cp, axis=0)[:4], 0.0, atol=1e-12)
+    assert np.allclose(np.sort(cp, axis=0)[4:], 1.0, atol=1e-12)
+    # ridge vertices still lie exactly on cube edges (two coords at {0,1})
+    ridge = ((vt & tags.RIDGE) != 0) & vm & ~corner
+    rp = p[ridge]
+    on_ext = (np.abs(rp) < 1e-9) | (np.abs(rp - 1.0) < 1e-9)
+    assert (on_ext.sum(axis=1) >= 2).all()
+    # boundary vertices still on the unit-cube surface
+    bdy = ((vt & tags.BDY) != 0) & vm
+    bp = p[bdy]
+    face = (np.abs(bp) < 1e-9) | (np.abs(bp - 1.0) < 1e-9)
+    assert (face.any(axis=1)).all()
+    # total volume preserved (flat faces: surface ops are in-plane)
+    from parmmg_tpu.core.mesh import tet_volumes
+
+    vol = np.asarray(tet_volumes(out))[np.asarray(out.tmask)].sum()
+    assert vol == pytest.approx(1.0, rel=1e-9)

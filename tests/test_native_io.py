@@ -1,0 +1,18 @@
+"""Native tokenizer: build + parity with the pure-Python fallback."""
+
+import re
+
+import pytest
+
+from parmmg_tpu.io import native_io
+
+CUBE = "/root/reference/libexamples/adaptation_example0/cube.mesh"
+
+
+def test_native_tokenizer_parity():
+    if not native_io.available():
+        pytest.skip("native tokenizer not built (no g++?)")
+    with open(CUBE) as f:
+        text = f.read()
+    py = re.compile(r"#.*").sub(" ", text).split()
+    assert native_io.tokenize(CUBE) == py
