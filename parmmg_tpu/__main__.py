@@ -118,6 +118,15 @@ def main(argv=None) -> int:
                 args.input, args.nparts, metpath=args.sol
             )
             mesh = None
+        elif args.ls is not None:
+            # in ls mode the sol file IS the level-set (reference
+            # `src/parmmg.c:241-307` routing)
+            raw = medit.read_mesh(args.input)
+            ls = None
+            if args.sol:
+                vals, _types = medit.read_sol(args.sol)
+                ls = vals[:, :1]
+            mesh = medit.raw_to_mesh(raw, ls=ls)
         else:
             mesh = medit.load_mesh(args.input, args.sol)
 

@@ -74,6 +74,11 @@ class QualityHisto:
 
 
 def quality_histogram(mesh: Mesh, nbins: int = 5) -> QualityHisto:
+    """Quality histogram with the reference's binning: 5 uniform bins of
+    width 0.2 (`PMMG_QUAL_HISSIZE=5`, reference `src/parmmg.h:93`, filled
+    by Mmg's computeInqua `(int)(5*qual)` rule) plus BEST/AVRG/WRST and
+    the argmin-with-location the custom MPI_Op reduces
+    (`PMMG_min_iel_compute`, `src/quality_pmmg.c:82`)."""
     q = tet_quality(mesh)
     m = mesh.tmask
     ne = jnp.sum(m.astype(jnp.int32))
@@ -182,10 +187,11 @@ class LengthStats:
     counts: jax.Array   # [nbins] histogram over log2-length classes
 
 
-# bin edges for the length histogram (geometric classes around the exact
-# collapse/split thresholds so bins agree with n_small/n_large)
+# bin edges for the length histogram — the reference's exact bounds
+# (`bd[9]` at `src/quality_pmmg.c:387`: 0, .3, .6, 1/sqrt2, .9, 1.3,
+# sqrt2, 2, 5), so "identical histogram" comparisons are well-defined
 _LEN_EDGES = jnp.array(
-    [0.0, 0.3, 0.6, float(metric_mod.LSHRT), 0.9, 1.111,
+    [0.0, 0.3, 0.6, float(metric_mod.LSHRT), 0.9, 1.3,
      float(metric_mod.LLONG), 2.0, 5.0]
 )
 
