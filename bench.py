@@ -26,10 +26,12 @@ import sys
 import time
 
 # steady-state tets/sec of the default workload on the host CPU backend
-# (measured with a warm jit cache; see BASELINE.md "CPU anchor" row)
-CPU_ANCHOR_TPS = 2017.5
+# (measured with a warm jit cache; see BASELINE.md "CPU anchor" row).
+# Re-measured 2026-07-30 after the M5/M6 kernels (boundary adaptation +
+# feature detection active): 93,765 output tets in 68.6 s.
+CPU_ANCHOR_TPS = 1367.3
 # CPU anchor for the small fallback workload (n=8, hsiz=0.08)
-CPU_ANCHOR_TPS_SMALL = 6649.7
+CPU_ANCHOR_TPS_SMALL = 4575.7
 
 
 def _workload(n, hsiz):

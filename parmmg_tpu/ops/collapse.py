@@ -284,10 +284,15 @@ def collapse_short_edges(
         acc, rg, rs, rt, _ = eval_winners(trial)
         return acc, rej_g | rg, rej_s | rs, rej_t | rt, claim_tets(acc)
 
-    zero_e = jnp.zeros(ecap, bool)
+    # initial carries derived from mesh data (not fresh constants) so
+    # they inherit the device-varying type under shard_map — a literal
+    # jnp.zeros carry is 'unvarying' and the loop body would change its
+    # type on the first iteration
+    zero_e = cand & False
+    zero_t = tmask & False
     win_acc, rej_g, rej_s, rej_t, _ = jax.lax.fori_loop(
         0, 3, outer_body,
-        (zero_e, zero_e, zero_e, zero_e, jnp.zeros(tcap, bool)),
+        (zero_e, zero_e, zero_e, zero_e, zero_t),
     )
     # Cheap final pass: winners were fully validated inside the loop;
     # re-derive only the apply intermediates (scatter/compare, no
