@@ -41,6 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable angle detection")
     p.add_argument("-optim", action="store_true",
                    help="keep mesh-implied sizes, only improve quality")
+    p.add_argument("-rn", dest="renumber", action="store_true",
+                   help="Morton-order renumbering for locality (the "
+                   "reference's Scotch renumbering role)")
     p.add_argument("-noinsert", action="store_true")
     p.add_argument("-noswap", action="store_true")
     p.add_argument("-nomove", action="store_true")
@@ -147,6 +150,13 @@ def main(argv=None) -> int:
                       file=sys.stderr)
                 return 1
             mesh = discretize_levelset(mesh, isovalue=args.ls)
+
+    if args.renumber and mesh is not None:
+        from .core.adjacency import build_adjacency
+        from .parallel.partition import renumber_sfc
+
+        with timers.phase("renumbering"):
+            mesh = build_adjacency(renumber_sfc(mesh))
 
     if args.pure_partitioning:
         import jax

@@ -1,0 +1,114 @@
+"""Debug/observability dumps: meshes with quality/partition scalars,
+entity statistics, communicator printer.
+
+Role of the reference's debug layer (`src/debug_pmmg.c`:
+`PMMG_grp_quality_to_saveMesh:619`, `PMMG_grp_mark_to_saveMesh:583` and
+the all-groups variants `:653-706`; `PMMG_printCommunicator`,
+`src/libparmmg.h:2554`): write visualizable artifacts (Medit mesh + a
+scalar sol over tetrahedra) and human-readable summaries of the
+communicator tables.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import tags
+from ..core.mesh import Mesh
+
+
+def _save_tet_sol(path: str, values: np.ndarray) -> None:
+    """Medit sol with one scalar per tetrahedron (SolAtTetrahedra)."""
+    with open(path, "w") as f:
+        f.write("MeshVersionFormatted 2\n\nDimension 3\n\nSolAtTetrahedra\n")
+        f.write(f"{len(values)}\n1 1\n")
+        np.savetxt(f, np.asarray(values).reshape(-1, 1), fmt="%.9g")
+        f.write("\nEnd\n")
+
+
+def save_quality(mesh: Mesh, basename: str) -> None:
+    """Write `basename.mesh` + `basename.sol` with per-tet quality —
+    `PMMG_grp_quality_to_saveMesh` (`src/debug_pmmg.c:619`)."""
+    from ..io import medit
+    from ..ops.quality import tet_quality
+
+    medit.save_mesh(mesh, basename + ".mesh")
+    q = np.asarray(tet_quality(mesh))[np.asarray(mesh.tmask)]
+    _save_tet_sol(basename + ".sol", q)
+
+
+def save_partition(mesh: Mesh, part: np.ndarray, basename: str) -> None:
+    """Write mesh + per-tet partition color — the `mark`-dump role
+    (`PMMG_grp_mark_to_saveMesh`, `src/debug_pmmg.c:583`)."""
+    from ..io import medit
+
+    medit.save_mesh(mesh, basename + ".mesh")
+    colors = np.asarray(part)[np.asarray(mesh.tmask)]
+    _save_tet_sol(basename + ".sol", colors.astype(np.float64))
+
+
+def save_stacked_quality(stacked: Mesh, basename: str) -> None:
+    """Per-shard quality dumps `basename-S<k>.mesh/.sol` (the all-groups
+    variant, `src/debug_pmmg.c:653-706`)."""
+    from ..parallel.distribute import unstack_mesh
+
+    for s, m in enumerate(unstack_mesh(stacked)):
+        save_quality(m, f"{basename}-S{s:02d}")
+
+
+def mesh_stats(mesh: Mesh) -> str:
+    """Entity counts + tag breakdown, one line per class."""
+    vm = np.asarray(mesh.vmask)
+    vt = np.asarray(mesh.vtag)[vm]
+    em = np.asarray(mesh.edmask)
+    et = np.asarray(mesh.edtag)[em]
+    tm = np.asarray(mesh.trmask)
+    tt = np.asarray(mesh.trtag)[tm]
+
+    def n(bits, arr):
+        return int(((arr & bits) != 0).sum())
+
+    lines = [
+        f"  vertices {vm.sum()}  tets {int(np.asarray(mesh.tmask).sum())}"
+        f"  trias {tm.sum()}  edges {em.sum()}",
+        f"  vtag: BDY {n(tags.BDY, vt)}  RIDGE {n(tags.RIDGE, vt)}"
+        f"  CORNER {n(tags.CORNER, vt)}  REQ {n(tags.REQUIRED, vt)}"
+        f"  NOM {n(tags.NOM, vt)}  PARBDY {n(tags.PARBDY, vt)}",
+        f"  edtag: RIDGE {n(tags.RIDGE, et)}  REF {n(tags.REF, et)}"
+        f"  REQ {n(tags.REQUIRED, et)}  NOM {n(tags.NOM, et)}",
+        f"  trtag: REQ {n(tags.REQUIRED, tt)}"
+        f"  PARBDY {n(tags.PARBDY, tt)}  NOSURF {n(tags.NOSURF, tt)}",
+    ]
+    return "\n".join(lines)
+
+
+def format_comm(comm) -> str:
+    """Human-readable node-communicator tables —
+    `PMMG_printCommunicator` (`src/libparmmg.h:2554`)."""
+    counts = np.asarray(comm.counts)
+    l2g = np.asarray(comm.l2g)
+    D = counts.shape[0]
+    lines = [f"  node communicators over {D} shards "
+             f"(table capacity {comm.icap}):"]
+    for s in range(D):
+        nbrs = [
+            f"{r}:{counts[s, r]}" for r in range(D)
+            if r != s and counts[s, r] > 0
+        ]
+        owned = int(np.asarray(comm.owner)[s].sum())
+        lines.append(
+            f"    shard {s}: owned {owned}, shared with "
+            f"{{{', '.join(nbrs) if nbrs else '-'}}}"
+        )
+    total = int(counts.sum()) // 2
+    ci = np.asarray(comm.comm_idx)
+    ifc: set = set()
+    for s in range(D):
+        for r in range(D):
+            c = int(counts[s, r])
+            if r != s and c:
+                ifc.update(l2g[s][ci[s, r, :c]].tolist())
+    lines.append(
+        f"    total shared pairs {total}, distinct interface gids {len(ifc)}"
+    )
+    return "\n".join(lines)
