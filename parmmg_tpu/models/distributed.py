@@ -433,16 +433,17 @@ def adapt_stacked_input(
     opts = opts or DistOptions()
     opts = dataclasses.replace(opts, nparts=stacked.vert.shape[0])
 
-    # per-shard preprocess: adjacency + analysis + metric (the reference
-    # preprocesses each rank's mesh then runs PMMG_analys; cross-shard
-    # feature agreement is conservative — interface entities are frozen
-    # and NOSURF interface trias are excluded from dihedral detection)
+    # per-shard preprocess: adjacency + analysis + metric, then the
+    # cross-shard feature agreement pass for surface edges split by an
+    # interface (the reference's PMMG_analys with its PMMG_setdhd
+    # exchange rounds, src/libparmmg.c:314 + src/analys_pmmg.c:2001)
     shards = []
     ecap0 = int(stacked.tet.shape[1] * 1.6) + 64
     for m in unstack_mesh(stacked):
-        m = analysis.analyze(m, ang=opts.angle)
-        m = prepare_metric(m, opts, ecap0)
-        shards.append(m)
+        shards.append(analysis.analyze(m, ang=opts.angle))
+    if opts.angle is not None:
+        shards = analysis.cross_shard_features(shards, ang=opts.angle)
+    shards = [prepare_metric(m, opts, ecap0) for m in shards]
     fcaps = {m.fcap for m in shards}
     ecaps = {m.ecap for m in shards}
     if len(fcaps) > 1 or len(ecaps) > 1:  # analysis growth diverged

@@ -28,6 +28,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import tags
 from ..core.mesh import FACE_VERTS, Mesh
@@ -412,6 +413,132 @@ def detect_features(mesh: Mesh, ang: float = ANG_DEFAULT) -> Mesh:
         mesh = mesh.with_capacity(ecap=int((ned0 + n_new) * 1.3) + 8)
     mesh = _apply_features(mesh, first, prs, etag, new_sel, match)
     return classify_corners(mesh, cos_ang=cos_ang)
+
+
+def cross_shard_features(
+    shards: list, ang: float = ANG_DEFAULT
+) -> list:
+    """Feature detection for surface edges split by a shard interface —
+    the `PMMG_setdhd` role (reference `src/analys_pmmg.c:2001`): each
+    side of an interface-crossing surface edge sees only ONE of the two
+    adjacent boundary trias, so per-shard dihedral detection must skip it
+    (the suppression in `_detect_feature_edges`); here the missing half
+    is exchanged across shards, keyed by global vertex ids.
+
+    The reference runs owner-computed triangle-normal exchanges over its
+    edge communicators (`MPI_ANALYS_TAG` rounds); on one host the
+    exchange is a dict join — on multi-host it becomes one bounded
+    `all_gather` of (gid-pair, normal, ref) rows per shard. Singularity
+    re-classification then reruns per shard (`PMMG_singul` role).
+
+    Takes/returns a list of per-shard Meshes (already through
+    `analyze()`, so vglob + PARBDY tags are set and normals orientable).
+    """
+    import math as _math
+
+    cos_ang = _math.cos(_math.radians(ang))
+    # collect interface-edge rows from every shard
+    rows = {}  # (glo, ghi) -> list of (shard, unit normal, trref)
+    locs = {}  # (glo, ghi) -> list of (shard, lo_slot, hi_slot)
+    for s, m in enumerate(shards):
+        unit, _, ok = tria_normals(m)
+        unit = np.asarray(unit)
+        ok = np.asarray(ok)
+        tria = np.asarray(m.tria)
+        trref = np.asarray(m.trref)
+        vt = np.asarray(m.vtag)
+        vg = np.asarray(m.vglob)
+        par = ((vt & tags.PARBDY) != 0) & (vg >= 0)
+        for e0, e1 in ((0, 1), (1, 2), (0, 2)):
+            a, b = tria[:, e0], tria[:, e1]
+            sel = ok & par[a] & par[b]
+            for fi in np.nonzero(sel)[0]:
+                ga, gb = int(vg[a[fi]]), int(vg[b[fi]])
+                key = (min(ga, gb), max(ga, gb))
+                rows.setdefault(key, []).append(
+                    (s, unit[fi], int(trref[fi]))
+                )
+                la, lb = int(a[fi]), int(b[fi])
+                if ga > gb:
+                    la, lb = lb, la
+                locs.setdefault(key, []).append((s, la, lb))
+
+    # classify keys whose trias live on DIFFERENT shards (same-shard
+    # pairs were already handled by the local detection)
+    new_edges = {s: [] for s in range(len(shards))}  # (lo,hi,tag)
+    for key, lst in rows.items():
+        shards_in = {s for s, _, _ in lst}
+        if len(shards_in) < 2:
+            continue
+        etag = 0
+        if len(lst) == 2:
+            (s1, n1, r1), (s2, n2, r2) = lst
+            if float(np.dot(n1, n2)) < cos_ang:
+                etag |= tags.RIDGE
+            if r1 != r2:
+                etag |= tags.REF
+        else:  # cross-shard non-manifold fan
+            etag |= tags.NOM | tags.REQUIRED
+        if not etag:
+            continue
+        for s, la, lb in locs[key]:
+            new_edges[s].append((la, lb, etag))
+
+    out = []
+    for s, m in enumerate(shards):
+        if new_edges[s]:
+            arr = np.array(
+                sorted(set(new_edges[s])), np.int64
+            )
+            m = _merge_host_edges(m, arr[:, :2], arr[:, 2])
+            m = classify_corners(m, cos_ang=cos_ang)
+        out.append(m)
+    return out
+
+
+def _merge_host_edges(mesh: Mesh, pairs: np.ndarray, etags: np.ndarray) -> Mesh:
+    """OR tags into matching stored feature edges / append the missing
+    ones, then re-propagate vertex tags (host-side variant of
+    `_apply_features` for the cross-shard pass)."""
+    edge = np.asarray(mesh.edge)
+    edmask = np.asarray(mesh.edmask).copy()
+    edtag = np.asarray(mesh.edtag).copy()
+    edref = np.asarray(mesh.edref)
+    live = np.nonzero(edmask)[0]
+    existing = {
+        (min(int(edge[i, 0]), int(edge[i, 1])),
+         max(int(edge[i, 0]), int(edge[i, 1]))): i
+        for i in live
+    }
+    to_add = []
+    for (a, b), t in zip(pairs, etags):
+        key = (min(int(a), int(b)), max(int(a), int(b)))
+        i = existing.get(key)
+        if i is not None:
+            edtag[i] |= int(t)
+        else:
+            to_add.append((key[0], key[1], int(t)))
+    ned = int(edmask.sum())
+    if ned + len(to_add) > mesh.ecap:
+        mesh = mesh.with_capacity(ecap=int((ned + len(to_add)) * 1.3) + 8)
+        edge = np.asarray(mesh.edge)
+        m2 = np.asarray(mesh.edmask)
+        e2 = np.asarray(mesh.edtag).copy()
+        e2[: len(edtag)] = edtag
+        edmask, edtag = m2.copy(), e2
+        edref = np.asarray(mesh.edref)
+    edge = edge.copy()
+    edref = edref.copy()
+    for k, (a, b, t) in enumerate(to_add):
+        edge[ned + k] = (a, b)
+        edtag[ned + k] = t
+        edref[ned + k] = 0
+        edmask[ned + k] = True
+    mesh = mesh.replace(
+        edge=jnp.asarray(edge), edtag=jnp.asarray(edtag),
+        edref=jnp.asarray(edref), edmask=jnp.asarray(edmask),
+    )
+    return _tag_feature_vertices(mesh)
 
 
 def analyze(
