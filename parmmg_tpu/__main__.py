@@ -26,6 +26,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="metric .sol file")
     p.add_argument("-v", dest="verbose", type=int, default=1,
                    help="verbosity level")
+    p.add_argument("-m", dest="mem", type=float, default=None,
+                   help="memory budget in MB per shard for mesh arrays")
     # remeshing controls (Mmg-forwarded flags)
     p.add_argument("-hsiz", type=float, default=None,
                    help="constant target edge size")
@@ -109,6 +111,7 @@ def main(argv=None) -> int:
         noinsert=args.noinsert, noswap=args.noswap,
         nomove=args.nomove, nosurf=args.nosurf,
         verbose=args.verbose,
+        mem_budget_mb=args.mem,
         nparts=args.nparts,
         nobalancing=args.nobalancing,
         ifc_layers=args.ifc_layers,
@@ -189,6 +192,7 @@ def main(argv=None) -> int:
                 angle=opts.angle, optim=opts.optim,
                 noinsert=opts.noinsert, noswap=opts.noswap,
                 nomove=opts.nomove, nosurf=opts.nosurf,
+                mem_budget_mb=opts.mem_budget_mb,
                 verbose=opts.verbose,
             )
             mesh_out, info = adapt(mesh, aopts)

@@ -292,6 +292,9 @@ class ParMesh:
             o.nparts = int(value)
         elif param == Param.IPARAM_APImode:
             self.api_mode = APIDistrib(value)
+        elif param == Param.IPARAM_mem:
+            # -m: memory budget in MB per shard (zaldy_pmmg.c role)
+            o.mem_budget_mb = float(value) if value > 0 else None
         else:
             # accepted for call-site parity (mem/debug/octree/... have no
             # TPU-side effect yet); remembered for get_iparameter

@@ -52,6 +52,13 @@ class AdaptOptions:
     # capacity management
     grow_trigger: float = 0.85
     grow_factor: float = 1.6
+    # device-memory budget in MB for the mesh arrays (per shard in the
+    # distributed driver) — the role of the reference's per-node memory
+    # budget (`PMMG_parmesh_SetMemGloMax`, `src/zaldy_pmmg.c:53`; -m
+    # flag / IPARAM_mem). None = unlimited. Exceeding it raises
+    # RuntimeError, which the distributed loop degrades to LOWFAILURE
+    # with the last conformal mesh.
+    mem_budget_mb: Optional[float] = None
     verbose: int = 0
 
 
@@ -199,11 +206,42 @@ def _counts(mesh: Mesh):
     )
 
 
+def estimate_mesh_bytes(
+    mesh: Mesh, pc: int, tc: int, fc: int, ec: int
+) -> int:
+    """Device bytes the mesh arrays would occupy at the given capacities
+    (current per-slot byte rates scaled — the sizing arithmetic of
+    `PMMG_setMeshSize_alloc`, `src/zaldy_pmmg.c:256`)."""
+    fs = jnp.dtype(mesh.dtype).itemsize
+    per_v = 3 * fs + 4 * 3 + 1 + (
+        mesh.met.shape[-1] + mesh.ls.shape[-1] + mesh.disp.shape[-1]
+        + mesh.fields.shape[-1]
+    ) * fs + 4  # vert+vref/vtag/vglob+vmask+sols
+    per_t = 4 * 4 + 4 + 1 + 4 * 4        # tet+tref+tmask+adja
+    per_f = 3 * 4 + 4 + 4 + 1
+    per_e = 2 * 4 + 4 + 4 + 1
+    return pc * per_v + tc * per_t + fc * per_f + ec * per_e
+
+
+def _check_budget(mesh: Mesh, opts: AdaptOptions, pc, tc, fc, ec):
+    if opts.mem_budget_mb is None:
+        return
+    need = estimate_mesh_bytes(mesh, pc, tc, fc, ec)
+    if need > opts.mem_budget_mb * 1e6:
+        raise RuntimeError(
+            f"mesh memory budget exceeded: growth to caps "
+            f"(p={pc}, t={tc}, f={fc}, e={ec}) needs "
+            f"{need / 1e6:.1f} MB > budget {opts.mem_budget_mb} MB"
+        )
+
+
 def ensure_capacity(mesh: Mesh, opts: AdaptOptions) -> Mesh:
     """Host-side capacity planning (the reference's memory-budget role,
     `src/zaldy_pmmg.c`): grow arrays when utilization crosses the trigger
     so jitted sweeps keep headroom. Growth changes static shapes and hence
-    recompiles — growth is geometric to bound recompilations."""
+    recompiles — growth is geometric to bound recompilations. A
+    configured memory budget caps growth (RuntimeError, degraded to
+    LOWFAILURE by the distributed loop)."""
     npo, nte, ntr, ned = _counts(mesh)
     g = opts.grow_factor
 
@@ -217,6 +255,7 @@ def ensure_capacity(mesh: Mesh, opts: AdaptOptions) -> Mesh:
     fc = target(ntr, mesh.fcap)
     ec = target(ned, mesh.ecap)
     if (pc, tc, fc, ec) != (mesh.pcap, mesh.tcap, mesh.fcap, mesh.ecap):
+        _check_budget(mesh, opts, pc, tc, fc, ec)
         mesh = mesh.with_capacity(pc, tc, fc, ec)
     return mesh
 
@@ -297,16 +336,24 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     h0 = quality.quality_histogram(mesh)
 
     # pre-size capacities for the predicted unit mesh so sweeps compile
-    # once instead of once per growth bucket
+    # once instead of once per growth bucket. Presizing is an
+    # optimization: when it would blow the memory budget it is skipped
+    # (the sweeps then grow incrementally until the budget genuinely
+    # blocks a needed growth, which raises from ensure_capacity).
     est_ne = int(estimate_target_ntet(mesh) * 1.35) + 64
     if est_ne > mesh.tcap:
-        est_np = est_ne // 5 + 64
-        mesh = mesh.with_capacity(
-            pcap=max(mesh.pcap, est_np),
-            tcap=est_ne,
-            fcap=max(mesh.fcap, est_ne // 4 + 64),
-            ecap=max(mesh.ecap, est_ne // 16 + 64),
+        want = (
+            max(mesh.pcap, est_ne // 5 + 64),
+            est_ne,
+            max(mesh.fcap, est_ne // 4 + 64),
+            max(mesh.ecap, est_ne // 16 + 64),
         )
+        try:
+            _check_budget(mesh, opts, *want)
+        except RuntimeError:
+            pass
+        else:
+            mesh = mesh.with_capacity(*want)
 
     def sweep_fn(m, ecap):
         m, st = remesh_sweep(

@@ -85,19 +85,29 @@ def grow_stacked(
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *grown)
 
 
-def _presize_for_target(st: Mesh) -> Mesh:
+def _presize_for_target(st: Mesh, opts: AdaptOptions | None = None) -> Mesh:
     """Pre-size capacities for the predicted unit mesh (per-shard max) so
-    the sweep compiles once per growth bucket at most."""
+    the sweep compiles once per growth bucket at most. Skipped when the
+    predicted size would blow the per-shard memory budget (presize is an
+    optimization; real growth failures raise inside the iterations and
+    degrade to LOWFAILURE)."""
     ests = [estimate_target_ntet(m) for m in unstack_mesh(st)]
     est_ne = int(max(ests) * 1.35) + 64
     if est_ne > st.tet.shape[1]:
-        st = grow_stacked(
-            st,
-            pcap=max(st.vert.shape[1], est_ne // 5 + 64),
-            tcap=est_ne,
-            fcap=max(st.tria.shape[1], est_ne // 4 + 64),
-            ecap=max(st.edge.shape[1], est_ne // 16 + 64),
+        want = (
+            max(st.vert.shape[1], est_ne // 5 + 64),
+            est_ne,
+            max(st.tria.shape[1], est_ne // 4 + 64),
+            max(st.edge.shape[1], est_ne // 16 + 64),
         )
+        if opts is not None:
+            from .adapt import _check_budget
+
+            try:
+                _check_budget(st, opts, *want)
+            except RuntimeError:
+                return st
+        st = grow_stacked(st, *want)
     return st
 
 
@@ -123,6 +133,10 @@ def ensure_capacity_stacked(st: Mesh, opts: AdaptOptions) -> Mesh:
         target(ned, caps[3]),
     )
     if want != caps:
+        from .adapt import _check_budget
+
+        # per-shard budget (uniform capacities = uniform per-shard cost)
+        _check_budget(st, opts, *want)
         st = grow_stacked(st, *want)
     return st
 
@@ -260,7 +274,7 @@ def adapt_distributed(
     stacked, comm = split_mesh(
         mesh, part, nparts, build_shard_adjacency=False
     )
-    stacked = _presize_for_target(stacked)
+    stacked = _presize_for_target(stacked, opts)
 
     history: List[dict] = []
     stacked, comm, status = _iteration_loop(stacked, opts, hausd, history)
@@ -410,7 +424,7 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
             build_shard_adjacency=False,
         )
         icap = None  # interface sets changed; re-derive table shape
-        stacked = _presize_for_target(stacked)
+        stacked = _presize_for_target(stacked, opts)
 
     return stacked, comm, icap
 
@@ -462,7 +476,7 @@ def adapt_stacked_input(
         jax.vmap(quality.quality_histogram)(stacked)
     )
 
-    stacked = _presize_for_target(stacked)
+    stacked = _presize_for_target(stacked, opts)
     history: List[dict] = []
     # the supplied comm's tables stay valid in shape (interfaces are
     # frozen, shared lists can only shrink): reuse its capacity so the
