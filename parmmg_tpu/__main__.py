@@ -133,6 +133,11 @@ def main(argv=None) -> int:
                 vals, _types = medit.read_sol(args.sol)
                 ls = vals[:, :1]
             mesh = medit.raw_to_mesh(raw, ls=ls)
+        elif args.input.endswith(".vtu"):
+            # input format sniffing (reference `src/parmmg.c:157-210`)
+            from .io import vtk as vtk_io
+
+            mesh = vtk_io.load_vtu(args.input)
         else:
             mesh = medit.load_mesh(args.input, args.sol)
 
@@ -202,6 +207,16 @@ def main(argv=None) -> int:
                                        "INPUT MESH QUALITY"))
         print(quality.format_histogram(info["qual_out"],
                                        "OUTPUT MESH QUALITY"))
+        if mesh_out is not None:
+            # edge-length histogram (PMMG_prilen role)
+            from .core import adjacency as adj
+
+            m_l = adj.build_adjacency(mesh_out)
+            ecap_l = int(m_l.tcap * 1.7) + 64
+            e_l, em_l, _, _ = adj.unique_edges(m_l, ecap_l)
+            print(quality.format_length_stats(
+                quality.length_stats(m_l, e_l, em_l)
+            ))
 
     with timers.phase("output"):
         distributed_out = args.dist_out or (

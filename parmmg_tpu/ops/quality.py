@@ -213,3 +213,32 @@ def length_stats(mesh: Mesh, edges, emask) -> LengthStats:
         emask.astype(jnp.int32), mode="drop"
     )
     return LengthStats(ne, lmin, lmax, lavg, small, large, unit, counts)
+
+
+def format_length_stats(ls: LengthStats) -> str:
+    """Edge-length report with the reference's bins (`PMMG_prilen`
+    output shape, `src/quality_pmmg.c:591-719`)."""
+    edges = [float(e) for e in jax.device_get(_LEN_EDGES)]
+    counts = [int(c) for c in jax.device_get(ls.counts)]
+    ne = max(int(ls.nedge), 1)
+    lines = [
+        f"  -- RESULTING EDGE LENGTHS  {int(ls.nedge)} edges",
+        f"     AVERAGE LENGTH {float(ls.lavg):12.4f}",
+        f"     SMALLEST EDGE  {float(ls.lmin):12.4f}",
+        f"     LARGEST  EDGE  {float(ls.lmax):12.4f}",
+        f"     unit [1/sqrt2, sqrt2]: {int(ls.n_unit)} "
+        f"({100.0 * int(ls.n_unit) / ne:.2f} %)",
+    ]
+    # counts[0] is below edges[0]=0 (empty); interior bins then overflow
+    for k in range(len(edges) - 1):
+        c = counts[k + 1]
+        lines.append(
+            f"     {edges[k]:6.2f} < L < {edges[k + 1]:6.2f}  "
+            f"{c:10d}  {100.0 * c / ne:6.2f} %"
+        )
+    c_over = counts[len(edges)]
+    lines.append(
+        f"     {edges[-1]:6.2f} < L          {c_over:10d}  "
+        f"{100.0 * c_over / ne:6.2f} %"
+    )
+    return "\n".join(lines)
