@@ -219,12 +219,13 @@ def main(argv=None) -> int:
             ))
 
     with timers.phase("output"):
-        distributed_out = args.dist_out or (
-            (args.dist_in or args.nparts > 1) and not args.cent_out
-            and args.dist_out
-        )
+        # output mode follows the input mode unless overridden: distributed
+        # input defaults to distributed output, centralized input to
+        # centralized, -distributed-output/-centralized-output force
+        # (reference `PMMG_IPARAM_distributedOutput` + parsar discipline)
+        distributed_out = not args.cent_out and (args.dist_out or args.dist_in)
         vtk = out.endswith((".vtu", ".pvtu"))
-        if mesh_out is None and (args.dist_out and not args.cent_out):
+        if distributed_out and mesh_out is None:
             if vtk:
                 from .io import vtk as vtk_io
 
@@ -232,6 +233,31 @@ def main(argv=None) -> int:
             else:
                 medit.save_mesh_distributed(stacked, comm, out,
                                             with_met=True)
+        elif distributed_out:
+            # single-part run asked for distributed output: one rank file
+            if vtk:
+                from .io import vtk as vtk_io
+
+                if out.endswith(".pvtu"):
+                    # a .pvtu is an XML index over .vtu pieces — write the
+                    # piece plus the one-piece index, not raw vtu content
+                    # under a .pvtu name
+                    import jax
+                    import jax.numpy as jnp
+
+                    stacked1 = jax.tree_util.tree_map(
+                        lambda a: jnp.asarray(a)[None], mesh_out
+                    )
+                    vtk_io.save_pvtu(stacked1, None, out)
+                else:
+                    vtk_io.save_vtu(mesh_out, medit.shard_filename(out, 0))
+            else:
+                medit.save_mesh(mesh_out, medit.shard_filename(out, 0))
+                medit.save_met(
+                    mesh_out,
+                    os.path.splitext(medit.shard_filename(out, 0))[0]
+                    + ".sol",
+                )
         else:
             if mesh_out is None:
                 mesh_out = merge_adapted(stacked, comm)

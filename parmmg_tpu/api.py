@@ -282,6 +282,14 @@ class ParMesh:
         elif param == Param.IPARAM_angle:
             if not value:
                 o.angle = None
+            elif o.angle is None:
+                # re-enable detection: restore the last DPARAM value or
+                # the 45-degree default (reference PMMG_Set_iparameter
+                # toggle semantics)
+                from .ops.analysis import ANG_DEFAULT
+
+                last = self.dparam.get(Param.DPARAM_angleDetection)
+                o.angle = ANG_DEFAULT if last is None else last
         elif param == Param.IPARAM_nobalancing:
             o.nobalancing = bool(value)
         elif param == Param.IPARAM_ifcLayers:

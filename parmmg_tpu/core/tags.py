@@ -35,6 +35,19 @@ IMMOVABLE = REQUIRED | CORNER | PARBDY
 UNCOLLAPSIBLE = REQUIRED | CORNER | PARBDY | NOM
 
 
+def pure_interface_tria(trtag):
+    """Bool mask: tria is a *synthetic* parallel-interface face
+    (PARBDY|NOSURF without PARBDYBDY) — an interior face of the global
+    mesh materialized as frozen pseudo-boundary by the split, to be
+    stripped again at merge. Works on numpy and jnp int arrays; the one
+    definition shared by the checkpoint writer, the merge, and tests."""
+    return (
+        ((trtag & PARBDY) != 0)
+        & ((trtag & NOSURF) != 0)
+        & ((trtag & PARBDYBDY) == 0)
+    )
+
+
 class ReturnStatus(enum.IntEnum):
     """Graded failure model, mirroring the reference semantics
     (PMMG_SUCCESS / PMMG_LOWFAILURE / PMMG_STRONGFAILURE,

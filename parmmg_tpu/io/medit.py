@@ -323,7 +323,14 @@ def save_mesh(
         _fmt_block(f, "Ridges", ridges[:, None], None, False)
         req_ed = np.nonzero(d["edtags"] & tags.REQUIRED)[0] + 1
         _fmt_block(f, "RequiredEdges", req_ed[:, None], None, False)
-        req_tr = np.nonzero(d["trtags"] & tags.REQUIRED)[0] + 1
+        # pure synthetic interface trias are excluded: their REQUIRED is
+        # split-added and restored from the face-comm sections on load;
+        # PARBDYBDY (real-surface) interface trias stay listed here, which
+        # is what lets the loader tell the two kinds apart
+        req_tr = np.nonzero(
+            ((d["trtags"] & tags.REQUIRED) != 0)
+            & ~tags.pure_interface_tria(d["trtags"])
+        )[0] + 1
         _fmt_block(f, "RequiredTriangles", req_tr[:, None], None, False)
         # communicator local ids are mesh slot ids; entity sections above
         # are written in compacted numbering, so remap through the same maps
@@ -404,7 +411,44 @@ def save_mesh_distributed(stacked: Mesh, comm, path: str,
                 continue
             loc = comm_idx[s, r, :c]
             node_comms.append((r, loc, l2g[s][loc]))
-        save_mesh(m, shard_filename(path, s), node_comms=node_comms)
+        # Interface trias carrying a split-added NOSURF (both the pure
+        # synthetic ones and real-surface PARBDYBDY replicas) are persisted
+        # as face-comm sections so a reloaded run restores the
+        # MG_PARBDY/MG_NOSURF distinction — Medit's RequiredTriangles alone
+        # cannot carry it and the resumed run would otherwise freeze these
+        # faces as plain REQUIRED surface (reference stores its face
+        # communicators the same way, `src/inout_pmmg.c:798`). The loader
+        # tells the kinds apart by RequiredTriangles membership: pure
+        # synthetic trias are excluded from it (see save_mesh), PARBDYBDY
+        # ones stay in.
+        trtag_s = np.asarray(m.trtag)
+        syn = (
+            np.asarray(m.trmask)
+            & ((trtag_s & tags.PARBDY) != 0)
+            & ((trtag_s & tags.NOSURF) != 0)
+        )
+        tria_ids = np.nonzero(syn)[0]
+        face_comms = []
+        if len(tria_ids):
+            member = np.zeros((l2g.shape[1], D), bool)
+            for r in range(D):
+                c = int(counts[s, r])
+                if r != s and c:
+                    member[comm_idx[s, r, :c], r] = True
+            tv = np.asarray(m.tria)[tria_ids]
+            in_r = member[tv].all(axis=1)  # [K, D]
+            # the neighbor sharing all three vertices (exists by
+            # construction: a synthetic tria is a tet face between
+            # exactly two shards); argmax falls back to 0 harmlessly —
+            # the loader unions the lists and ignores colors
+            color = np.argmax(in_r, axis=1)
+            for r in np.unique(color):
+                sel = color == r
+                face_comms.append(
+                    (int(r), tria_ids[sel], np.zeros(int(sel.sum()), np.int64))
+                )
+        save_mesh(m, shard_filename(path, s), node_comms=node_comms,
+                  face_comms=face_comms or None)
         if with_met:
             base, _ = os.path.splitext(shard_filename(path, s))
             save_met(m, base + ".sol")

@@ -27,7 +27,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core import tags
-from ..core.mesh import EDGE_VERTS, Mesh
+from ..core.mesh import EDGE_VERTS, FACE_VERTS, Mesh
 
 REF_IN = 3
 REF_OUT = 2
@@ -233,6 +233,27 @@ def discretize_levelset(
         out_trefs.append(ref_iso)
         out_ttags.append(tags.BDY | tags.REF)
 
+    # drop sub-trias whose owner sub-tet was discarded as a degenerate
+    # sliver above: a boundary tria with no adjacent tet face would make
+    # tria_normals fall back to stored winding and could misclassify the
+    # patch during feature detection
+    out_tris_a = np.asarray(out_tris, np.int64).reshape(-1, 3)
+    out_trefs_a = np.asarray(out_trefs, np.int64)
+    out_ttags_a = np.asarray(out_ttags, np.int64)
+    if len(out_tris_a):
+        fkeys = np.sort(
+            out_tets[:, np.asarray(FACE_VERTS)].reshape(-1, 3), axis=1
+        )
+        tkeys = np.sort(out_tris_a, axis=1)
+        allrows = np.concatenate([fkeys, tkeys])
+        _, inv = np.unique(allrows, axis=0, return_inverse=True)
+        is_face = np.zeros(inv.max() + 1, bool)
+        is_face[inv[: len(fkeys)]] = True
+        keep = is_face[inv[len(fkeys):]]
+        out_tris_a = out_tris_a[keep]
+        out_trefs_a = out_trefs_a[keep]
+        out_ttags_a = out_ttags_a[keep]
+
     # --- vertex data -------------------------------------------------------
     def cat(name, newvals):
         return np.concatenate([d[name], newvals], axis=0)
@@ -249,9 +270,9 @@ def discretize_levelset(
         all_pts, out_tets, trefs=out_refs,
         vrefs=cat("vrefs", np.zeros(len(new_pts), np.int32)),
         vtags=vtags,
-        trias=np.asarray(out_tris, np.int64),
-        trrefs=np.asarray(out_trefs, np.int64),
-        trtags=np.asarray(out_ttags, np.int64),
+        trias=out_tris_a,
+        trrefs=out_trefs_a,
+        trtags=out_ttags_a,
         edges=d["edges"], edrefs=d["edrefs"], edtags=d["edtags"],
         met=met,
         ls=np.concatenate([d["ls"] - 0.0, ls_new]),

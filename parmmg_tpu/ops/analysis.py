@@ -256,6 +256,11 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
     dot = jnp.einsum("si,si->s", unit[tri_of], unit[tri_partner])
     refdiff = mesh.trref[tri_of] != mesh.trref[tri_partner]
     has_partner = partner_sorted >= 0
+    # NB: synthetic interface trias (PARBDY|NOSURF) never reach these
+    # dihedral/ref tests — surf_tria_mask excludes them from tria_normals'
+    # `ok`, so their edge slots are dead here; the checkpoint round trip
+    # (io.medit face-comm persistence) guarantees reloaded meshes keep
+    # that NOSURF tagging
 
     etag_sorted = jnp.zeros(n3, jnp.int32)
     etag_sorted = jnp.where(

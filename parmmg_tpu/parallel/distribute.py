@@ -404,6 +404,16 @@ def stack_loaded_shards(
     gids: List[np.ndarray | None] = []
     ifc_trias: List[np.ndarray] = []
     for raw in raws:
+        # face-comm tria lists restore the PARBDY|NOSURF tagging of the
+        # synthetic interface trias regardless of which mode identifies
+        # the vertices (a checkpoint written by save_mesh_distributed
+        # carries BOTH: node comms for gids, face comms for trias)
+        if raw.face_comms:
+            tr = np.concatenate([np.asarray(c[1], np.int64)
+                                 for c in raw.face_comms])
+            ifc_trias.append(np.unique(tr))
+        else:
+            ifc_trias.append(np.zeros(0, np.int64))
         if raw.node_comms:
             loc = np.concatenate([np.asarray(c[1], np.int64)
                                   for c in raw.node_comms])
@@ -412,17 +422,12 @@ def stack_loaded_shards(
             loc, first = np.unique(loc, return_index=True)
             loc_ids.append(loc)
             gids.append(gid[first] if (gid >= 0).all() and len(gid) else None)
-            ifc_trias.append(np.zeros(0, np.int64))
         elif raw.face_comms:
-            tr = np.concatenate([np.asarray(c[1], np.int64)
-                                 for c in raw.face_comms])
-            ifc_trias.append(np.unique(tr))
-            loc_ids.append(np.unique(raw.trias[np.unique(tr)].reshape(-1)))
+            loc_ids.append(np.unique(raw.trias[ifc_trias[-1]].reshape(-1)))
             gids.append(None)
         else:
             loc_ids.append(np.zeros(0, np.int64))
             gids.append(None)
-            ifc_trias.append(np.zeros(0, np.int64))
 
     if any(g is None and len(l) for g, l in zip(gids, loc_ids)):
         # derive shared numbering by exact coordinate matching
@@ -460,9 +465,32 @@ def stack_loaded_shards(
         vglob[loc_ids[s]] = gids[s]
         trtag = np.asarray(m.trtag).copy()
         if len(ifc_trias[s]):
-            trtag[ifc_trias[s]] |= (
+            ifc = ifc_trias[s]
+            trtag[ifc] |= (
                 tags.PARBDY | tags.REQUIRED | tags.NOSURF | tags.BDY
             )
+            # a face-comm tria ALSO listed in RequiredTriangles is a
+            # real-surface interface replica (PARBDYBDY discipline): the
+            # checkpoint writer keeps those in RequiredTriangles and drops
+            # the pure synthetic ones (io.medit.save_mesh)
+            bb = np.isin(ifc, raw.req_trias)
+            trtag[ifc[bb]] |= tags.PARBDYBDY
+            # user-required interface replicas carry no NOSURF and are
+            # therefore NOT in the face-comm list (split_mesh withholds
+            # NOSURF when user_req): restore their PARBDY|PARBDYBDY|BDY
+            # bookkeeping from the interface vertex set
+            vtx_par = np.zeros(len(raw.verts), bool)
+            vtx_par[loc_ids[s]] = True
+            tria_np = raw.trias
+            if len(tria_np):
+                in_ifc = np.zeros(len(tria_np), bool)
+                in_ifc[ifc] = True
+                ureq = np.zeros(len(tria_np), bool)
+                ureq[raw.req_trias] = True
+                rep = ureq & ~in_ifc & vtx_par[tria_np].all(axis=1)
+                trtag[np.nonzero(rep)[0]] |= (
+                    tags.PARBDY | tags.PARBDYBDY | tags.BDY
+                )
         m = m.replace(
             vtag=jnp.asarray(vtag),
             vglob=jnp.asarray(vglob),
@@ -552,12 +580,7 @@ def merge_shards(stacked: Mesh, comm: ShardComm) -> Mesh:
         # boundary. PARBDYBDY trias are REAL boundary replicated on both
         # sides — kept (and deduplicated below).
         trtag_s = np.asarray(m.trtag)
-        pure_par = (
-            ((trtag_s & tags.PARBDY) != 0)
-            & ((trtag_s & tags.NOSURF) != 0)
-            & ((trtag_s & tags.PARBDYBDY) == 0)
-        )
-        fm = np.asarray(m.trmask) & ~pure_par
+        fm = np.asarray(m.trmask) & ~tags.pure_interface_tria(trtag_s)
         tt = trtag_s[fm] & ~(tags.PARBDY | tags.PARBDYBDY)
         # REQUIRED that came with NOSURF was split-added (reference
         # MG_NOSURF convention): strip both, keep user-required intact

@@ -107,6 +107,19 @@ def test_distributed_io_checkpoint_loop(tmp_path):
         path, 4, metpath=str(tmp_path / "ckpt.sol")
     )
     chkcomm.assert_comm_ok(stacked2, comm2, device_mesh(4), tol=1e-6)
+    # the PARBDY|NOSURF discipline of synthetic interface trias must
+    # survive the round trip (else they come back as plain REQUIRED
+    # surface and freeze permanently — advisor round-2 medium finding)
+    import numpy as np
+
+    from parmmg_tpu.core import tags as tg
+
+    tt0 = np.asarray(stacked.trtag)
+    tt1 = np.asarray(stacked2.trtag)
+    syn0 = np.asarray(stacked.trmask) & tg.pure_interface_tria(tt0)
+    syn1 = np.asarray(stacked2.trmask) & tg.pure_interface_tria(tt1)
+    assert syn0.sum() > 0, "expected synthetic interface trias in ckpt"
+    assert syn1.sum(axis=1).tolist() == syn0.sum(axis=1).tolist()
     # continue adapting from the checkpoint
     out, comm3, _ = adapt_stacked_input(
         stacked2, comm2,
@@ -116,6 +129,19 @@ def test_distributed_io_checkpoint_loop(tmp_path):
     merged = merge_adapted(out, comm3)
     rep = conformity.check_mesh(merged)
     assert rep.ok, str(rep)
+    # merged output must not retain interface pseudo-boundary trias:
+    # every surviving tria is a real boundary face (exactly one owner tet)
+    from parmmg_tpu.core.adjacency import build_adjacency
+
+    madj = build_adjacency(merged)
+    adja = np.asarray(madj.adja)
+    tm = np.asarray(madj.tmask)
+    bdry_faces = ((adja < 0) & tm[:, None]).sum()
+    ntria = int(np.asarray(merged.trmask).sum())
+    assert ntria <= bdry_faces, (
+        f"{ntria} trias > {bdry_faces} boundary faces: interior "
+        "pseudo-boundary trias leaked through the checkpoint"
+    )
 
 
 def test_vtu_roundtrip(tmp_path):
