@@ -30,7 +30,13 @@ def build_adjacency(mesh: Mesh) -> Mesh:
     """Fill `mesh.adja`: adja[t,f] = 4*t2+f2 for the tet face glued to (t,f),
     -1 for boundary faces. Masked tets get all -1 and never match. Faces
     shared by 3+ tets (invalid input) are left unmatched (-1) rather than
-    silently mis-paired; `utils.conformity.check_mesh` reports them."""
+    silently mis-paired; `utils.conformity.check_mesh` reports them.
+
+    When vertex ids fit the packed-key bound, the (b,c) columns collapse
+    into one uint32 key, cutting the sort from 3 comparator columns to 2
+    (see ops.common.pack_ok)."""
+    from ..ops import common as _common
+
     tc = mesh.tcap
     tet = mesh.tet
     # face vertex triples, canonically sorted; dead slots get unique sentinels
@@ -39,13 +45,21 @@ def build_adjacency(mesh: Mesh) -> Mesh:
     slot = jnp.arange(tc * 4, dtype=jnp.int32).reshape(tc, 4)
     dead = ~mesh.tmask[:, None]
     a = jnp.where(dead, _BIG, a).reshape(-1)
-    b = jnp.where(dead, slot, b).reshape(-1)
-    c = jnp.where(dead, slot, c).reshape(-1)
-    order = jnp.lexsort((c, b, a)).astype(jnp.int32)
-    sa, sb, sc = a[order], b[order], c[order]
-    eq_next = (
-        (sa[:-1] == sa[1:]) & (sb[:-1] == sb[1:]) & (sc[:-1] == sc[1:])
-    )
+    if _common.pack_ok(mesh.pcap, 2):
+        s = jnp.uint32(mesh.pcap + 1)
+        bc = b.astype(jnp.uint32) * s + c.astype(jnp.uint32)
+        bc = jnp.where(dead, slot.astype(jnp.uint32), bc).reshape(-1)
+        order = jnp.lexsort((bc, a)).astype(jnp.int32)
+        sa, sbc = a[order], bc[order]
+        eq_next = (sa[:-1] == sa[1:]) & (sbc[:-1] == sbc[1:])
+    else:
+        b = jnp.where(dead, slot, b).reshape(-1)
+        c = jnp.where(dead, slot, c).reshape(-1)
+        order = jnp.lexsort((c, b, a)).astype(jnp.int32)
+        sa, sb, sc = a[order], b[order], c[order]
+        eq_next = (
+            (sa[:-1] == sa[1:]) & (sb[:-1] == sb[1:]) & (sc[:-1] == sc[1:])
+        )
     eq_next = jnp.concatenate([eq_next, jnp.zeros(1, bool)])
     eq_prev = jnp.concatenate([jnp.zeros(1, bool), eq_next[:-1]])
     # pair only runs of exactly 2 equal faces; longer runs are invalid
@@ -70,22 +84,18 @@ def unique_edges(mesh: Mesh, ecap: int):
     entries are -1) — callers must check and re-run with a larger cap.
     `ecap = 6*tcap` is always safe; ~1.3*tcap suffices for well-connected
     tet meshes (~1.19 edges/tet asymptotically)."""
+    from ..ops import common as _common
+
     tc = mesh.tcap
     ev = mesh.tet[:, EDGE_VERTS]  # [TC, 6, 2]
     lo = jnp.minimum(ev[..., 0], ev[..., 1])
     hi = jnp.maximum(ev[..., 0], ev[..., 1])
-    slot = jnp.arange(tc * 6, dtype=jnp.int32).reshape(tc, 6)
-    dead = ~mesh.tmask[:, None]
-    lo = jnp.where(dead, _BIG, lo).reshape(-1)
-    hi = jnp.where(dead, slot, hi).reshape(-1)
-    order = jnp.lexsort((hi, lo)).astype(jnp.int32)
-    slo, shi = lo[order], hi[order]
-    newgrp = jnp.concatenate(
-        [jnp.ones(1, bool), (slo[1:] != slo[:-1]) | (shi[1:] != shi[:-1])]
+    dead = jnp.broadcast_to(~mesh.tmask[:, None], (tc, 6))
+    order, newgrp, live_sorted, slo, shi = _common.sorted_pair_groups(
+        lo.reshape(-1), hi.reshape(-1), dead.reshape(-1), mesh.pcap
     )
     # unique edge id per sorted position (0-based over all groups incl. dead)
     gid = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
-    live_sorted = slo < _BIG
     # edge arrays: scatter first member of each live group
     first = newgrp & live_sorted
     edges = jnp.zeros((ecap, 2), jnp.int32)

@@ -138,6 +138,94 @@ def remesh_sweep(
     )
 
 
+# history columns of remesh_sweeps: one int32 row per executed sweep
+HIST_COLS = (
+    "nsplit", "ncollapse", "nswap", "nmoved", "ne", "np", "n_unique",
+    "capped",
+)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "ecap", "max_sweeps", "noinsert", "noswap", "nomove", "nosurf",
+        "grow_trigger", "converge_frac",
+    ),
+    donate_argnums=0,
+)
+def remesh_sweeps(
+    mesh: Mesh,
+    n_left,
+    ecap: int,
+    max_sweeps: int,
+    noinsert: bool = False,
+    noswap: bool = False,
+    nomove: bool = False,
+    nosurf: bool = False,
+    hausd: float = 0.01,
+    converge_frac: float = 0.005,
+    grow_trigger: float = 0.85,
+):
+    """Run up to `max_sweeps` fused sweeps in ONE device program.
+
+    The per-sweep host round trip of the naive loop (dispatch + stats
+    readback) costs more than a sweep's compute on a remote accelerator;
+    here the sweep loop is a `lax.while_loop` that exits early when the
+    mesh converged (ops below `converge_frac`) or when host intervention
+    is needed: capacity growth crossing `grow_trigger`, a capped split,
+    or unique-edge overflow. The host inspects the last history row to
+    decide what to do next — the role split matches the reference, where
+    `PMMG_parmmglib1` drives Mmg sweeps and only reallocation returns to
+    the coordination layer (`src/libparmmg1.c:636-896`).
+
+    `max_sweeps` is STATIC (fixes the history shape — pass the constant
+    options value so the compile cache is keyed only on mesh shapes);
+    `n_left` is the DYNAMIC remaining sweep budget of this call.
+
+    Returns (mesh, hist [max_sweeps, len(HIST_COLS)] int32, n_done).
+    """
+
+    def body(state):
+        m, hist, k, _ = state
+        m, st = remesh_sweep(
+            m, ecap,
+            noinsert=noinsert, noswap=noswap, nomove=nomove, nosurf=nosurf,
+            hausd=hausd,
+        )
+        ne = m.ntet
+        npo = m.npoin
+        nops = st.nsplit + st.ncollapse + st.nswap
+        overflow = st.n_unique > ecap
+        near_cap = (
+            (npo > grow_trigger * m.pcap)
+            | (ne > grow_trigger * m.tcap)
+            | (m.ntria > grow_trigger * m.fcap)
+            | (m.nedge > grow_trigger * m.ecap)
+        )
+        converged = (
+            ~st.split_capped
+            & ~overflow
+            & (nops <= converge_frac * jnp.maximum(ne, 1))
+        )
+        stop = converged | st.split_capped | overflow | near_cap
+        row = jnp.stack([
+            st.nsplit, st.ncollapse, st.nswap, st.nmoved,
+            ne, npo, st.n_unique, st.split_capped.astype(jnp.int32),
+        ])
+        hist = hist.at[k].set(row)
+        return m, hist, k + 1, stop
+
+    def cond(state):
+        _, _, k, stop = state
+        return (k < jnp.minimum(max_sweeps, n_left)) & ~stop
+
+    hist0 = jnp.zeros((max_sweeps, len(HIST_COLS)), jnp.int32)
+    mesh, hist, n_done, _ = jax.lax.while_loop(
+        cond, body, (mesh, hist0, jnp.int32(0), jnp.bool_(False))
+    )
+    return mesh, hist, n_done
+
+
 def resolve_hausd(mesh: Mesh, opts: AdaptOptions) -> float:
     """-hausd value, defaulting to 0.01 x bounding-box diagonal (the
     reference applies Mmg's default hausd=0.01 on the unit-scaled mesh,
@@ -319,6 +407,67 @@ def run_sweep_loop(
     return state
 
 
+def run_batched_sweep_loop(
+    mesh: Mesh,
+    opts: AdaptOptions,
+    emult: List[float],
+    history: List[dict],
+    it: int,
+    hausd: float,
+) -> Mesh:
+    """Single-shard sweep engine on top of `remesh_sweeps`: each device
+    call runs as many sweeps as it can; the host only intervenes for
+    capacity growth / edge-cap overflow, then re-enters. Replaces one
+    dispatch + stats readback PER SWEEP with one per capacity event."""
+    budget = opts.max_sweeps
+    done = 0
+    while done < budget:
+        mesh = ensure_capacity(mesh, opts)
+        ecap = int(mesh.tcap * emult[0]) + 64
+        mesh, hist, n_done = remesh_sweeps(
+            mesh, jnp.int32(budget - done), ecap, opts.max_sweeps,
+            noinsert=opts.noinsert, noswap=opts.noswap,
+            nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
+            converge_frac=opts.converge_frac,
+            grow_trigger=opts.grow_trigger,
+        )
+        n = int(n_done)
+        if n == 0:
+            break
+        import numpy as _np
+
+        rows = _np.asarray(jax.device_get(hist))[:n]
+        for i, row in enumerate(rows):
+            rec = dict(zip(HIST_COLS, (int(x) for x in row)))
+            rec["capped"] = bool(rec["capped"])
+            rec.update(iter=it, sweep=done + i)
+            history.append(rec)
+            if opts.verbose >= 2:
+                print(
+                    f"  it {it} sweep {rec['sweep']}: +{rec['nsplit']} "
+                    f"split -{rec['ncollapse']} collapse {rec['nswap']} "
+                    f"swap {rec['nmoved']} moved -> ne={rec['ne']}"
+                )
+        last = history[-1]
+        overflow = last["n_unique"] > ecap
+        if overflow:
+            emult[0] = max(
+                emult[0] * 1.5,
+                1.1 * last["n_unique"] / max(mesh.tcap, 1),
+            )
+            if budget < opts.max_sweeps + 4:
+                budget += 1
+        done += n
+        nops = last["nsplit"] + last["ncollapse"] + last["nswap"]
+        if (
+            not last["capped"]
+            and not overflow
+            and nops <= opts.converge_frac * max(last["ne"], 1)
+        ):
+            break
+    return mesh
+
+
 def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     """Adapt `mesh` to its metric. Returns (mesh, info dict).
 
@@ -357,36 +506,9 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
         else:
             mesh = mesh.with_capacity(*want)
 
-    def sweep_fn(m, ecap):
-        m, st = remesh_sweep(
-            m,
-            ecap,
-            noinsert=opts.noinsert,
-            noswap=opts.noswap,
-            nomove=opts.nomove,
-            nosurf=opts.nosurf,
-            hausd=hausd,
-        )
-        rec = dict(
-            nsplit=int(st.nsplit),
-            ncollapse=int(st.ncollapse),
-            nswap=int(st.nswap),
-            nmoved=int(st.nmoved),
-            ne=int(m.ntet),
-            np=int(m.npoin),
-            n_unique=int(st.n_unique),
-            capped=bool(st.split_capped),
-        )
-        return m, rec
-
     history: List[dict] = []
     for it in range(opts.niter):
-        mesh = run_sweep_loop(
-            mesh, opts, emult, history, it,
-            ensure_fn=lambda m: ensure_capacity(m, opts),
-            tcap_fn=lambda m: m.tcap,
-            sweep_fn=sweep_fn,
-        )
+        mesh = run_batched_sweep_loop(mesh, opts, emult, history, it, hausd)
 
     mesh = compact(mesh)
     h1 = quality.quality_histogram(mesh)

@@ -83,7 +83,8 @@ def _missing_face_info(mesh: Mesh):
     trkeys = _sorted3(
         jnp.where(mesh.trmask[:, None], mesh.tria, -1)
     )
-    have = common.sorted_membership(trkeys, fkeys).reshape(-1, 4)
+    have = common.sorted_membership(trkeys, fkeys,
+                                    bound=mesh.pcap).reshape(-1, 4)
     need = open_face & ~have
     return need, jnp.sum(need.astype(jnp.int32))
 
@@ -162,7 +163,8 @@ def tria_normals(mesh: Mesh):
     fkeys = _sorted3(fverts).reshape(-1, 3)
     fkeys = jnp.where(jnp.repeat(mesh.tmask, 4)[:, None], fkeys, -1)
     trkeys = _sorted3(jnp.where(smask[:, None], mesh.tria, -1))
-    fid1, fid2, cnt = common.match_rows2(fkeys, trkeys)  # into 4*TC
+    fid1, fid2, cnt = common.match_rows2(fkeys, trkeys,
+                                         bound=mesh.pcap)  # into 4*TC
     t1 = jnp.maximum(fid1, 0) // 4
     t2 = jnp.maximum(fid2, 0) // 4
     ref1 = mesh.tref[t1]
@@ -221,22 +223,18 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
     fcap = mesh.fcap
     unit, _, ok = tria_normals(mesh)
 
+    from . import common
+
     t = mesh.tria
     pairs = jnp.stack([t[:, [0, 1]], t[:, [1, 2]], t[:, [0, 2]]], axis=1)
     lo = jnp.minimum(pairs[..., 0], pairs[..., 1]).reshape(-1)
     hi = jnp.maximum(pairs[..., 0], pairs[..., 1]).reshape(-1)
     n3 = 3 * fcap
-    slot = jnp.arange(n3, dtype=jnp.int32)
     dead = ~jnp.repeat(ok, 3)
-    lo = jnp.where(dead, jnp.int32(2**30), lo)
-    hi = jnp.where(dead, slot, hi)
-    order = jnp.lexsort((hi, lo)).astype(jnp.int32)
-    slo, shi = lo[order], hi[order]
-    newgrp = jnp.concatenate(
-        [jnp.ones(1, bool), (slo[1:] != slo[:-1]) | (shi[1:] != shi[:-1])]
+    order, newgrp, live_sorted, slo, shi = common.sorted_pair_groups(
+        lo, hi, dead, mesh.pcap
     )
     gid = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
-    live_sorted = slo < jnp.int32(2**30)
     cnt_g = jnp.zeros(n3, jnp.int32).at[gid].add(
         live_sorted.astype(jnp.int32)
     )
@@ -321,7 +319,7 @@ def _merge_info(mesh: Mesh, first, prs, etag):
     )
     feat = first & (etag != 0)
     q = jnp.where(feat[:, None], prs, -1)
-    match = common.match_rows(ekeys, q)
+    match = common.match_rows(ekeys, q, bound=mesh.pcap)
     new_sel = feat & (match < 0)
     return new_sel, jnp.sum(new_sel.astype(jnp.int32)), match
 

@@ -96,7 +96,7 @@ def collapse_short_edges(
     smask = surf_tria_mask(mesh)
     tri_keys = common.tria_edge_keys(mesh, smask)
     surf_e = common.sorted_membership(
-        tri_keys, jnp.where(emask[:, None], edges, -1)
+        tri_keys, jnp.where(emask[:, None], edges, -1), bound=mesh.pcap
     )
     feat = common.feature_edge_index(mesh, edges, emask)
     feat_tag = jnp.where(feat >= 0, mesh.edtag[jnp.maximum(feat, 0)], 0)
@@ -236,7 +236,7 @@ def collapse_short_edges(
         del_t = is_shell & accept[e_ts]
         tet_tent = jnp.where(app_t[:, None], new_tet, tet)
         valid_tent = tmask & ~del_t
-        dup = common.duplicate_tets(tet_tent, valid_tent)
+        dup = common.duplicate_tets(tet_tent, valid_tent, bound=mesh.pcap)
         bad_e = jnp.zeros(ecap, bool).at[
             jnp.where(dup & has, e_t, ecap)
         ].max(True, mode="drop")
@@ -332,6 +332,7 @@ def collapse_short_edges(
     dup = common.duplicate_tets(
         jnp.where((is_ball & win[e_ts])[:, None], new_tet, tet),
         tmask & ~(is_shell & win[e_ts]),
+        bound=mesh.pcap,
     )
     bad_e = jnp.zeros(ecap, bool).at[
         jnp.where(dup & has, e_t, ecap)

@@ -70,28 +70,122 @@ def two_phase_winners(
     return is_top & (lo >= best_lo)
 
 
-def _run_match(keys: jax.Array, query: jax.Array):
+# uint32 sentinel for packed invalid rows (valid packed keys are
+# < (bound+1)^2 - 1 <= 0xFFFE0000 when bound <= PACK_BOUND, so the
+# sentinel never collides)
+SENT_U32 = jnp.uint32(0xFFFFFFFF)
+# largest entity-id bound for which two int32 keys pack into one uint32
+PACK_BOUND = 65534
+
+
+def pack_ok(bound, ncols: int) -> bool:
+    """Static predicate: can `ncols` keys with values in [0, bound) be
+    pairwise-packed into uint32 sort keys? Packing halves the comparator
+    width of the (bitonic on TPU) sort — the dominant cost of the
+    sort-merge kernels — at the price of one multiply-add per row."""
+    return bound is not None and ncols >= 2 and bound <= PACK_BOUND
+
+
+def _pack_pairs(rows: jax.Array, invalid: jax.Array, bound: int):
+    """[N,c] int32 rows with values in [0,bound) -> tuple of uint32 key
+    columns, adjacent columns packed pairwise; invalid rows map to
+    all-sentinel keys (shared — callers mask invalid rows out of every
+    result, so a shared group is safe)."""
+    s = jnp.uint32(bound + 1)
+    c = rows.shape[1]
+    cols = []
+    i = 0
+    while i < c:
+        if i + 1 < c:
+            kk = rows[:, i].astype(jnp.uint32) * s + rows[:, i + 1].astype(
+                jnp.uint32
+            )
+            i += 2
+        else:
+            kk = rows[:, i].astype(jnp.uint32)
+            i += 1
+        cols.append(jnp.where(invalid, SENT_U32, kk))
+    return tuple(cols)
+
+
+def _row_order_groups(rows: jax.Array, invalid: jax.Array, bound):
+    """Shared sort core of the row-matching helpers: returns
+    (order [N] int32 — sorted row order, newgrp [N] bool — run starts).
+    With a static `bound` on the row values the sort runs on packed
+    uint32 keys (half the comparator width); otherwise on the raw
+    columns with unique negative sentinels for invalid rows."""
+    n, c = rows.shape
+    if pack_ok(bound, c):
+        cols = _pack_pairs(rows.astype(jnp.int32), invalid, bound)
+        order = jnp.lexsort(tuple(reversed(cols))).astype(jnp.int32)
+        sc = [kk[order] for kk in cols]
+        diff = sc[0][1:] != sc[0][:-1]
+        for kk in sc[1:]:
+            diff = diff | (kk[1:] != kk[:-1])
+        newgrp = jnp.concatenate([jnp.ones(1, bool), diff])
+        return order, newgrp
+    slot = jnp.arange(n, dtype=jnp.int32)
+    uniq = jnp.concatenate(
+        [(-(slot[:, None] + 2)), jnp.zeros((n, c - 1), jnp.int32)], axis=1
+    )
+    r = jnp.where(invalid[:, None], uniq, rows.astype(jnp.int32))
+    order = jnp.lexsort(tuple(r[:, i] for i in reversed(range(c)))).astype(
+        jnp.int32
+    )
+    sr = r[order]
+    newgrp = jnp.concatenate(
+        [jnp.ones(1, bool), jnp.any(sr[1:] != sr[:-1], axis=1)]
+    )
+    return order, newgrp
+
+
+def sorted_pair_groups(lo, hi, dead, bound, dead_slot=None):
+    """Sort (lo,hi) pairs and mark group starts — the shared core of
+    `unique_edges` and `_detect_feature_edges`. Returns
+    (order, newgrp, live_sorted, slo, shi) where slo/shi are the pair
+    values in sorted order (garbage on dead rows in the packed path —
+    consumers must gate on live_sorted). With `bound` packable the sort
+    runs on one uint32 key; dead rows share the max sentinel and form a
+    single trailing group that never becomes a representative.
+    `dead_slot` (unpacked path only) supplies unique hi-values for dead
+    rows; defaults to arange."""
+    n = lo.shape[0]
+    if pack_ok(bound, 2):
+        s = jnp.uint32(bound + 1)
+        key = lo.astype(jnp.uint32) * s + hi.astype(jnp.uint32)
+        key = jnp.where(dead, SENT_U32, key)
+        order = jnp.argsort(key).astype(jnp.int32)
+        sk = key[order]
+        newgrp = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+        live_sorted = sk != SENT_U32
+        return order, newgrp, live_sorted, lo[order], hi[order]
+    slot = (
+        jnp.arange(n, dtype=jnp.int32) if dead_slot is None else dead_slot
+    )
+    big = jnp.int32(2**30)
+    lo_s = jnp.where(dead, big, lo)
+    hi_s = jnp.where(dead, slot, hi)
+    order = jnp.lexsort((hi_s, lo_s)).astype(jnp.int32)
+    slo, shi = lo_s[order], hi_s[order]
+    newgrp = jnp.concatenate(
+        [jnp.ones(1, bool), (slo[1:] != slo[:-1]) | (shi[1:] != shi[:-1])]
+    )
+    return order, newgrp, slo < big, slo, shi
+
+
+def _run_match(keys: jax.Array, query: jax.Array, bound=None):
     """Sort-merge row matching: for each query row, does it appear among
     `keys` rows, and at what first index? Rows containing any negative
     entry are treated as invalid and never match. Returns (hit [Q] bool,
-    idx [Q] int32 first-match index into keys or -1). int32-only."""
+    idx [Q] int32 first-match index into keys or -1). int32-only.
+    `bound` (static, optional): exclusive upper bound on row values,
+    enables packed uint32 sort keys."""
     k, c = keys.shape
     q = query.shape[0]
     n = k + q
     rows = jnp.concatenate([keys, query], axis=0).astype(jnp.int32)
     invalid = jnp.any(rows < 0, axis=1)
-    slot = jnp.arange(n, dtype=jnp.int32)
-    uniq = jnp.concatenate(
-        [(-(slot[:, None] + 2)), jnp.zeros((n, c - 1), jnp.int32)], axis=1
-    )
-    rows = jnp.where(invalid[:, None], uniq, rows)
-    order = jnp.lexsort(tuple(rows[:, i] for i in reversed(range(c)))).astype(
-        jnp.int32
-    )
-    sr = rows[order]
-    newgrp = jnp.concatenate(
-        [jnp.ones(1, bool), jnp.any(sr[1:] != sr[:-1], axis=1)]
-    )
+    order, newgrp = _row_order_groups(rows, invalid, bound)
     gid = (jnp.cumsum(newgrp.astype(jnp.int32)) - 1).astype(jnp.int32)
     from_key = order < k
     cnt = jnp.zeros(n, jnp.int32).at[gid].add(from_key.astype(jnp.int32))
@@ -108,7 +202,7 @@ def _run_match(keys: jax.Array, query: jax.Array):
     return hit[k:] & ~invalid[k:], jnp.where(invalid[k:], -1, idx[k:])
 
 
-def _run_match2(keys: jax.Array, query: jax.Array):
+def _run_match2(keys: jax.Array, query: jax.Array, bound=None):
     """Like `_run_match` but returns, per query row, the FIRST and LAST
     matching key-row indices plus the match count (for entities that can
     legitimately appear twice among the keys, e.g. internal tria faces
@@ -118,18 +212,7 @@ def _run_match2(keys: jax.Array, query: jax.Array):
     n = k + q
     rows = jnp.concatenate([keys, query], axis=0).astype(jnp.int32)
     invalid = jnp.any(rows < 0, axis=1)
-    slot = jnp.arange(n, dtype=jnp.int32)
-    uniq = jnp.concatenate(
-        [(-(slot[:, None] + 2)), jnp.zeros((n, c - 1), jnp.int32)], axis=1
-    )
-    rows = jnp.where(invalid[:, None], uniq, rows)
-    order = jnp.lexsort(tuple(rows[:, i] for i in reversed(range(c)))).astype(
-        jnp.int32
-    )
-    sr = rows[order]
-    newgrp = jnp.concatenate(
-        [jnp.ones(1, bool), jnp.any(sr[1:] != sr[:-1], axis=1)]
-    )
+    order, newgrp = _row_order_groups(rows, invalid, bound)
     gid = (jnp.cumsum(newgrp.astype(jnp.int32)) - 1).astype(jnp.int32)
     from_key = order < k
     cnt = jnp.zeros(n, jnp.int32).at[gid].add(from_key.astype(jnp.int32))
@@ -158,23 +241,24 @@ def _run_match2(keys: jax.Array, query: jax.Array):
     return out_lo[k:], out_hi[k:], out_cnt[k:]
 
 
-def match_rows2(keys: jax.Array, query: jax.Array):
+def match_rows2(keys: jax.Array, query: jax.Array, bound=None):
     """(first_idx, last_idx, count) of each query row among `keys` rows
     (-1/-1/0 when absent; rows with negative entries never match)."""
-    return _run_match2(keys, query)
+    return _run_match2(keys, query, bound)
 
 
-def sorted_membership(keys: jax.Array, query: jax.Array) -> jax.Array:
+def sorted_membership(keys: jax.Array, query: jax.Array,
+                      bound=None) -> jax.Array:
     """[Q] bool: does each query row appear among `keys` rows? Rows with
     any negative entry never match."""
-    hit, _ = _run_match(keys, query)
+    hit, _ = _run_match(keys, query, bound)
     return hit
 
 
-def match_rows(keys: jax.Array, query: jax.Array) -> jax.Array:
+def match_rows(keys: jax.Array, query: jax.Array, bound=None) -> jax.Array:
     """[Q] int32 index of the first row of `keys` equal to each query row,
     -1 if absent."""
-    _, idx = _run_match(keys, query)
+    _, idx = _run_match(keys, query, bound)
     return idx
 
 
@@ -200,7 +284,7 @@ def surface_edge_mask(mesh: Mesh, edges: jax.Array, emask: jax.Array):
     does through `MMG5_HGeom` hashes (`src/hash_pmmg.c`)."""
     keys = tria_edge_keys(mesh)
     q = jnp.where(emask[:, None], edges, -1)
-    return sorted_membership(keys, q)
+    return sorted_membership(keys, q, bound=mesh.pcap)
 
 
 def feature_edge_index(mesh: Mesh, edges: jax.Array, emask: jax.Array):
@@ -213,24 +297,39 @@ def feature_edge_index(mesh: Mesh, edges: jax.Array, emask: jax.Array):
         [jnp.where(dead, -1, lo), jnp.where(dead, -1, hi)], axis=1
     )
     q = jnp.where(emask[:, None], edges, -1)
-    return match_rows(keys, q)
+    return match_rows(keys, q, bound=mesh.pcap)
 
 
-def duplicate_tets(tet: jax.Array, valid: jax.Array) -> jax.Array:
+def duplicate_tets(tet: jax.Array, valid: jax.Array, bound=None) -> jax.Array:
     """[T] bool: tet's sorted vertex set appears more than once among valid
     tets (topological damage detector used to reject unsafe collapses —
-    the batched stand-in for Mmg's link-condition check)."""
+    the batched stand-in for Mmg's link-condition check). `bound` (static,
+    optional) = exclusive vertex-id bound, enables packed uint32 keys."""
     tcap = tet.shape[0]
-    keys = jnp.sort(tet, axis=1)
     slot = jnp.arange(tcap, dtype=jnp.int32)
-    keys = jnp.where(valid[:, None], keys, -(slot[:, None] + 2))
-    order = jnp.lexsort((keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0])).astype(
-        jnp.int32
-    )
-    sk = keys[order]
-    same_next = jnp.concatenate(
-        [jnp.all(sk[:-1] == sk[1:], axis=1), jnp.zeros(1, bool)]
-    )
+    keys = jnp.sort(tet, axis=1)
+    if pack_ok(bound, 4):
+        s = jnp.uint32(bound + 1)
+        k0 = keys[:, 0].astype(jnp.uint32) * s + keys[:, 1].astype(jnp.uint32)
+        k1 = keys[:, 2].astype(jnp.uint32) * s + keys[:, 3].astype(jnp.uint32)
+        # invalid rows: sentinel first key, unique second key (slot) so
+        # two invalid rows never read as duplicates of each other
+        k0 = jnp.where(valid, k0, SENT_U32)
+        k1 = jnp.where(valid, k1, slot.astype(jnp.uint32))
+        order = jnp.lexsort((k1, k0)).astype(jnp.int32)
+        s0, s1 = k0[order], k1[order]
+        same_next = jnp.concatenate(
+            [(s0[:-1] == s0[1:]) & (s1[:-1] == s1[1:]), jnp.zeros(1, bool)]
+        )
+    else:
+        keys = jnp.where(valid[:, None], keys, -(slot[:, None] + 2))
+        order = jnp.lexsort(
+            (keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0])
+        ).astype(jnp.int32)
+        sk = keys[order]
+        same_next = jnp.concatenate(
+            [jnp.all(sk[:-1] == sk[1:], axis=1), jnp.zeros(1, bool)]
+        )
     same_prev = jnp.concatenate([jnp.zeros(1, bool), same_next[:-1]])
     dup_sorted = same_next | same_prev
     out = jnp.zeros(tcap, bool).at[order].set(dup_sorted)
@@ -245,15 +344,20 @@ def vol_of(vert: jax.Array, tet: jax.Array) -> jax.Array:
 
 def quality_of(vert: jax.Array, met: jax.Array, tet: jax.Array) -> jax.Array:
     """Quality of arbitrary tet rows against given vert/met arrays (same
-    measure as ops.quality.tet_quality, usable on tentative configs)."""
+    measure as ops.quality.tet_quality, usable on tentative configs).
+
+    Gathers the 4 corner rows once and derives the 6 edge vectors from
+    them — random-index gathers are the dominant kernel cost on TPU
+    (row-DMA bound), so 4 wide rows beat 12 endpoint lookups."""
     from ..core import metric as metric_mod
     from ..core.mesh import EDGE_VERTS
     from .quality import ALPHA
 
-    vol = vol_of(vert, tet)
-    ev = tet[:, EDGE_VERTS]
-    p0, p1 = vert[ev[..., 0]], vert[ev[..., 1]]
-    e = p1 - p0
+    c = vert[tet]                                     # [T,4,3] one gather
+    d1, d2, d3 = c[:, 1] - c[:, 0], c[:, 2] - c[:, 0], c[:, 3] - c[:, 0]
+    vol = jnp.einsum("ti,ti->t", jnp.cross(d1, d2), d3) / 6.0
+    ev = jnp.asarray(EDGE_VERTS)
+    e = c[:, ev[:, 1]] - c[:, ev[:, 0]]               # [T,6,3] from corners
     if met.shape[1] == 6:
         mt = jnp.mean(met[tet], axis=1)
         M = metric_mod.sym6_to_mat(mt)

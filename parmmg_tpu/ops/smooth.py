@@ -76,7 +76,7 @@ def smooth_vertices(
     smask = surf_tria_mask(mesh)
     tri_keys = common.tria_edge_keys(mesh, smask)
     surf_e = common.sorted_membership(
-        tri_keys, jnp.where(emask[:, None], edges, -1)
+        tri_keys, jnp.where(emask[:, None], edges, -1), bound=mesh.pcap
     )
     feat = common.feature_edge_index(mesh, edges, emask)
     feat_tag = jnp.where(feat >= 0, mesh.edtag[jnp.maximum(feat, 0)], 0)

@@ -67,7 +67,8 @@ def split_long_edges(
     fcap = mesh.fcap
     edge_keys = jnp.where(emask[:, None], edges, -1)
     tri_keys = common.tria_edge_keys(mesh)  # [3*FC, 2], pair order 01,12,02
-    eid3 = common.match_rows(edge_keys, tri_keys).reshape(fcap, 3)
+    eid3 = common.match_rows(edge_keys, tri_keys,
+                             bound=mesh.pcap).reshape(fcap, 3)
 
     def mark_edges(tri_mask):
         tgt = jnp.where(tri_mask[:, None] & (eid3 >= 0), eid3, ecap)

@@ -60,38 +60,69 @@ def swap_32(
     safe_t2e = jnp.where(live_e, t2e, 0)
     flat_e = jnp.where(live_e, t2e, ecap).reshape(-1)
 
-    # shell size per edge
-    inc = jnp.zeros(ecap, jnp.int32).at[flat_e].add(
-        jnp.ones(tcap * 6, jnp.int32), mode="drop"
-    )
     surf = common.surface_edge_mask(mesh, edges, emask)
 
-    # ring vertices via the twice-each trick
-    e6 = jnp.where(live_e, t2e, ecap)
-    # off-edge vertex sum/min/max per edge: each tet contributes the two
-    # vertices not on the edge
-    va, vb = a[safe_t2e], b[safe_t2e]          # [TC,6]
-    ring_sum = jnp.zeros(ecap, jnp.int32)
-    ring_min = jnp.full(ecap, 2**30, jnp.int32)
-    ring_max = jnp.full(ecap, -1, jnp.int32)
-    for c in range(4):
-        vc = tet[:, c][:, None]                # [TC,1] -> broadcast [TC,6]
-        vcb = jnp.broadcast_to(vc, (tcap, 6))
-        off = (vcb != va) & (vcb != vb) & live_e
-        idx = jnp.where(off, e6, ecap).reshape(-1)
-        vals = vcb.reshape(-1)
-        ring_sum = ring_sum.at[idx].add(vals, mode="drop")
-        ring_min = ring_min.at[idx].min(vals, mode="drop")
-        ring_max = ring_max.at[idx].max(vals, mode="drop")
-    u = ring_min
-    w = ring_max
-    v = ring_sum // 2 - u - w
-
-    # old worst quality over the shell
-    q_old = common.quality_of(mesh.vert, mesh.met, tet)
-    shell_min_q = jnp.full(ecap, jnp.inf).at[flat_e].min(
-        jnp.broadcast_to(q_old[:, None], (tcap, 6)).reshape(-1), mode="drop"
+    # Ring vertices: for edge slot k of a tet, the two OFF-edge local
+    # corners are known statically (complement of EDGE_VERTS[k]) — no
+    # comparisons, and the per-edge reductions pack into ONE scatter-add
+    # ([N,2] int: vertex sum + shell count) and ONE scatter-min ([N,3]
+    # float: min off-vertex, negated max off-vertex, shell quality).
+    # Random-index scatters are row-DMA bound on TPU, so three wide
+    # passes replace the fifteen narrow ones of the per-corner loop.
+    OFF = jnp.asarray(
+        [[2, 3], [1, 3], [1, 2], [0, 3], [0, 2], [0, 1]], jnp.int32
     )
+    off1 = tet[:, OFF[:, 0]]                   # [TC,6]
+    off2 = tet[:, OFF[:, 1]]
+    q_old = common.quality_of(mesh.vert, mesh.met, tet)
+    vol_all = common.vol_of(mesh.vert, tet)
+
+    int_pack = jnp.stack(
+        [off1 + off2, jnp.ones((tcap, 6), jnp.int32)], axis=-1
+    ).reshape(-1, 2)
+    int_acc = jnp.zeros((ecap, 2), jnp.int32).at[flat_e].add(
+        int_pack, mode="drop"
+    )
+    ring_sum, inc = int_acc[:, 0], int_acc[:, 1]
+
+    fdt = mesh.vert.dtype
+    if mesh.pcap <= (1 << (jnp.finfo(fdt).nmant + 1)):
+        # vertex ids are exact in fdt: pack both ring-id reductions with
+        # the shell quality into one wide scatter-min
+        min_pack = jnp.stack(
+            [
+                jnp.minimum(off1, off2).astype(fdt),
+                -jnp.maximum(off1, off2).astype(fdt),
+                jnp.broadcast_to(q_old[:, None], (tcap, 6)),
+            ],
+            axis=-1,
+        ).reshape(-1, 3)
+        min_acc = jnp.full((ecap, 3), jnp.inf, fdt).at[flat_e].min(
+            min_pack, mode="drop"
+        )
+        u = jnp.where(
+            jnp.isfinite(min_acc[:, 0]), min_acc[:, 0], 2**30
+        ).astype(jnp.int32)
+        w = jnp.where(
+            jnp.isfinite(min_acc[:, 1]), -min_acc[:, 1], -1
+        ).astype(jnp.int32)
+        shell_min_q = min_acc[:, 2]
+    else:
+        # ids would round in fdt (pcap beyond the mantissa): exact int32
+        # reductions, separate float min for the quality
+        imin_pack = jnp.stack(
+            [jnp.minimum(off1, off2), -jnp.maximum(off1, off2)], axis=-1
+        ).reshape(-1, 2)
+        iacc = jnp.full((ecap, 2), 2**30, jnp.int32).at[flat_e].min(
+            imin_pack, mode="drop"
+        )
+        u = iacc[:, 0]
+        w = jnp.where(iacc[:, 1] == 2**30, -1, -iacc[:, 1])
+        shell_min_q = jnp.full(ecap, jnp.inf, fdt).at[flat_e].min(
+            jnp.broadcast_to(q_old[:, None], (tcap, 6)).reshape(-1),
+            mode="drop",
+        )
+    v = ring_sum // 2 - u - w
 
     ok_ring = (u >= 0) & (v >= 0) & (w >= 0) & (u != v) & (v != w) & (u != w)
     cand = (
@@ -116,7 +147,6 @@ def swap_32(
     # individually positive but overlap outside the old shell (each tet
     # has exactly one slot matching this edge, so the scatter counts each
     # shell tet once)
-    vol_all = common.vol_of(mesh.vert, tet)
     shell_vol = jnp.zeros(ecap, vol_all.dtype).at[flat_e].add(
         jnp.broadcast_to(vol_all[:, None], (tcap, 6)).reshape(-1), mode="drop"
     )
@@ -132,9 +162,12 @@ def swap_32(
     )
     # the new tets must not already exist
     tet_keys = jnp.where(tmask[:, None], jnp.sort(tet, axis=1), -1)
-    exists1 = common.sorted_membership(tet_keys, jnp.sort(t1, axis=1))
-    exists2 = common.sorted_membership(tet_keys, jnp.sort(t2_, axis=1))
-    cand = cand & gain_ok & ~exists1 & ~exists2
+    exists = common.sorted_membership(
+        tet_keys,
+        jnp.concatenate([jnp.sort(t1, axis=1), jnp.sort(t2_, axis=1)]),
+        bound=mesh.pcap,
+    )
+    cand = cand & gain_ok & ~exists[:ecap] & ~exists[ecap:]
 
     # --- arena = the 3 shell tets -----------------------------------------
     def scatter_arena(vals):
@@ -174,7 +207,7 @@ def swap_32(
     tmask_new = tmask & ~rank2
 
     # duplicate post-check (cross-swap interactions)
-    dup = common.duplicate_tets(tet_new, tmask_new)
+    dup = common.duplicate_tets(tet_new, tmask_new, bound=mesh.pcap)
     bad_e = jnp.zeros(ecap, bool).at[
         jnp.where(dup & has, e_t, ecap)
     ].max(True, mode="drop")
@@ -225,7 +258,7 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
     equery = jnp.stack(
         [jnp.where(valid, elo, -1), jnp.where(valid, ehi, -1)], axis=1
     )
-    edge_exists = common.sorted_membership(ekeys, equery)
+    edge_exists = common.sorted_membership(ekeys, equery, bound=mesh.pcap)
 
     # three new tets around (d1,d2)
     x, y, z = fv[:, 0], fv[:, 1], fv[:, 2]
@@ -290,7 +323,7 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
     tmask_out = tmask.at[tgt_c].set(win, mode="drop")
 
     # duplicate post-check: reject interacting winners and revert
-    dup = common.duplicate_tets(tet_out, tmask_out)
+    dup = common.duplicate_tets(tet_out, tmask_out, bound=mesh.pcap)
     bad = (
         dup[jnp.clip(t_id, 0, tcap - 1)]
         | dup[t2c]

@@ -1,0 +1,121 @@
+"""Per-op steady-state profiler for the remeshing kernels.
+
+Times each kernel of the sweep (warm jit, block_until_ready) on whatever
+backend jax resolves — run as-is for the TPU tunnel, or with
+`env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu` for the host anchor.
+Produces the PERF_NOTES.md table. Usage:
+
+    python tools/profile_ops.py [n] [hsiz] [reps]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1000.0, out
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    hsiz = float(sys.argv[2]) if len(sys.argv) > 2 else 0.08
+    reps = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+
+    from parmmg_tpu.core import adjacency
+    from parmmg_tpu.core.mesh import compact
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.ops import analysis, collapse, smooth, split, swap
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    print(f"platform: {jax.devices()[0].platform}", flush=True)
+    est = int(12.0 / hsiz**3)
+    mesh = unit_cube_mesh(
+        n,
+        tcap=int(est * 1.9),
+        pcap=max(int(est * 0.45), 4096),
+        fcap=max(int(est * 0.30), 4096),
+    )
+    # reach steady state: one adaptation pass
+    t0 = time.perf_counter()
+    mesh, _ = adapt(mesh, AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=8,
+                                       hgrad=None))
+    print(f"steady-state prep: {time.perf_counter() - t0:.1f}s "
+          f"ne={int(mesh.ntet)}", flush=True)
+    ecap = int(mesh.tcap * 1.6) + 64
+
+    rows = []
+
+    ms, mesh2 = timeit(jax.jit(lambda m: compact(m)), mesh, reps=reps)
+    rows.append(("compact", ms))
+    mesh = mesh2
+
+    ue = jax.jit(adjacency.unique_edges, static_argnames=("ecap",))
+    ms, (edges, emask, t2e, nu) = timeit(lambda m: ue(m, ecap), mesh,
+                                         reps=reps)
+    rows.append(("unique_edges", ms))
+
+    ms, mesh_adj = timeit(adjacency.build_adjacency, mesh, reps=reps)
+    rows.append(("build_adjacency", ms))
+    mesh = mesh_adj
+
+    ms, _ = timeit(analysis.tria_normals, mesh, reps=reps)
+    rows.append(("tria_normals", ms))
+
+    ms, _ = timeit(analysis.vertex_normals, mesh, reps=reps)
+    rows.append(("vertex_normals", ms))
+
+    @jax.jit
+    def run_split(m):
+        # outer non-donating jit: the ops' donate_argnums would otherwise
+        # invalidate the reused input buffer on TPU between reps
+        return split.split_long_edges(m, edges, emask, t2e)[0]
+
+    ms, _ = timeit(run_split, mesh, reps=reps)
+    rows.append(("split", ms))
+
+    @jax.jit
+    def run_col(m):
+        return collapse.collapse_short_edges(m, edges, emask, t2e)[0]
+
+    ms, _ = timeit(run_col, mesh, reps=reps)
+    rows.append(("collapse", ms))
+
+    @jax.jit
+    def run_s32(m):
+        return swap.swap_32(m, edges, emask, t2e)[0]
+
+    ms, _ = timeit(run_s32, mesh, reps=reps)
+    rows.append(("swap32", ms))
+
+    @jax.jit
+    def run_s23(m):
+        return swap.swap_23(m, edges, emask)[0]
+
+    ms, _ = timeit(run_s23, mesh, reps=reps)
+    rows.append(("swap23", ms))
+
+    @jax.jit
+    def run_sm(m):
+        return smooth.smooth_vertices(m, edges, emask)[0]
+
+    ms, _ = timeit(run_sm, mesh, reps=reps)
+    rows.append(("smooth", ms))
+
+    print(f"\nper-op steady state (ms, mean of {reps}), "
+          f"ne={int(mesh.ntet)} tcap={mesh.tcap}:")
+    for name, ms in rows:
+        print(f"  {name:16s} {ms:8.1f}")
+    print(f"  TOTAL            {sum(ms for _, ms in rows):8.1f}")
+
+
+if __name__ == "__main__":
+    main()
