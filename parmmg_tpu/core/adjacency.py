@@ -100,14 +100,20 @@ def unique_edges(mesh: Mesh, ecap: int):
     first = newgrp & live_sorted
     edges = jnp.zeros((ecap, 2), jnp.int32)
     emask = jnp.zeros(ecap, bool)
-    tgt = jnp.where(first, gid, ecap)  # OOB drop for non-first / dead
-    edges = edges.at[tgt, 0].set(slo.astype(jnp.int32), mode="drop")
-    edges = edges.at[tgt, 1].set(shi.astype(jnp.int32), mode="drop")
-    emask = emask.at[tgt].set(True, mode="drop")
+    # group representatives have unique gids; non-first/dead rows AND
+    # overflow representatives (gid >= ecap, the documented retry path)
+    # get distinct OOB sentinels so the unique-indices promise holds
+    tgt = _common.unique_oob(
+        first & (gid < ecap), gid.astype(jnp.int32), ecap
+    )
+    kw = dict(mode="drop", unique_indices=True)
+    edges = edges.at[tgt, 0].set(slo.astype(jnp.int32), **kw)
+    edges = edges.at[tgt, 1].set(shi.astype(jnp.int32), **kw)
+    emask = emask.at[tgt].set(True, **kw)
     # tet->edge map
     t2e_flat = jnp.full(tc * 6, -1, jnp.int32)
     val = jnp.where(live_sorted & (gid < ecap), gid, -1).astype(jnp.int32)
-    t2e_flat = t2e_flat.at[order].set(val)
+    t2e_flat = t2e_flat.at[order].set(val, unique_indices=True)
     n_unique = jnp.sum((newgrp & live_sorted).astype(jnp.int32))
     return edges, emask, t2e_flat.reshape(tc, 6), n_unique
 

@@ -381,19 +381,26 @@ def compact(mesh: Mesh) -> Mesh:
     vpos = jnp.cumsum(keep_v.astype(jnp.int32)) - 1  # new id per old slot
     vnew = jnp.where(keep_v, vpos, 0).astype(jnp.int32)
 
+    from ..ops import common as _common
+
+    vidx = _common.unique_oob(keep_v, vpos, pc)  # dead -> distinct OOB
+
     def scat_v(a, fill):
         out = jnp.full_like(a, fill)
-        idx = jnp.where(keep_v, vpos, pc)  # dead -> OOB drop
-        return out.at[idx].set(a, mode="drop")
+        return _common.scatter_rows(out, vidx, a, unique=True)
 
     def compact_ent(conn, mask, extras, fills):
         n = conn.shape[0]
         pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
-        idx = jnp.where(mask, pos, n)
-        new_conn = jnp.zeros_like(conn).at[idx].set(vnew[conn], mode="drop")
-        new_mask = jnp.zeros_like(mask).at[idx].set(mask, mode="drop")
+        idx = _common.unique_oob(mask, pos, n)
+        new_conn = _common.scatter_rows(
+            jnp.zeros_like(conn), idx, vnew[conn], unique=True
+        )
+        new_mask = jnp.zeros_like(mask).at[idx].set(
+            mask, mode="drop", unique_indices=True
+        )
         new_extras = tuple(
-            jnp.full_like(e, f).at[idx].set(e, mode="drop")
+            _common.scatter_rows(jnp.full_like(e, f), idx, e, unique=True)
             for e, f in zip(extras, fills)
         )
         return new_conn, new_mask, new_extras

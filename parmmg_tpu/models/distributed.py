@@ -42,7 +42,7 @@ from ..parallel.distribute import (
     split_mesh,
     unstack_mesh,
 )
-from ..parallel.partition import displace_partition, sfc_partition
+from ..parallel.partition import sfc_partition
 from .adapt import (
     AdaptOptions,
     adapt as adapt_single,
@@ -221,9 +221,13 @@ class DistOptions(AdaptOptions):
     # advancing-front displacement depth per iteration (reference
     # PMMG_MVIFCS_NLAYERS=2, src/parmmg.h:227)
     ifc_layers: int = 2
-    # max shard-size imbalance before a rebalancing SFC re-cut replaces
-    # the displaced partition (reference PMMG_GRPS_RATIO, src/parmmg.h:221)
-    grps_ratio: float = 2.0
+    # max shard-size imbalance (max/mean) before a rebalancing SFC
+    # re-cut replaces the displaced partition. The reference's
+    # PMMG_GRPS_RATIO=2.0 (src/parmmg.h:221) governs GROUP sizes, a much
+    # finer granularity it can re-split at will; shard = device here, so
+    # the guard gets more slack before it cancels a displacement whose
+    # front movement is the whole point of the iteration
+    grps_ratio: float = 2.5
     check_comm: bool = False      # chkcomm assert each iteration (debug)
     # minimum elements per shard before distribution pays off — the group
     # sizing role of PMMG_howManyGroups / PMMG_GRPSPL_DISTR_TARGET
@@ -375,58 +379,102 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
 
     # --- load balancing / interface displacement ----------------------
     # (reference PMMG_loadBalancing, src/loadbalancing_pmmg.c:44, in
-    # ifc-displacement mode src/moveinterfaces_pmmg.c:1306): the old
-    # per-tet colors advance `ifc_layers` layers across interfaces
-    # under a per-iteration priority permutation, so every band frozen
-    # this iteration is interior in the next. Host resharding via
-    # merge+split; skipped after the last iteration.
+    # ifc-displacement mode src/moveinterfaces_pmmg.c:1306): per-tet
+    # colors advance `ifc_layers` layers across interfaces under a
+    # fixed priority permutation, so every band frozen this iteration
+    # is interior in the next. DEVICE-FIRST path: front propagation +
+    # halo agreement + fixed-slot exchange (`parallel.migrate`, the
+    # PMMG_transfer_all_grps role) — the host only re-derives the
+    # interface discipline from connectivity. The former global
+    # merge+split survives solely as the GRPS_RATIO re-cut fallback.
     if not opts.nobalancing and it < opts.niter - 1 and nparts > 1:
+        from ..parallel import migrate as migrate_mod
+
         stacked = assign_global_ids(stacked)
         comm = rebuild_comm(stacked, icap)
-        shard_ne = [
-            int(m.ntet) for m in unstack_mesh(stacked)
-        ]
-        merged = adjacency.build_adjacency(merge_shards(stacked, comm))
-        # advancing-front displacement, bigger-group-wins with a
-        # fixed tie-break (round_id=0) so fronts move monotonically —
-        # each iteration's frozen band was interior, hence remeshed,
-        # in an earlier iteration. Provenance colors: merge
-        # concatenates live tets in shard order.
-        part = np.full(merged.tcap, -1, np.int64)
-        part[: sum(shard_ne)] = np.repeat(
-            np.arange(nparts), shard_ne
+        stacked = jax.vmap(adjacency.build_adjacency)(stacked)
+        color = migrate_mod.displace_colors(
+            stacked, comm, nparts, round_id=0, layers=opts.ifc_layers
         )
-        part = displace_partition(
-            part,
-            np.asarray(merged.adja),
-            np.asarray(merged.tmask),
-            nparts,
-            round_id=0,
-            layers=opts.ifc_layers,
+        cnts = np.asarray(jax.device_get(
+            migrate_mod.migration_counts(stacked, color, nparts)
+        ))
+        shard_ne = np.asarray(
+            jax.device_get(jnp.sum(stacked.tmask, axis=1))
         )
-        # GRPS_RATIO discipline (reference src/parmmg.h:218-227):
-        # when accumulated displacement skews shard sizes past the
-        # ratio, rebalance with a fresh SFC cut instead. Its
-        # interfaces fall near earlier cut planes, whose bands were
-        # remeshed while displaced — adapted, merely re-frozen.
-        # Ratio is max-vs-mean: uniform capacities and per-device
-        # wall-clock are governed by the LARGEST shard (a floored
-        # tiny shard is waste, not cost — min-based ratios fire on
-        # every small-mesh run and cancel the displacement).
-        tm = np.asarray(merged.tmask)
-        counts = np.bincount(part[tm], minlength=nparts)
-        if counts.max() > opts.grps_ratio * counts.mean():
-            part = np.asarray(
-                jax.device_get(sfc_partition(merged, nparts))
+        new_ne = shard_ne - cnts.sum(axis=1) + cnts.sum(axis=0)
+        # GRPS_RATIO discipline (reference src/parmmg.h:218-227): when
+        # accumulated displacement skews shard sizes past the ratio,
+        # rebalance with a fresh SFC cut (host fallback). Ratio is
+        # max-vs-mean: wall-clock is governed by the LARGEST shard.
+        if opts.verbose >= 2:
+            print(f"  [balance] moved={int(cnts.sum())} "
+                  f"new_ne={new_ne.tolist()}")
+        if new_ne.max() > opts.grps_ratio * max(new_ne.mean(), 1.0):
+            if opts.verbose >= 2:
+                print("  [balance] GRPS_RATIO fallback (full re-cut)")
+            stacked, comm = _rebalance_full(stacked, comm, nparts)
+            icap = None
+            stacked = _presize_for_target(stacked, opts)
+        elif cnts.max() > 0:
+            slot_cap = int(cnts.max()) + 8
+            # headroom for incoming entities before the exchange
+            pc = stacked.vert.shape[1]
+            tc = stacked.tet.shape[1]
+            fc = stacked.tria.shape[1]
+            ec = stacked.edge.shape[1]
+            shard_np = np.asarray(
+                jax.device_get(jnp.sum(stacked.vmask, axis=1))
             )
-        stacked, comm = split_mesh(
-            merged, part, nparts, assume_adjacency=True,
-            build_shard_adjacency=False,
-        )
-        icap = None  # interface sets changed; re-derive table shape
-        stacked = _presize_for_target(stacked, opts)
+            shard_nf = np.asarray(
+                jax.device_get(jnp.sum(stacked.trmask, axis=1))
+            )
+            inc = cnts.sum(axis=0)
+            need_t = int((shard_ne + inc).max())
+            need_p = int((shard_np + 4 * inc).max())
+            need_f = int((shard_nf + 2 * inc).max())
+            if (need_t > 0.9 * tc or need_p > 0.9 * pc
+                    or need_f > 0.9 * fc):
+                stacked = grow_stacked(
+                    stacked,
+                    pcap=max(pc, int(need_p * 1.3) + 8),
+                    tcap=max(tc, int(need_t * 1.3) + 8),
+                    fcap=max(fc, int(need_f * 1.3) + 8),
+                    ecap=max(ec, int(need_t * 0.5) + 64),
+                )
+                pad = stacked.tet.shape[1] - color.shape[1]
+                if pad:
+                    color = jnp.pad(
+                        color, ((0, 0), (0, pad)), constant_values=-1
+                    )
+            try:
+                stacked = migrate_mod.migrate(
+                    stacked, color, nparts, slot_cap
+                )
+            except RuntimeError:
+                # capacity estimate fell short: full re-cut fallback
+                stacked, comm = _rebalance_full(stacked, comm, nparts)
+                icap = None
+                stacked = _presize_for_target(stacked, opts)
+            else:
+                stacked = jax.vmap(compact)(stacked)
+                stacked, comm = migrate_mod.retag_interfaces(stacked)
+                icap = comm.icap
+                stacked = _presize_for_target(stacked, opts)
 
     return stacked, comm, icap
+
+
+def _rebalance_full(stacked: Mesh, comm: ShardComm, nparts: int):
+    """Full SFC re-cut via host merge+split — the rare GRPS_RATIO
+    fallback (the displaced partition skewed too far). Centralizes the
+    mesh once; the steady-state path is `parallel.migrate`."""
+    merged = adjacency.build_adjacency(merge_shards(stacked, comm))
+    part = np.asarray(jax.device_get(sfc_partition(merged, nparts)))
+    return split_mesh(
+        merged, part, nparts, assume_adjacency=True,
+        build_shard_adjacency=False,
+    )
 
 
 def adapt_stacked_input(

@@ -241,15 +241,12 @@ def discretize_levelset(
     out_trefs_a = np.asarray(out_trefs, np.int64)
     out_ttags_a = np.asarray(out_ttags, np.int64)
     if len(out_tris_a):
+        from ..utils.rows import row_member
+
         fkeys = np.sort(
             out_tets[:, np.asarray(FACE_VERTS)].reshape(-1, 3), axis=1
         )
-        tkeys = np.sort(out_tris_a, axis=1)
-        allrows = np.concatenate([fkeys, tkeys])
-        _, inv = np.unique(allrows, axis=0, return_inverse=True)
-        is_face = np.zeros(inv.max() + 1, bool)
-        is_face[inv[: len(fkeys)]] = True
-        keep = is_face[inv[len(fkeys):]]
+        keep = row_member(np.sort(out_tris_a, axis=1), fkeys)
         out_tris_a = out_tris_a[keep]
         out_trefs_a = out_trefs_a[keep]
         out_ttags_a = out_ttags_a[keep]

@@ -194,11 +194,13 @@ def vertex_normals(mesh: Mesh) -> jax.Array:
     unit, area, ok = tria_normals(mesh)
     pcap = mesh.pcap
     w = jnp.where(ok, area, 0.0)
+    from . import common
+
     contrib = unit * w[:, None]
     acc = jnp.zeros((pcap, 3), mesh.vert.dtype)
     idx = jnp.where(ok[:, None], mesh.tria, pcap)
     for k in range(3):
-        acc = acc.at[idx[:, k]].add(contrib, mode="drop")
+        acc = common.scatter_rows(acc, idx[:, k], contrib, op="add")
     n = jnp.linalg.norm(acc, axis=1)
     return acc / jnp.maximum(n, 1e-30)[:, None]
 
@@ -296,11 +298,13 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
         gtag = gtag | jnp.where(hasbit, bit, 0)
     etag_g = gtag[gid]
 
-    first = jnp.zeros(n3, bool).at[order].set(newgrp & live_sorted)
-    etag = jnp.zeros(n3, jnp.int32).at[order].set(etag_g)
+    first = jnp.zeros(n3, bool).at[order].set(newgrp & live_sorted,
+                                              unique_indices=True)
+    etag = jnp.zeros(n3, jnp.int32).at[order].set(etag_g, unique_indices=True)
     prs = jnp.stack(
-        [jnp.zeros(n3, jnp.int32).at[order].set(slo),
-         jnp.zeros(n3, jnp.int32).at[order].set(shi)], axis=1
+        [jnp.zeros(n3, jnp.int32).at[order].set(slo, unique_indices=True),
+         jnp.zeros(n3, jnp.int32).at[order].set(shi, unique_indices=True)],
+        axis=1,
     )
     return first, prs, etag
 

@@ -85,8 +85,8 @@ def smooth_vertices(
     def centroid_over(sel):
         w = (emask & sel).astype(dtype)
         acc = jnp.zeros((pcap, 3), dtype)
-        acc = acc.at[a].add(vert0[b] * w[:, None], mode="drop")
-        acc = acc.at[b].add(vert0[a] * w[:, None], mode="drop")
+        acc = common.scatter_rows(acc, a, vert0[b] * w[:, None], op="add")
+        acc = common.scatter_rows(acc, b, vert0[a] * w[:, None], op="add")
         cnt = jnp.zeros(pcap, dtype)
         cnt = cnt.at[a].add(w, mode="drop")
         cnt = cnt.at[b].add(w, mode="drop")

@@ -202,17 +202,25 @@ def split_long_edges(
     new_tag = jnp.where(surf, tags.BDY, 0) | (feat_tag & _INHERIT)
     new_ref = jnp.where(feat >= 0, mesh.edref[jnp.maximum(feat, 0)], 0)
 
-    tgt_v = jnp.where(win, vnew, mesh.pcap).astype(jnp.int32)  # OOB drop
-    vert = mesh.vert.at[tgt_v].set(mid, mode="drop")
-    met = mesh.met.at[tgt_v].set(mmid, mode="drop")
-    ls = mesh.ls.at[tgt_v].set(0.5 * (mesh.ls[a] + mesh.ls[b]), mode="drop")
-    disp = mesh.disp.at[tgt_v].set(0.5 * (mesh.disp[a] + mesh.disp[b]), mode="drop")
-    fields = mesh.fields.at[tgt_v].set(
-        0.5 * (mesh.fields[a] + mesh.fields[b]), mode="drop"
+    # winner targets are distinct appended slots; distinct OOB sentinels
+    # keep the unique-indices promise (faster scatter lowering on TPU)
+    tgt_v = common.unique_oob(win, vnew, mesh.pcap)
+    kw = dict(mode="drop", unique_indices=True)
+    vert = common.scatter_rows(mesh.vert, tgt_v, mid, unique=True)
+    met = common.scatter_rows(mesh.met, tgt_v, mmid, unique=True)
+    ls = common.scatter_rows(
+        mesh.ls, tgt_v, 0.5 * (mesh.ls[a] + mesh.ls[b]), unique=True
     )
-    vtag = mesh.vtag.at[tgt_v].set(new_tag, mode="drop")
-    vref = mesh.vref.at[tgt_v].set(new_ref, mode="drop")
-    vmask = mesh.vmask.at[tgt_v].set(True, mode="drop")
+    disp = common.scatter_rows(
+        mesh.disp, tgt_v, 0.5 * (mesh.disp[a] + mesh.disp[b]), unique=True
+    )
+    fields = common.scatter_rows(
+        mesh.fields, tgt_v, 0.5 * (mesh.fields[a] + mesh.fields[b]),
+        unique=True,
+    )
+    vtag = mesh.vtag.at[tgt_v].set(new_tag, **kw)
+    vref = mesh.vref.at[tgt_v].set(new_ref, **kw)
+    vmask = mesh.vmask.at[tgt_v].set(True, **kw)
 
     # --- split tets --------------------------------------------------------
     nv_of_t = vnew[e_of_t]
@@ -223,10 +231,10 @@ def split_long_edges(
     # child B appended: vertex li -> newv (of the ORIGINAL tet)
     tetB = mesh.tet.at[rows, li].set(nv_of_t)
     app_rank = jnp.cumsum(has.astype(jnp.int32)) - 1
-    tgt_t = jnp.where(has, ne0 + app_rank, tcap).astype(jnp.int32)
-    tet = tetA.at[tgt_t].set(tetB, mode="drop")
-    tref = mesh.tref.at[tgt_t].set(mesh.tref, mode="drop")
-    tmask = mesh.tmask.at[tgt_t].set(has, mode="drop")
+    tgt_t = common.unique_oob(has, ne0 + app_rank, tcap)
+    tet = common.scatter_rows(tetA, tgt_t, tetB, unique=True)
+    tref = mesh.tref.at[tgt_t].set(mesh.tref, **kw)
+    tmask = mesh.tmask.at[tgt_t].set(has, **kw)
 
     # --- split trias (reuses eid3 from candidate selection) ---------------
     w3 = (eid3 >= 0) & win[jnp.maximum(eid3, 0)] & mesh.trmask[:, None]
@@ -243,11 +251,11 @@ def split_long_edges(
     )
     triB = mesh.tria.at[frows, fu].set(fnv)
     frank = jnp.cumsum(fhas.astype(jnp.int32)) - 1
-    tgt_f = jnp.where(fhas, nf0 + frank, fcap).astype(jnp.int32)
-    tria = triA.at[tgt_f].set(triB, mode="drop")
-    trref = mesh.trref.at[tgt_f].set(mesh.trref, mode="drop")
-    trtag = mesh.trtag.at[tgt_f].set(mesh.trtag, mode="drop")
-    trmask = mesh.trmask.at[tgt_f].set(fhas, mode="drop")
+    tgt_f = common.unique_oob(fhas, nf0 + frank, fcap)
+    tria = common.scatter_rows(triA, tgt_f, triB, unique=True)
+    trref = mesh.trref.at[tgt_f].set(mesh.trref, **kw)
+    trtag = mesh.trtag.at[tgt_f].set(mesh.trtag, **kw)
+    trmask = mesh.trmask.at[tgt_f].set(fhas, **kw)
 
     # --- split feature edges ----------------------------------------------
     ehas = win & (feat >= 0)
@@ -257,14 +265,14 @@ def split_long_edges(
     r1 = mesh.edge[jnp.maximum(feat, 0), 1]
     edge_arr = mesh.edge.at[fidx, 1].set(vnew, mode="drop")
     erank = jnp.cumsum(ehas.astype(jnp.int32)) - 1
-    tgt_e = jnp.where(ehas, ned0 + erank, mesh.ecap).astype(jnp.int32)
+    tgt_e = common.unique_oob(ehas, ned0 + erank, mesh.ecap)
     newrow = jnp.stack([vnew, r1], axis=1)
-    edge_arr = edge_arr.at[tgt_e].set(newrow, mode="drop")
+    edge_arr = common.scatter_rows(edge_arr, tgt_e, newrow, unique=True)
     edref = mesh.edref.at[tgt_e].set(
-        jnp.where(feat >= 0, mesh.edref[jnp.maximum(feat, 0)], 0), mode="drop"
+        jnp.where(feat >= 0, mesh.edref[jnp.maximum(feat, 0)], 0), **kw
     )
-    edtag = mesh.edtag.at[tgt_e].set(feat_tag, mode="drop")
-    edmask = mesh.edmask.at[tgt_e].set(ehas, mode="drop")
+    edtag = mesh.edtag.at[tgt_e].set(feat_tag, **kw)
+    edmask = mesh.edmask.at[tgt_e].set(ehas, **kw)
 
     out = mesh.replace(
         vert=vert, met=met, ls=ls, disp=disp, fields=fields,

@@ -64,11 +64,10 @@ def swap_32(
 
     # Ring vertices: for edge slot k of a tet, the two OFF-edge local
     # corners are known statically (complement of EDGE_VERTS[k]) — no
-    # comparisons, and the per-edge reductions pack into ONE scatter-add
-    # ([N,2] int: vertex sum + shell count) and ONE scatter-min ([N,3]
-    # float: min off-vertex, negated max off-vertex, shell quality).
-    # Random-index scatters are row-DMA bound on TPU, so three wide
-    # passes replace the fifteen narrow ones of the per-corner loop.
+    # comparisons, and each per-edge reduction is one single-column
+    # scatter (six passes replace the fifteen of the per-corner loop;
+    # single-column because TPU lowers multi-column scatter-combines
+    # ~8x slower than the same data split per column).
     OFF = jnp.asarray(
         [[2, 3], [1, 3], [1, 2], [0, 3], [0, 2], [0, 1]], jnp.int32
     )
@@ -77,51 +76,21 @@ def swap_32(
     q_old = common.quality_of(mesh.vert, mesh.met, tet)
     vol_all = common.vol_of(mesh.vert, tet)
 
-    int_pack = jnp.stack(
-        [off1 + off2, jnp.ones((tcap, 6), jnp.int32)], axis=-1
-    ).reshape(-1, 2)
-    int_acc = jnp.zeros((ecap, 2), jnp.int32).at[flat_e].add(
-        int_pack, mode="drop"
+    ring_sum = jnp.zeros(ecap, jnp.int32).at[flat_e].add(
+        (off1 + off2).reshape(-1), mode="drop"
     )
-    ring_sum, inc = int_acc[:, 0], int_acc[:, 1]
-
-    fdt = mesh.vert.dtype
-    if mesh.pcap <= (1 << (jnp.finfo(fdt).nmant + 1)):
-        # vertex ids are exact in fdt: pack both ring-id reductions with
-        # the shell quality into one wide scatter-min
-        min_pack = jnp.stack(
-            [
-                jnp.minimum(off1, off2).astype(fdt),
-                -jnp.maximum(off1, off2).astype(fdt),
-                jnp.broadcast_to(q_old[:, None], (tcap, 6)),
-            ],
-            axis=-1,
-        ).reshape(-1, 3)
-        min_acc = jnp.full((ecap, 3), jnp.inf, fdt).at[flat_e].min(
-            min_pack, mode="drop"
-        )
-        u = jnp.where(
-            jnp.isfinite(min_acc[:, 0]), min_acc[:, 0], 2**30
-        ).astype(jnp.int32)
-        w = jnp.where(
-            jnp.isfinite(min_acc[:, 1]), -min_acc[:, 1], -1
-        ).astype(jnp.int32)
-        shell_min_q = min_acc[:, 2]
-    else:
-        # ids would round in fdt (pcap beyond the mantissa): exact int32
-        # reductions, separate float min for the quality
-        imin_pack = jnp.stack(
-            [jnp.minimum(off1, off2), -jnp.maximum(off1, off2)], axis=-1
-        ).reshape(-1, 2)
-        iacc = jnp.full((ecap, 2), 2**30, jnp.int32).at[flat_e].min(
-            imin_pack, mode="drop"
-        )
-        u = iacc[:, 0]
-        w = jnp.where(iacc[:, 1] == 2**30, -1, -iacc[:, 1])
-        shell_min_q = jnp.full(ecap, jnp.inf, fdt).at[flat_e].min(
-            jnp.broadcast_to(q_old[:, None], (tcap, 6)).reshape(-1),
-            mode="drop",
-        )
+    inc = jnp.zeros(ecap, jnp.int32).at[flat_e].add(
+        jnp.ones(tcap * 6, jnp.int32), mode="drop"
+    )
+    u = jnp.full(ecap, 2**30, jnp.int32).at[flat_e].min(
+        jnp.minimum(off1, off2).reshape(-1), mode="drop"
+    )
+    w = jnp.full(ecap, -1, jnp.int32).at[flat_e].max(
+        jnp.maximum(off1, off2).reshape(-1), mode="drop"
+    )
+    shell_min_q = jnp.full(ecap, jnp.inf, mesh.vert.dtype).at[flat_e].min(
+        jnp.broadcast_to(q_old[:, None], (tcap, 6)).reshape(-1), mode="drop"
+    )
     v = ring_sum // 2 - u - w
 
     ok_ring = (u >= 0) & (v >= 0) & (w >= 0) & (u != v) & (v != w) & (u != w)
@@ -314,13 +283,13 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
 
     # tentative apply: children 0/1 overwrite t and t2, child 2 appended
     tet_out = tet
-    tgt_a = jnp.where(win, t_id, tcap)
-    tet_out = tet_out.at[tgt_a].set(cands[0], mode="drop")
-    tgt_b = jnp.where(win, t2c, tcap)
-    tet_out = tet_out.at[tgt_b].set(cands[1], mode="drop")
-    tgt_c = jnp.where(win, ne0 + rank, tcap).astype(jnp.int32)
-    tet_out = tet_out.at[tgt_c].set(cands[2], mode="drop")
-    tmask_out = tmask.at[tgt_c].set(win, mode="drop")
+    tgt_a = common.unique_oob(win, t_id, tcap)
+    tet_out = common.scatter_rows(tet_out, tgt_a, cands[0], unique=True)
+    tgt_b = common.unique_oob(win, t2c, tcap)
+    tet_out = common.scatter_rows(tet_out, tgt_b, cands[1], unique=True)
+    tgt_c = common.unique_oob(win, ne0 + rank, tcap)
+    tet_out = common.scatter_rows(tet_out, tgt_c, cands[2], unique=True)
+    tmask_out = tmask.at[tgt_c].set(win, mode="drop", unique_indices=True)
 
     # duplicate post-check: reject interacting winners and revert
     dup = common.duplicate_tets(tet_out, tmask_out, bound=mesh.pcap)
@@ -330,15 +299,16 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
         | dup[jnp.clip(ne0 + rank, 0, tcap - 1)]
     ) & win
     win2 = win & ~bad
-    tgt_a = jnp.where(win2, t_id, tcap)
-    tgt_b = jnp.where(win2, t2c, tcap)
-    tgt_c = jnp.where(win2, ne0 + rank, tcap).astype(jnp.int32)
+    tgt_a = common.unique_oob(win2, t_id, tcap)
+    tgt_b = common.unique_oob(win2, t2c, tcap)
+    tgt_c = common.unique_oob(win2, ne0 + rank, tcap)
     tet_out = tet
-    tet_out = tet_out.at[tgt_a].set(cands[0], mode="drop")
-    tet_out = tet_out.at[tgt_b].set(cands[1], mode="drop")
-    tet_out = tet_out.at[tgt_c].set(cands[2], mode="drop")
-    tref_out = mesh.tref.at[tgt_c].set(mesh.tref[t_id], mode="drop")
-    tmask_out = tmask.at[tgt_c].set(win2, mode="drop")
+    tet_out = common.scatter_rows(tet_out, tgt_a, cands[0], unique=True)
+    tet_out = common.scatter_rows(tet_out, tgt_b, cands[1], unique=True)
+    tet_out = common.scatter_rows(tet_out, tgt_c, cands[2], unique=True)
+    tref_out = mesh.tref.at[tgt_c].set(mesh.tref[t_id], mode="drop",
+                                       unique_indices=True)
+    tmask_out = tmask.at[tgt_c].set(win2, mode="drop", unique_indices=True)
 
     out = mesh.replace(tet=tet_out, tref=tref_out, tmask=tmask_out)
     return out, SwapStats(nswap32=jnp.int32(0),

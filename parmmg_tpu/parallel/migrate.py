@@ -1,0 +1,696 @@
+"""Device-side interface displacement and shard-to-shard tet migration.
+
+Re-design of the reference's between-iteration load balancing
+(`PMMG_loadBalancing`, `src/loadbalancing_pmmg.c:44`) without the host
+merge+re-split of the global mesh:
+
+ - `displace_colors` — the advancing-front interface displacement
+   (`PMMG_part_moveInterfaces`, `src/moveinterfaces_pmmg.c:1306`) as
+   per-shard front propagation: local face-adjacency advance plus
+   cross-shard agreement through the node-communicator tables (the
+   reference's `PMMG_mark_interfacePoints`/`PMMG_mark_boulevolp` rounds
+   exchange interface-point colors the same way). Pure device code over
+   the stacked [D, ...] arrays; under `shard_map` the halo step is one
+   `all_to_all` over ICI.
+ - `migrate` — the group-transfer role (`PMMG_transfer_all_grps`,
+   `src/distributegrps_pmmg.c:1843`; pack at `src/mpipack_pmmg.c:1116`):
+   outgoing tets (with their vertex payloads, real-surface trias and
+   feature edges, all addressed by GLOBAL vertex ids) are packed into
+   fixed-capacity per-destination slots, exchanged with one transpose —
+   `jax.lax.all_to_all` under `shard_map`, an axis swap on stacked
+   arrays — and integrated on the receiving shard by sort-merge gid
+   matching. No byte packing, no MPI datatypes, no tags.
+ - `retag_interfaces` — re-derives the interface discipline afterwards:
+   PARBDY vertex tags from global gid multiplicity, synthetic NOSURF
+   interface trias from cross-shard open-face matching (the
+   `PMMG_updateTag`/`PMMG_parbdySet` roles, `src/tag_pmmg.c:267,460`).
+   Host-side but CONNECTIVITY-ONLY and O(interface + shared): no
+   geometry, metrics or fields ever leave the device — this replaces
+   the former merge of the whole mesh onto the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import adjacency, tags
+from ..core.mesh import FACE_VERTS, Mesh
+from ..ops import common
+from .distribute import ShardComm, rebuild_comm
+
+
+# ---------------------------------------------------------------------------
+# stacked halo combine (vmap-mode equivalent of parallel.comm.halo_max)
+# ---------------------------------------------------------------------------
+
+def stacked_halo_max(vals: jax.Array, comm: ShardComm) -> jax.Array:
+    """[D,P] values -> [D,P] with each interface vertex holding the MAX
+    over its copies on all shards. On stacked arrays the exchange is a
+    pure gather; under shard_map the same access pattern is
+    `parallel.comm.halo_max` (one all_to_all)."""
+    ci = comm.comm_idx                      # [D(s), D(r), I]
+    safe = jnp.maximum(ci, 0)
+    d = ci.shape[0]
+    # recv[s, r, k] = vals[r, ci[r, s, k]]
+    src_rows = jnp.broadcast_to(
+        jnp.arange(d)[None, :, None], safe.shape
+    )
+    recv = vals[src_rows, jnp.swapaxes(safe, 0, 1)]
+    neutral = (
+        jnp.iinfo(vals.dtype).min
+        if jnp.issubdtype(vals.dtype, jnp.integer) else -jnp.inf
+    )
+    recv = jnp.where(jnp.swapaxes(ci, 0, 1) >= 0, recv, neutral)
+
+    def per_shard(v, ci_s, r_s):
+        tgt = jnp.where(ci_s >= 0, ci_s, v.shape[0]).reshape(-1)
+        return v.at[tgt].max(r_s.reshape(-1), mode="drop")
+
+    return jax.vmap(per_shard)(vals, ci, recv)
+
+
+# ---------------------------------------------------------------------------
+# interface displacement (device)
+# ---------------------------------------------------------------------------
+
+def _color_prio(nparts: int, round_id: int) -> jax.Array:
+    """Fixed deterministic priority permutation of the colors.
+
+    The driver keeps it CONSTANT across iterations (round_id=0) so
+    fronts move monotonically: the reference's bigger-group-wins rule
+    (`PMMG_get_ifcDirection`, `src/moveinterfaces_pmmg.c:74-98`)
+    oscillates at shard granularity because counts stay noise-level
+    equal, re-freezing the same band; the reference tolerates that by
+    re-splitting groups with Metis, machinery replaced here by the
+    driver's GRPS_RATIO re-cut guard."""
+    pr = (
+        (np.arange(nparts, dtype=np.int64) * 40503 + round_id * 25173)
+        * 2654435761
+    ) % (1 << 16)
+    return jnp.asarray(pr, jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("nparts", "round_id", "layers",
+                                   "min_elts"))
+def displace_colors(
+    stacked: Mesh,
+    comm: ShardComm,
+    nparts: int,
+    round_id: int = 0,
+    layers: int = 2,
+    min_elts: int = 8,
+) -> jax.Array:
+    """[D,T] int32 destination color per tet (own shard id where kept).
+
+    Per layer: every tet face-adjacent — locally via `adja`, across
+    shards via an open face whose corners agree through the node-table
+    halo — to a higher-priority color adopts it, with the `min_elts`
+    starvation floor enforced on GLOBAL color counts (psum'd across
+    shards).
+    """
+    d = stacked.vert.shape[0]
+    tcap = stacked.tet.shape[1]
+    pcap = stacked.vert.shape[1]
+    prio = _color_prio(nparts, round_id)
+    tmask = stacked.tmask
+    color0 = jnp.where(
+        tmask, jnp.arange(d, dtype=jnp.int32)[:, None], -1
+    )
+    floor_c = jnp.int32(min_elts)
+
+    nb = stacked.adja >> 2
+    valid_nb = (stacked.adja >= 0) & tmask[:, :, None]
+    par_v = (stacked.vtag & tags.PARBDY) != 0
+
+    def body(_, color):
+        # encode (prio, color) so one max carries both
+        enc_t = jnp.where(
+            color >= 0, prio[jnp.maximum(color, 0)] * 256 + color, -1
+        )
+        # local face-adjacency best
+        nb_enc = jnp.where(
+            valid_nb,
+            jax.vmap(lambda e, n: e[n])(enc_t, jnp.maximum(nb, 0)),
+            -1,
+        )
+        best_local = jnp.max(nb_enc, axis=2)            # [D,T]
+        # cross-shard: interface vertices carry the max enc of their
+        # incident tets, agreed through the halo
+        venc = jnp.full((d, pcap), -1, jnp.int32)
+
+        def scatter_venc(ve, tet_s, enc_s, tm_s):
+            idx = jnp.where(tm_s[:, None], tet_s, pcap)
+            return ve.at[idx.reshape(-1)].max(
+                jnp.repeat(enc_s, 4), mode="drop"
+            )
+
+        venc = jax.vmap(scatter_venc)(venc, stacked.tet, enc_t, tmask)
+        venc = jnp.where(par_v, venc, -1)
+        venc = stacked_halo_max(venc, comm)
+        venc = jnp.where(par_v, venc, -1)
+        # cross-shard advance is FACE-based like the reference front: a
+        # tet adopts a neighbor-shard color only through one of its OPEN
+        # faces whose three corners agree on the same higher color (the
+        # vertex-ball hop would also flip diagonal tets and advance ~2x
+        # the per-layer front)
+        best_ifc = jnp.full(enc_t.shape, -1, jnp.int32)
+        fv4 = jnp.asarray(FACE_VERTS)                      # [4,3]
+        for f in range(4):
+            fverts = stacked.tet[:, :, fv4[f]]             # [D,T,3]
+            ve = jax.vmap(lambda vv, t: vv[t])(venc, fverts)
+            open_f = (stacked.adja[:, :, f] < 0) & tmask
+            all_pos = jnp.all(ve >= 0, axis=2)
+            col = jnp.where(ve >= 0, ve % 256, -1)
+            same_col = (
+                (col[..., 0] == col[..., 1])
+                & (col[..., 1] == col[..., 2])
+            )
+            fenc = jnp.min(ve, axis=2)
+            ok = open_f & all_pos & same_col
+            best_ifc = jnp.maximum(
+                best_ifc, jnp.where(ok, fenc, -1)
+            )
+        best = jnp.maximum(best_local, best_ifc)
+        own_enc = jnp.where(
+            color >= 0, prio[jnp.maximum(color, 0)] * 256 + color, -1
+        )
+        bestcol = best % 256
+        flip = tmask & (best > own_enc) & (best >= 0)
+        # starvation floor on GLOBAL counts (the reference's nemin,
+        # src/moveinterfaces_pmmg.c:1343)
+        safe_c = jnp.where(tmask, jnp.maximum(color, 0), 0)
+        counts = jnp.zeros((d, nparts), jnp.int32)
+        counts = jax.vmap(
+            lambda c, sc, tm: c.at[sc].add(
+                tm.astype(jnp.int32), mode="drop")
+        )(counts, safe_c, tmask)
+        g_counts = jnp.sum(counts, axis=0)              # psum role
+        losses = jnp.zeros((d, nparts), jnp.int32)
+        losses = jax.vmap(
+            lambda c, sc, fl: c.at[sc].add(fl.astype(jnp.int32),
+                                           mode="drop")
+        )(losses, safe_c, flip)
+        g_losses = jnp.sum(losses, axis=0)
+        starved = (g_counts - g_losses) < floor_c
+        flip = flip & ~starved[safe_c]
+        return jnp.where(flip, bestcol, color)
+
+    return jax.lax.fori_loop(0, layers, body, color0)
+
+
+# ---------------------------------------------------------------------------
+# migration (pack -> exchange -> integrate), device
+# ---------------------------------------------------------------------------
+
+def migration_counts(stacked: Mesh, color: jax.Array, nparts: int):
+    """[D,D] int32 outgoing tet counts (host uses the max to pick the
+    static slot capacity)."""
+    d = stacked.vert.shape[0]
+    sid = jnp.arange(d, dtype=jnp.int32)[:, None]
+    out = stacked.tmask & (color >= 0) & (color != sid)
+    safe = jnp.where(out, color, 0)
+    cnt = jnp.zeros((d, nparts), jnp.int32)
+    return jax.vmap(
+        lambda c, sc, o: c.at[sc].add(o.astype(jnp.int32), mode="drop")
+    )(cnt, safe, out)
+
+
+@partial(jax.jit, static_argnames=("slot_cap", "tria_cap", "edge_cap"))
+def _pack(stacked: Mesh, color: jax.Array, slot_cap: int,
+          tria_cap: int, edge_cap: int):
+    """Build per-destination slot buffers. Returns dict of [D,D,cap,W]
+    arrays (int payloads) + float payloads [D,D,cap,4,Wf]."""
+    d = stacked.vert.shape[0]
+    tcap = stacked.tet.shape[1]
+    fcap = stacked.tria.shape[1]
+    ecap = stacked.edge.shape[1]
+    sid = jnp.arange(d, dtype=jnp.int32)[:, None]
+    out_t = stacked.tmask & (color >= 0) & (color != sid)   # [D,T]
+
+    def pack_shard(m: Mesh, out_s, color_s):
+        # --- tets ---------------------------------------------------------
+        gids4 = m.vglob[m.tet]                              # [T,4]
+        ti = jnp.concatenate(
+            [
+                gids4,
+                m.tref[:, None],
+                m.vtag[m.tet],
+                m.vref[m.tet],
+            ],
+            axis=1,
+        ).astype(jnp.int32)                  # [T,13]
+        fpay = jnp.concatenate(
+            [m.vert, m.met, m.ls, m.disp, m.fields], axis=1
+        )                                                    # [P,Wf]
+        tf = fpay[m.tet]                                     # [T,4,Wf]
+        buf_ti = jnp.full((d, slot_cap, 13), -1, jnp.int32)
+        buf_tf = jnp.zeros((d, slot_cap, 4, tf.shape[-1]), m.vert.dtype)
+        n_t = jnp.zeros(d, jnp.int32)
+        # rank within destination: cumsum over tets of (out & color==dest)
+        # one pass per destination (D is small and static)
+        for dst in range(d):
+            sel = out_s & (color_s == dst)
+            n_t = n_t.at[dst].set(jnp.sum(sel.astype(jnp.int32)))
+            rank = jnp.cumsum(sel.astype(jnp.int32)) - 1
+            tgt = common.unique_oob(sel, rank, slot_cap)
+            buf_ti = buf_ti.at[dst].set(
+                common.scatter_rows(buf_ti[dst], tgt, ti, unique=True)
+            )
+            buf_tf = buf_tf.at[dst].set(
+                buf_tf[dst].at[tgt].set(tf, mode="drop",
+                                        unique_indices=True)
+            )
+        # --- real trias owned by moving tets ------------------------------
+        # owner tets by face match; pure synthetic interface trias are
+        # dropped globally and re-derived by retag_interfaces
+        fverts = m.tet[:, jnp.asarray(FACE_VERTS)].reshape(-1, 3)
+        fkeys = jnp.sort(fverts, axis=1)
+        fkeys = jnp.where(
+            jnp.repeat(m.tmask, 4)[:, None], fkeys, -1
+        )
+        syn = tags.pure_interface_tria(m.trtag)
+        real_tr = m.trmask & ~syn
+        trkeys = jnp.sort(jnp.where(real_tr[:, None], m.tria, -1), axis=1)
+        fid1, fid2, cnt = common.match_rows2(fkeys, trkeys,
+                                             bound=m.pcap)
+        own1 = jnp.maximum(fid1, 0) // 4
+        own2 = jnp.maximum(fid2, 0) // 4
+        tria_int = jnp.concatenate(
+            [
+                m.vglob[m.tria],
+                m.trref[:, None],
+                (m.trtag & ~(tags.PARBDY | tags.PARBDYBDY | tags.NOSURF))[
+                    :, None
+                ],
+            ],
+            axis=1,
+        ).astype(jnp.int32)                  # [F,5]
+        buf_fi = jnp.full((d, tria_cap, 5), -1, jnp.int32)
+        n_f = jnp.zeros(d, jnp.int32)
+        for dst in range(d):
+            d1 = (cnt >= 1) & out_s[own1] & (color_s[own1] == dst)
+            d2 = (cnt >= 2) & out_s[own2] & (color_s[own2] == dst)
+            sel = real_tr & (d1 | d2)
+            n_f = n_f.at[dst].set(jnp.sum(sel.astype(jnp.int32)))
+            rank = jnp.cumsum(sel.astype(jnp.int32)) - 1
+            tgt = common.unique_oob(sel, rank, tria_cap)
+            buf_fi = buf_fi.at[dst].set(
+                common.scatter_rows(buf_fi[dst], tgt, tria_int,
+                                    unique=True)
+            )
+        # tria stays locally iff some owner stays. Pure synthetic
+        # interface trias are dropped HERE, not in retag: keeping them
+        # through compact() would keep their vertices alive in the
+        # departed shard, and every such stale replica reads as a shared
+        # gid — freezing the genuine copy on the receiving side too.
+        # retag_interfaces recreates exactly the ones still needed.
+        keep1 = (cnt >= 1) & ~out_s[own1]
+        keep2 = (cnt >= 2) & ~out_s[own2]
+        tria_keep = m.trmask & ~syn & (
+            keep1 | keep2 | (cnt == 0)
+        )
+        # --- feature edges ------------------------------------------------
+        ed_int = jnp.concatenate(
+            [m.vglob[m.edge], m.edref[:, None], m.edtag[:, None]], axis=1
+        ).astype(jnp.int32)                  # [E,4]
+        buf_ei = jnp.full((d, edge_cap, 4), -1, jnp.int32)
+        n_e = jnp.zeros(d, jnp.int32)
+        pcap = m.pcap
+        for dst in range(d):
+            vd = jnp.zeros(pcap, bool)
+            selt = out_s & (color_s == dst)
+            idx = jnp.where(selt[:, None], m.tet, pcap)
+            vd = vd.at[idx.reshape(-1)].set(True, mode="drop")
+            sel = m.edmask & vd[m.edge[:, 0]] & vd[m.edge[:, 1]]
+            n_e = n_e.at[dst].set(jnp.sum(sel.astype(jnp.int32)))
+            rank = jnp.cumsum(sel.astype(jnp.int32)) - 1
+            tgt = common.unique_oob(sel, rank, edge_cap)
+            buf_ei = buf_ei.at[dst].set(
+                common.scatter_rows(buf_ei[dst], tgt, ed_int,
+                                    unique=True)
+            )
+        # edges stay only where both endpoints still belong to a STAYING
+        # tet — otherwise the departed region's feature web would remain
+        # as frozen orphans (its REQUIRED/ridge endpoints survive
+        # compact(), then read as spuriously shared gids)
+        stay_v = jnp.zeros(pcap, bool)
+        sidx = jnp.where((m.tmask & ~out_s)[:, None], m.tet, pcap)
+        stay_v = stay_v.at[sidx.reshape(-1)].set(True, mode="drop")
+        edge_keep = (
+            m.edmask & stay_v[m.edge[:, 0]] & stay_v[m.edge[:, 1]]
+        )
+        return (buf_ti, buf_tf, buf_fi, buf_ei, tria_keep, edge_keep,
+                jnp.stack([n_t, n_f, n_e]))
+
+    return jax.vmap(pack_shard)(stacked, out_t, color), out_t
+
+
+def _exchange(buf: jax.Array) -> jax.Array:
+    """Stacked-mode exchange: [D_src, D_dst, ...] -> [D_dst, D_src, ...].
+    Under shard_map the identical data motion is
+    `jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0)`."""
+    return jnp.swapaxes(buf, 0, 1)
+
+
+@jax.jit
+def _integrate(stacked: Mesh, out_t, rti, rtf, rfi, rei, tria_keep,
+               edge_keep):
+    # NB: deliberately NOT donating `stacked` — on a capacity-estimate
+    # miss the caller falls back to the host re-cut with the same arrays
+    """Receive-side merge: dedup vertices by gid, append new entities,
+    drop outgoing ones. All sort-merge device code, vmapped over shards."""
+
+    def per_shard(m: Mesh, out_s, ti, tf, fi, ei, tr_keep, ed_keep):
+        pcap, tcap, fcap, ecap = m.pcap, m.tcap, m.fcap, m.ecap
+        ti = ti.reshape(-1, ti.shape[-1])                   # [K,13]
+        tf = tf.reshape(-1, 4, tf.shape[-1])                # [K,4,Wf]
+        fi = fi.reshape(-1, fi.shape[-1])                   # [Kf,5]
+        ei = ei.reshape(-1, ei.shape[-1])                   # [Ke,4]
+        k = ti.shape[0]
+        t_valid = ti[:, 0] >= 0
+
+        # ---- vertices: dedup corners by gid, match against local -------
+        cg = jnp.where(t_valid[:, None], ti[:, :4], -1).reshape(-1)  # [4K]
+        ckey = jnp.where(cg >= 0, cg, jnp.int32(2**30))
+        order = jnp.argsort(ckey).astype(jnp.int32)
+        sg = ckey[order]
+        newg = jnp.concatenate([jnp.ones(1, bool), sg[1:] != sg[:-1]])
+        live_s = sg < jnp.int32(2**30)
+        uid = jnp.cumsum(newg.astype(jnp.int32)) - 1        # group id
+        rep_sorted = newg & live_s
+        # match unique incoming gids against local live gids
+        lkeys = jnp.where(m.vmask, m.vglob, -1)[:, None]
+        q = jnp.where(rep_sorted, sg, -1)[:, None]
+        loc = common.match_rows(lkeys, q)                   # [4K] or -1
+        isnew_rep = rep_sorted & (loc < 0)
+        nrank = jnp.cumsum(isnew_rep.astype(jnp.int32)) - 1
+        np0 = m.npoin
+        slot_rep = jnp.where(isnew_rep, np0 + nrank, loc)   # [4K] sorted
+        # per-group slot, then back to original corner order
+        gslot = jnp.full(4 * k, -1, jnp.int32).at[
+            jnp.where(rep_sorted, uid, 4 * k)
+        ].max(slot_rep, mode="drop")
+        slot_sorted = gslot[uid]
+        corner_slot = jnp.full(4 * k, -1, jnp.int32).at[order].set(
+            slot_sorted, unique_indices=True
+        )                                                   # [4K]
+        # write payloads of NEW vertices (one writer: the representative)
+        wnew = jnp.zeros(4 * k, bool).at[order].set(
+            isnew_rep, unique_indices=True
+        )
+        tgt_v = common.unique_oob(wnew, corner_slot, pcap)
+        vtag_in = ti[:, 5:9].reshape(-1)
+        vref_in = ti[:, 9:13].reshape(-1)
+        gid_in = ti[:, :4].reshape(-1)
+        fpay = tf.reshape(-1, tf.shape[-1])                 # [4K,Wf]
+        mcomp = m.met.shape[1]
+        lc = m.ls.shape[1]
+        dc = m.disp.shape[1]
+        vert = common.scatter_rows(m.vert, tgt_v, fpay[:, :3], unique=True)
+        met = common.scatter_rows(m.met, tgt_v, fpay[:, 3:3 + mcomp],
+                                  unique=True)
+        ls = common.scatter_rows(m.ls, tgt_v, fpay[:, 3 + mcomp:3 + mcomp + lc],
+                                 unique=True)
+        disp = common.scatter_rows(
+            m.disp, tgt_v, fpay[:, 3 + mcomp + lc:3 + mcomp + lc + dc],
+            unique=True,
+        )
+        fields = common.scatter_rows(
+            m.fields, tgt_v, fpay[:, 3 + mcomp + lc + dc:], unique=True
+        )
+        kwu = dict(mode="drop", unique_indices=True)
+        vtag = m.vtag.at[tgt_v].set(vtag_in, **kwu)
+        vref = m.vref.at[tgt_v].set(vref_in, **kwu)
+        vglob = m.vglob.at[tgt_v].set(gid_in, **kwu)
+        vmask = m.vmask.at[tgt_v].set(True, **kwu)
+
+        # ---- tets ------------------------------------------------------
+        cs4 = corner_slot.reshape(k, 4)
+        ne0 = m.ntet
+        trank = jnp.cumsum(t_valid.astype(jnp.int32)) - 1
+        tgt_t = common.unique_oob(t_valid, ne0 + trank, tcap)
+        tet = common.scatter_rows(m.tet, tgt_t, cs4, unique=True)
+        tref = m.tref.at[tgt_t].set(ti[:, 4], **kwu)
+        tmask = (m.tmask & ~out_s).at[tgt_t].set(t_valid, **kwu)
+
+        # ---- trias: dedup against local by gid triple ------------------
+        f_valid = fi[:, 0] >= 0
+        # local keys in gid space (kept real trias only)
+        ltr = jnp.sort(
+            jnp.where(tr_keep[:, None], m.vglob[m.tria], -1), axis=1
+        )
+        qtr = jnp.sort(jnp.where(f_valid[:, None], fi[:, :3], -1), axis=1)
+        dup_loc = common.sorted_membership(ltr, qtr)
+        # dedup among incoming (first occurrence wins)
+        ord_f = jnp.lexsort((qtr[:, 2], qtr[:, 1], qtr[:, 0])).astype(
+            jnp.int32
+        )
+        sq = qtr[ord_f]
+        firstf = jnp.concatenate(
+            [jnp.ones(1, bool), jnp.any(sq[1:] != sq[:-1], axis=1)]
+        ) & (sq[:, 0] >= 0)
+        f_first = jnp.zeros(fi.shape[0], bool).at[ord_f].set(
+            firstf, unique_indices=True
+        )
+        f_add = f_valid & f_first & ~dup_loc
+        # map gids -> local slots (all corners were sent with some tet)
+        fslot = common.match_rows(
+            jnp.where(vmask, vglob, -1)[:, None],
+            jnp.where(f_add[:, None], fi[:, :3], -1).reshape(-1, 1),
+        ).reshape(-1, 3)
+        f_add = f_add & jnp.all(fslot >= 0, axis=1)
+        # kept trias stay in place (mask only); appends go after the
+        # pre-migration live prefix — compact() later repacks
+        frank = jnp.cumsum(f_add.astype(jnp.int32)) - 1
+        free0 = m.ntria  # append after current live prefix
+        tgt_f = common.unique_oob(f_add, free0 + frank, fcap)
+        tria = common.scatter_rows(m.tria, tgt_f, fslot, unique=True)
+        trref = m.trref.at[tgt_f].set(fi[:, 3], **kwu)
+        trtag = m.trtag.at[tgt_f].set(fi[:, 4], **kwu)
+        trmask = tr_keep.at[tgt_f].set(f_add, **kwu)
+
+        # ---- feature edges: dedup by gid pair --------------------------
+        e_valid = ei[:, 0] >= 0
+        led = jnp.sort(
+            jnp.where(ed_keep[:, None], m.vglob[m.edge], -1), axis=1
+        )
+        qed = jnp.sort(jnp.where(e_valid[:, None], ei[:, :2], -1), axis=1)
+        dup_le = common.sorted_membership(led, qed)
+        ord_e = jnp.lexsort((qed[:, 1], qed[:, 0])).astype(jnp.int32)
+        se = qed[ord_e]
+        firste = jnp.concatenate(
+            [jnp.ones(1, bool), jnp.any(se[1:] != se[:-1], axis=1)]
+        ) & (se[:, 0] >= 0)
+        e_first = jnp.zeros(ei.shape[0], bool).at[ord_e].set(
+            firste, unique_indices=True
+        )
+        e_add = e_valid & e_first & ~dup_le
+        eslot = common.match_rows(
+            jnp.where(vmask, vglob, -1)[:, None],
+            jnp.where(e_add[:, None], ei[:, :2], -1).reshape(-1, 1),
+        ).reshape(-1, 2)
+        e_add = e_add & jnp.all(eslot >= 0, axis=1)
+        erank = jnp.cumsum(e_add.astype(jnp.int32)) - 1
+        tgt_e = common.unique_oob(e_add, m.nedge + erank, ecap)
+        edge = common.scatter_rows(m.edge, tgt_e, eslot, unique=True)
+        edref = m.edref.at[tgt_e].set(ei[:, 2], **kwu)
+        edtag = m.edtag.at[tgt_e].set(ei[:, 3], **kwu)
+        edmask = ed_keep.at[tgt_e].set(e_add, **kwu)
+
+        # capacity overflow flags: appended entities beyond the caps are
+        # DROPPED by the scatters above, so the caller must be told
+        overflow = jnp.stack([
+            np0 + jnp.sum(wnew.astype(jnp.int32)) - pcap,
+            ne0 + jnp.sum(t_valid.astype(jnp.int32)) - tcap,
+            free0 + jnp.sum(f_add.astype(jnp.int32)) - fcap,
+            m.nedge + jnp.sum(e_add.astype(jnp.int32)) - ecap,
+        ])
+        return m.replace(
+            vert=vert, met=met, ls=ls, disp=disp, fields=fields,
+            vtag=vtag, vref=vref, vglob=vglob, vmask=vmask,
+            tet=tet, tref=tref, tmask=tmask,
+            tria=tria, trref=trref, trtag=trtag, trmask=trmask,
+            edge=edge, edref=edref, edtag=edtag, edmask=edmask,
+        ), overflow
+
+    return jax.vmap(per_shard)(stacked, out_t, rti, rtf, rfi, rei,
+                               tria_keep, edge_keep)
+
+
+def migrate(stacked: Mesh, color: jax.Array, nparts: int,
+            slot_cap: int) -> Mesh:
+    """Move tets to their `color` shard via the fixed-slot exchange.
+    `slot_cap` must be >= max outgoing count per (src,dst) pair — the
+    host picks it from `migration_counts`. Capacities must have headroom
+    for the incoming entities (host responsibility, like every other
+    growth decision)."""
+    tria_cap = slot_cap + 8
+    edge_cap = max(slot_cap // 2, 64)
+    (bti, btf, bfi, bei, tria_keep, edge_keep, pack_n), out_t = _pack(
+        stacked, color, slot_cap, tria_cap, edge_cap
+    )
+    # pack-side overflow check: a slot cap that undershoots would DROP
+    # outgoing entities (their source copies are already released), so
+    # verify the true per-destination counts before anything is applied
+    pn = np.asarray(jax.device_get(pack_n))      # [D, 3(kind), D(dst)]
+    caps = np.asarray([slot_cap, tria_cap, edge_cap])[None, :, None]
+    if (pn > caps).any():
+        raise RuntimeError(
+            "migration slot capacities too small (per-source max "
+            f"[tets,trias,edges]: {pn.max(axis=(0, 2)).tolist()} vs caps "
+            f"{caps.ravel().tolist()}) — raise slot_cap"
+        )
+    rti, rtf, rfi, rei = (
+        _exchange(bti), _exchange(btf), _exchange(bfi), _exchange(bei)
+    )
+    out, overflow = _integrate(stacked, out_t, rti, rtf, rfi, rei,
+                               tria_keep, edge_keep)
+    over = np.asarray(jax.device_get(overflow))
+    if (over > 0).any():
+        raise RuntimeError(
+            "migration overflowed shard capacities "
+            f"(excess per shard [verts,tets,trias,edges]: {over.tolist()})"
+            " — grow the stacked mesh before migrating"
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# interface re-tagging (host, connectivity-only)
+# ---------------------------------------------------------------------------
+
+def retag_interfaces(stacked: Mesh, icap=None) -> Tuple[Mesh, ShardComm]:
+    """Recompute the parallel-interface discipline after migration:
+    PARBDY/PARBDYBDY vertex tags from global gid multiplicity, synthetic
+    NOSURF trias from cross-shard open-face matching, then the node
+    tables. Host numpy over CONNECTIVITY ARRAYS ONLY (gids, faces, tags
+    — ints); geometry stays on device."""
+    d = stacked.vert.shape[0]
+    vglob = np.asarray(stacked.vglob)
+    vmask = np.asarray(stacked.vmask)
+    vtag = np.asarray(stacked.vtag).copy()
+    tet = np.asarray(stacked.tet)
+    tmask = np.asarray(stacked.tmask)
+    adja = np.asarray(jax.device_get(
+        jax.vmap(adjacency.build_adjacency)(stacked).adja
+    ))
+    tria = np.asarray(stacked.tria)
+    trmask = np.asarray(stacked.trmask).copy()
+    trtag = np.asarray(stacked.trtag).copy()
+    trref = np.asarray(stacked.trref).copy()
+
+    # --- PARBDY from gid multiplicity ---------------------------------
+    all_g = [vglob[s][vmask[s]] for s in range(d)]
+    cat = np.concatenate(all_g) if len(all_g) else np.zeros(0, np.int64)
+    if len(cat):
+        mult = np.bincount(cat.astype(np.int64),
+                           minlength=int(cat.max()) + 1)
+    else:
+        mult = np.zeros(1, np.int64)
+    for s in range(d):
+        live = vmask[s]
+        shared = np.zeros(vglob.shape[1], bool)
+        shared[live] = mult[vglob[s][live]] > 1
+        vtag[s] = np.where(
+            shared, vtag[s] | tags.PARBDY,
+            vtag[s] & ~(tags.PARBDY | tags.PARBDYBDY),
+        )
+
+    # --- open faces per shard -> cross-shard interface faces ----------
+    from ..utils.rows import row_member
+
+    fv = np.asarray(FACE_VERTS)
+    face_rows = []
+    for s in range(d):
+        open_f = (adja[s] < 0) & tmask[s][:, None]
+        t_ids, f_ids = np.nonzero(open_f)
+        if len(t_ids):
+            corners = tet[s][t_ids[:, None], fv[f_ids]]        # [K,3]
+            g3 = np.sort(vglob[s][corners], axis=1)
+        else:
+            g3 = np.zeros((0, 3), np.int64)
+        face_rows.append(g3)
+    allr = np.concatenate(face_rows)
+    _, inv, cnts = np.unique(
+        allr, axis=0, return_inverse=True, return_counts=True
+    )
+    is_ifc = cnts[inv] > 1                     # face present in 2 shards
+
+    # --- synthetic trias: drop stale, refresh bits, add missing -------
+    new_syn = []
+    off = 0
+    for s in range(d):
+        g3 = face_rows[s]
+        k = len(g3)
+        ifc_rows = g3[is_ifc[off:off + k]]
+        off += k
+        syn_mask = tags.pure_interface_tria(trtag[s]) & trmask[s]
+        syn_slots = np.nonzero(syn_mask)[0]
+        # stale synthetic trias: no longer an interface face
+        if len(syn_slots):
+            syn_rows = np.sort(vglob[s][tria[s][syn_slots]], axis=1)
+            still = row_member(syn_rows, ifc_rows)
+            trmask[s][syn_slots[~still]] = False
+        # real trias: set/clear interface bits by membership
+        real_slots = np.nonzero(trmask[s] & ~syn_mask)[0]
+        if len(real_slots):
+            real_rows = np.sort(vglob[s][tria[s][real_slots]], axis=1)
+            at_ifc = row_member(real_rows, ifc_rows)
+            trtag[s][real_slots[at_ifc]] |= (
+                tags.PARBDY | tags.PARBDYBDY | tags.BDY
+            )
+            was_par = (trtag[s][real_slots] & tags.PARBDYBDY) != 0
+            clear = real_slots[~at_ifc & was_par]
+            trtag[s][clear] &= ~(tags.PARBDY | tags.PARBDYBDY)
+        # missing synthetic trias: interface faces with no tria at all
+        live_now = np.nonzero(trmask[s])[0]
+        have_rows = (
+            np.sort(vglob[s][tria[s][live_now]], axis=1)
+            if len(live_now) else np.zeros((0, 3), np.int64)
+        )
+        missing = ifc_rows[~row_member(ifc_rows, have_rows)]
+        missing = np.unique(missing, axis=0)
+        # gid -> local slot lookup
+        live_v = np.nonzero(vmask[s])[0]
+        lut = np.full(int(vglob[s][live_v].max(initial=0)) + 2, -1,
+                      np.int64)
+        lut[vglob[s][live_v]] = live_v
+        new_syn.append(lut[missing] if len(missing)
+                       else np.zeros((0, 3), np.int64))
+
+    # append synthetic trias (host write into the stacked arrays)
+    tria_new = np.asarray(stacked.tria).copy()
+    IFC_TAG = tags.PARBDY | tags.REQUIRED | tags.NOSURF | tags.BDY
+    for s in range(d):
+        need = len(new_syn[s])
+        if need == 0:
+            continue
+        free = np.nonzero(~trmask[s])[0]
+        if need > len(free):
+            raise RuntimeError(
+                f"tria capacity too small for {need} interface trias"
+            )
+        sel = free[:need]
+        tria_new[s][sel] = np.asarray(new_syn[s])
+        trref[s][sel] = 0
+        trtag[s][sel] = IFC_TAG
+        trmask[s][sel] = True
+
+    # PARBDYBDY vertex bits
+    for s in range(d):
+        both = ((vtag[s] & tags.PARBDY) != 0) & ((vtag[s] & tags.BDY) != 0)
+        vtag[s] = np.where(both, vtag[s] | tags.PARBDYBDY, vtag[s])
+
+    stacked = stacked.replace(
+        vtag=jnp.asarray(vtag),
+        tria=jnp.asarray(tria_new),
+        trref=jnp.asarray(trref),
+        trtag=jnp.asarray(trtag),
+        trmask=jnp.asarray(trmask),
+    )
+    return stacked, rebuild_comm(stacked, icap)

@@ -66,64 +66,6 @@ def sfc_partition(
     return jnp.where(mesh.tmask, part, -1)
 
 
-def displace_partition(
-    part: "np.ndarray",
-    adja: "np.ndarray",
-    tmask: "np.ndarray",
-    nparts: int,
-    round_id: int,
-    layers: int = 2,
-    min_elts: int = 8,
-):
-    """Advancing-front interface displacement (host-side, numpy).
-
-    The partition-change role of the reference's
-    `PMMG_part_moveInterfaces` (`src/moveinterfaces_pmmg.c:1306`): for
-    `layers` rounds (reference default `PMMG_MVIFCS_NLAYERS=2`,
-    `src/parmmg.h:227`), every tet face-adjacent to a higher-priority
-    color adopts it, so winning colors grow a layer and every interface
-    surface moves sideways — the band frozen during the previous remesh
-    becomes interior. Priority is a FIXED deterministic permutation of
-    the colors (seeded by `round_id`; the driver keeps it constant so
-    fronts move monotonically — measured: the reference's
-    bigger-group-wins rule (`PMMG_get_ifcDirection`,
-    `src/moveinterfaces_pmmg.c:74-98`) oscillates at shard granularity
-    because counts stay noise-level equal, re-freezing the same band;
-    the reference tolerates that by re-splitting groups with Metis,
-    machinery we replace with the driver's GRPS_RATIO re-cut guard). A
-    color may not shrink below `min_elts` tets (the `nemin` floor,
-    `src/moveinterfaces_pmmg.c:1343`).
-    """
-    import numpy as np
-
-    part = np.asarray(part).copy()
-    adja = np.asarray(adja)
-    tmask = np.asarray(tmask)
-    # fixed priority permutation (odd multiplier mod 2^16)
-    prio = ((np.arange(nparts, dtype=np.int64) * 40503 + round_id * 25173)
-            * 2654435761) % (1 << 16)
-    nb = adja >> 2
-    valid = (adja >= 0) & tmask[:, None]
-    for _ in range(layers):
-        nbcol = np.where(valid, part[np.maximum(nb, 0)], -1)
-        nbprio = np.where(nbcol >= 0, prio[np.maximum(nbcol, 0)], -1)
-        k = np.argmax(nbprio, axis=1)
-        rows = np.arange(part.shape[0])
-        bestprio = nbprio[rows, k]
-        bestcol = nbcol[rows, k]
-        own = np.where(tmask, part, 0)
-        flip = tmask & (bestprio > prio[own]) & (bestcol >= 0)
-        # don't let a color shrink below min_elts (empty-shard repair)
-        counts = np.bincount(part[tmask], minlength=nparts)
-        losses = np.bincount(
-            part[flip], minlength=nparts
-        )
-        starved = (counts - losses) < min_elts
-        flip &= ~starved[np.where(tmask, part, 0)]
-        part = np.where(flip, bestcol, part)
-    return part
-
-
 def renumber_sfc(mesh: Mesh) -> Mesh:
     """Reorder valid tets along the Morton curve (cache-locality role of
     the reference's Scotch renumbering)."""

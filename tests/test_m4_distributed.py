@@ -204,3 +204,68 @@ def test_interface_displacement_refines_frozen_bands():
         counts[nobal] = nlong(merge_adapted(st, comm))
     # displacement must clear the majority of the frozen long edges
     assert counts[False] < 0.5 * counts[True], counts
+
+
+def test_device_migration_conserves_and_retags():
+    """One displacement + fixed-slot migration round (parallel.migrate):
+    tets conserved, every shard conformal, interface discipline
+    re-derived (the PMMG_transfer_all_grps + PMMG_updateTag roles,
+    reference src/distributegrps_pmmg.c:1843, src/tag_pmmg.c:267)."""
+    import jax
+    import jax.numpy as jnp
+
+    from parmmg_tpu.core import adjacency as adj
+    from parmmg_tpu.core.mesh import compact
+    from parmmg_tpu.models.adapt import AdaptOptions, prepare_metric
+    from parmmg_tpu.models.distributed import grow_stacked
+    from parmmg_tpu.ops import analysis
+    from parmmg_tpu.parallel import migrate as mig
+    from parmmg_tpu.parallel.distribute import (
+        assign_global_ids, merge_shards, rebuild_comm, split_mesh,
+    )
+    from parmmg_tpu.parallel.partition import sfc_partition
+
+    mesh = unit_cube_mesh(5)
+    mesh = adj.build_adjacency(mesh)
+    mesh = analysis.analyze(mesh)
+    mesh = prepare_metric(
+        mesh, AdaptOptions(hsiz=0.2, hgrad=None), int(mesh.tcap * 1.6) + 64
+    )
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 8)))
+    stacked, comm = split_mesh(mesh, part, 8)
+    stacked = assign_global_ids(stacked)
+    comm = rebuild_comm(stacked)
+    stacked = jax.vmap(adj.build_adjacency)(stacked)
+    ne0 = int(jnp.sum(stacked.tmask))
+
+    color = mig.displace_colors(stacked, comm, 8, round_id=0, layers=2)
+    cnts = np.asarray(jax.device_get(mig.migration_counts(stacked, color, 8)))
+    assert cnts.sum() > 0, "displacement moved nothing"
+    inc = cnts.sum(axis=0)
+    ne_s = np.asarray(jax.device_get(jnp.sum(stacked.tmask, axis=1)))
+    np_s = np.asarray(jax.device_get(jnp.sum(stacked.vmask, axis=1)))
+    stacked = grow_stacked(
+        stacked,
+        pcap=int((np_s + 4 * inc).max() * 1.5) + 8,
+        tcap=int((ne_s + inc).max() * 1.5) + 8,
+        fcap=stacked.tria.shape[1] * 2,
+        ecap=stacked.edge.shape[1] * 2,
+    )
+    color = jnp.pad(
+        color, ((0, 0), (0, stacked.tet.shape[1] - color.shape[1])),
+        constant_values=-1,
+    )
+    st2 = mig.migrate(stacked, color, 8, int(cnts.max()) + 8)
+    st2 = jax.vmap(compact)(st2)
+    assert int(jnp.sum(st2.tmask)) == ne0, "migration lost/duplicated tets"
+
+    st3, comm2 = mig.retag_interfaces(st2)
+    # every shard conformal, merged mesh conformal (dedup by gid works)
+    for s in range(8):
+        m = jax.tree_util.tree_map(lambda a: a[s], st3)
+        rep = check_mesh(m)
+        assert rep.ok, f"shard {s}: {rep}"
+    merged = merge_shards(st3, comm2)
+    rep = check_mesh(merged)
+    assert rep.ok, str(rep)
+    assert int(merged.ntet) == ne0

@@ -113,5 +113,9 @@ def test_cube_ridges_preserved_under_coarsening():
     # total volume preserved (flat faces: surface ops are in-plane)
     from parmmg_tpu.core.mesh import tet_volumes
 
-    vol = np.asarray(tet_volumes(out))[np.asarray(out.tmask)].sum()
-    assert vol == pytest.approx(1.0, rel=1e-9)
+    vol = np.asarray(tet_volumes(out), np.float64)[
+        np.asarray(out.tmask)
+    ].sum()
+    # f32 mesh: per-tet volumes carry f32 rounding; the sum is exact to
+    # ~n*eps_f32, not 1e-9
+    assert vol == pytest.approx(1.0, rel=1e-6)

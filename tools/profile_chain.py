@@ -1,0 +1,103 @@
+"""Chained per-op profiler: real numbers on backends whose
+block_until_ready does not synchronize (the remote TPU tunnel).
+
+Each op runs R times inside one jitted lax.fori_loop with the mesh as
+loop carry (true data dependency), so the measured wall time is actual
+device compute. Usage:
+
+    python tools/profile_chain.py [n] [hsiz] [R]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    hsiz = float(sys.argv[2]) if len(sys.argv) > 2 else 0.08
+    R = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+
+    from parmmg_tpu.core import adjacency
+    from parmmg_tpu.core.mesh import compact
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.ops import analysis, collapse, smooth, split, swap
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    print(f"platform: {jax.devices()[0].platform}", flush=True)
+    est = int(12.0 / hsiz**3)
+    mesh = unit_cube_mesh(
+        n,
+        tcap=int(est * 1.9),
+        pcap=max(int(est * 0.45), 4096),
+        fcap=max(int(est * 0.30), 4096),
+    )
+    t0 = time.perf_counter()
+    mesh, _ = adapt(mesh, AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=8,
+                                       hgrad=None))
+    print(f"prep: {time.perf_counter() - t0:.1f}s ne={int(mesh.ntet)}",
+          flush=True)
+    mesh = adjacency.build_adjacency(mesh)
+    ecap = int(mesh.tcap * 1.6) + 64
+    edges, emask, t2e, _ = adjacency.unique_edges(mesh, ecap)
+    jax.block_until_ready(mesh)
+
+    def timeit(name, step):
+        @jax.jit
+        def run(m):
+            return jax.lax.fori_loop(0, R, lambda i, mm: step(mm), m)
+
+        out = run(mesh)
+        _ = float(out.vert[0, 0])          # force full execution
+        t0 = time.perf_counter()
+        out = run(mesh)
+        _ = float(out.vert[0, 0])
+        dt = (time.perf_counter() - t0) / R * 1000
+        print(f"  {name:18s} {dt:8.1f} ms", flush=True)
+        return dt
+
+    dep = lambda m, x: m.replace(
+        vert=m.vert.at[0, 0].add(0.0 * x.reshape(-1)[0].astype(m.dtype))
+    )
+
+    rows = []
+    rows.append(("compact", timeit("compact", compact)))
+    rows.append(("unique_edges", timeit(
+        "unique_edges",
+        lambda m: dep(m, adjacency.unique_edges(m, ecap)[0]),
+    )))
+    rows.append(("build_adjacency", timeit(
+        "build_adjacency",
+        lambda m: dep(m, adjacency.build_adjacency(m).adja),
+    )))
+    rows.append(("tria_normals", timeit(
+        "tria_normals", lambda m: dep(m, analysis.tria_normals(m)[0]),
+    )))
+    rows.append(("vertex_normals", timeit(
+        "vertex_normals", lambda m: dep(m, analysis.vertex_normals(m)),
+    )))
+    rows.append(("split", timeit(
+        "split",
+        lambda m: split.split_long_edges(m, edges, emask, t2e)[0],
+    )))
+    rows.append(("collapse", timeit(
+        "collapse",
+        lambda m: collapse.collapse_short_edges(m, edges, emask, t2e)[0],
+    )))
+    rows.append(("swap32", timeit(
+        "swap32", lambda m: swap.swap_32(m, edges, emask, t2e)[0],
+    )))
+    rows.append(("swap23", timeit(
+        "swap23", lambda m: swap.swap_23(m, edges, emask)[0],
+    )))
+    rows.append(("smooth", timeit(
+        "smooth", lambda m: smooth.smooth_vertices(m, edges, emask)[0],
+    )))
+    print(f"TOTAL {sum(ms for _, ms in rows):.1f} ms  "
+          f"(ne={int(mesh.ntet)} tcap={mesh.tcap})")
+
+
+if __name__ == "__main__":
+    main()
