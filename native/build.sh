@@ -4,3 +4,11 @@ set -e
 cd "$(dirname "$0")"
 g++ -O2 -shared -fPIC -o libmedit_tok.so medit_tok.cpp
 echo "built $(pwd)/libmedit_tok.so"
+
+# C ABI shim (Fortran/ISO_C_BINDING surface; embeds CPython)
+PYINC=$(python3 -c "import sysconfig; print(sysconfig.get_paths()['include'])")
+PYLIB=$(python3 -c "import sysconfig; print(sysconfig.get_config_var('LIBDIR'))")
+PYVER=$(python3 -c "import sysconfig; print(sysconfig.get_config_var('LDVERSION'))")
+gcc -O2 -shared -fPIC -I"$PYINC" -o libparmmg_capi.so parmmg_capi.c \
+    -L"$PYLIB" -lpython"$PYVER"
+echo "built $(pwd)/libparmmg_capi.so"

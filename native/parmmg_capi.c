@@ -1,0 +1,106 @@
+/* C ABI for the parmmg_tpu library — the Fortran-surface role.
+ *
+ * The reference ships hand-written Fortran wrappers for every API
+ * function (`src/API_functionsf_pmmg.c`, 1,297 LoC of FORTRAN_NAME
+ * macros). Here the full setter/getter surface lives in Python
+ * (`parmmg_tpu/api.py`); foreign callers — Fortran via ISO_C_BINDING,
+ * C, or anything with a C FFI — consume this thin embedded-CPython shim
+ * instead of per-function name-mangled wrappers. The file-driven entry
+ * point below covers the reference CLI workflow (load → adapt → save,
+ * the `PMMG_parmmglib_centralized` path, `src/libparmmg.c:1444`);
+ * richer programs drive `parmmg_tpu.api.ParMesh` through Python.
+ *
+ * Build: native/build.sh (produces libparmmg_capi.so).
+ * Fortran usage sketch (ISO_C_BINDING):
+ *
+ *   interface
+ *     integer(c_int) function pmmgtpu_adapt_file(inmesh, insol, out, &
+ *         hsiz, niter, nparts) bind(c, name="pmmgtpu_adapt_file")
+ *       use iso_c_binding
+ *       character(kind=c_char), dimension(*) :: inmesh, insol, out
+ *       real(c_double), value :: hsiz
+ *       integer(c_int), value :: niter, nparts
+ *     end function
+ *   end interface
+ *
+ * Returns the graded status of the run: 0 = PMMG_SUCCESS,
+ * 1 = PMMG_LOWFAILURE (conformal mesh was still saved),
+ * 2 = PMMG_STRONGFAILURE (reference `src/libparmmgtypes.h:45-66`).
+ */
+
+#include <Python.h>
+#include <string.h>
+
+static int ensure_python(void) {
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        if (Py_IsInitialized()) {
+            /* release the GIL the interpreter holds after init, so
+             * later PyGILState_Ensure calls (from ANY caller thread)
+             * can acquire it instead of deadlocking */
+            PyEval_SaveThread();
+        }
+    }
+    return Py_IsInitialized() ? 0 : -1;
+}
+
+/* Adapt `inmesh` (Medit ASCII) to the metric in `insol` (may be NULL or
+ * "" for -optim implied sizes), writing `outmesh`. hsiz <= 0 means "use
+ * the sol metric"; nparts > 1 runs the distributed driver. */
+int pmmgtpu_adapt_file(const char *inmesh, const char *insol,
+                       const char *outmesh, double hsiz, int niter,
+                       int nparts) {
+    PyGILState_STATE g;
+    PyObject *mod = NULL, *fn = NULL, *res = NULL;
+    int rc = 2; /* STRONGFAILURE until proven otherwise */
+
+    if (ensure_python() != 0) return 2;
+    g = PyGILState_Ensure();
+
+    mod = PyImport_ImportModule("parmmg_tpu.api");
+    if (!mod) goto done;
+    fn = PyObject_GetAttrString(mod, "adapt_file");
+    if (!fn) goto done;
+    res = PyObject_CallFunction(
+        fn, "sssdii",
+        inmesh,
+        (insol && insol[0]) ? insol : "",
+        outmesh, hsiz, niter, nparts);
+    if (!res) goto done;
+    rc = (int)PyLong_AsLong(res);
+    if (PyErr_Occurred()) rc = 2;
+
+done:
+    if (PyErr_Occurred()) PyErr_Print();
+    Py_XDECREF(res);
+    Py_XDECREF(fn);
+    Py_XDECREF(mod);
+    PyGILState_Release(g);
+    return rc;
+}
+
+/* Library version string (static storage, do not free). */
+const char *pmmgtpu_version(void) {
+    static char buf[64] = "";
+    PyGILState_STATE g;
+    PyObject *mod = NULL, *v = NULL;
+
+    if (buf[0]) return buf;
+    if (ensure_python() != 0) return "unknown";
+    g = PyGILState_Ensure();
+    mod = PyImport_ImportModule("parmmg_tpu");
+    if (mod) {
+        v = PyObject_GetAttrString(mod, "__version__");
+        if (v) {
+            const char *s = PyUnicode_AsUTF8(v);
+            if (s) {
+                strncpy(buf, s, sizeof(buf) - 1);
+            }
+        }
+    }
+    if (PyErr_Occurred()) PyErr_Clear();
+    Py_XDECREF(v);
+    Py_XDECREF(mod);
+    PyGILState_Release(g);
+    return buf[0] ? buf : "unknown";
+}

@@ -509,3 +509,38 @@ class ParMesh:
 
         medit.save_met(self._result_mesh(), path)
         return ReturnStatus.SUCCESS
+
+
+def adapt_file(inmesh: str, insol: str, outmesh: str, hsiz: float,
+               niter: int, nparts: int) -> int:
+    """File-driven one-call adaptation — the target of the C-ABI shim
+    (`native/parmmg_capi.c`, the Fortran-surface role of the reference's
+    `API_functionsf_pmmg.c`): load -> adapt (centralized or distributed)
+    -> save, returning the graded ReturnStatus as an int. `insol` may be
+    "" (implied -optim metric); `hsiz` <= 0 means "use the sol metric"."""
+    from .io import medit
+    from .models.adapt import AdaptOptions, adapt as _adapt
+
+    try:
+        mesh = medit.load_mesh(inmesh, insol or None)
+        hs = hsiz if hsiz > 0 else None
+        if nparts > 1:
+            from .models.distributed import (
+                DistOptions, adapt_distributed, merge_adapted,
+            )
+
+            st, comm, info = adapt_distributed(
+                mesh, DistOptions(hsiz=hs, niter=niter, nparts=nparts)
+            )
+            out = merge_adapted(st, comm)
+            status = int(info["status"])
+        else:
+            out, _info = _adapt(mesh, AdaptOptions(hsiz=hs, niter=niter))
+            status = int(ReturnStatus.SUCCESS)
+        medit.save_mesh(out, outmesh)
+        return status
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        return int(ReturnStatus.STRONGFAILURE)

@@ -113,6 +113,11 @@ def displace_colors(
     starvation floor enforced on GLOBAL color counts (psum'd across
     shards).
     """
+    if nparts > 256:
+        raise ValueError(
+            "displace_colors packs (prio, color) in radix 256; "
+            f"nparts={nparts} needs a wider encoding"
+        )
     d = stacked.vert.shape[0]
     tcap = stacked.tet.shape[1]
     pcap = stacked.vert.shape[1]
@@ -284,9 +289,10 @@ def _pack(stacked: Mesh, color: jax.Array, slot_cap: int,
             [
                 m.vglob[m.tria],
                 m.trref[:, None],
-                (m.trtag & ~(tags.PARBDY | tags.PARBDYBDY | tags.NOSURF))[
-                    :, None
-                ],
+                # strip only the interface-position bits; NOSURF stays
+                # with the REQUIRED it marks as split-added, so merge
+                # can still strip the pair (reference MG_NOSURF role)
+                (m.trtag & ~(tags.PARBDY | tags.PARBDYBDY))[:, None],
             ],
             axis=1,
         ).astype(jnp.int32)                  # [F,5]
@@ -644,6 +650,12 @@ def retag_interfaces(stacked: Mesh, icap=None) -> Tuple[Mesh, ShardComm]:
             trtag[s][real_slots[at_ifc]] |= (
                 tags.PARBDY | tags.PARBDYBDY | tags.BDY
             )
+            # freeze real interface trias that are not yet required —
+            # with NOSURF marking the REQUIRED as split-added so merge
+            # strips it; USER-required trias keep their plain REQUIRED
+            fresh = real_slots[at_ifc]
+            noreq = (trtag[s][fresh] & tags.REQUIRED) == 0
+            trtag[s][fresh[noreq]] |= tags.REQUIRED | tags.NOSURF
             was_par = (trtag[s][real_slots] & tags.PARBDYBDY) != 0
             clear = real_slots[~at_ifc & was_par]
             trtag[s][clear] &= ~(tags.PARBDY | tags.PARBDYBDY)

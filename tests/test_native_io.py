@@ -16,3 +16,26 @@ def test_native_tokenizer_parity():
         text = f.read()
     py = re.compile(r"#.*").sub(" ", text).split()
     assert native_io.tokenize(CUBE) == py
+
+
+def test_capi_adapt_file(tmp_path):
+    """The C-ABI shim target: `api.adapt_file` runs load -> adapt -> save
+    and returns the graded status (the Fortran-surface role of
+    `API_functionsf_pmmg.c`; `native/parmmg_capi.c` calls exactly this)."""
+    import os
+
+    from parmmg_tpu import api
+    from parmmg_tpu.io import medit
+    from parmmg_tpu.utils import conformity
+
+    ref = "/root/reference/libexamples/adaptation_example0/cube.mesh"
+    if not os.path.exists(ref):
+        import pytest
+
+        pytest.skip("reference fixture not available")
+    out = str(tmp_path / "capi.mesh")
+    rc = api.adapt_file(ref, "", out, 0.25, 1, 1)
+    assert rc == 0
+    m = medit.load_mesh(out)
+    rep = conformity.check_mesh(m)
+    assert rep.ok, str(rep)
