@@ -29,9 +29,12 @@
  */
 
 #include <Python.h>
+#include <pthread.h>
 #include <string.h>
 
-static int ensure_python(void) {
+static pthread_once_t init_once = PTHREAD_ONCE_INIT;
+
+static void init_python_once(void) {
     if (!Py_IsInitialized()) {
         Py_InitializeEx(0);
         if (Py_IsInitialized()) {
@@ -41,6 +44,12 @@ static int ensure_python(void) {
             PyEval_SaveThread();
         }
     }
+}
+
+static int ensure_python(void) {
+    /* once-guarded: concurrent first calls from multiple foreign
+     * threads must not race Py_InitializeEx */
+    pthread_once(&init_once, init_python_once);
     return Py_IsInitialized() ? 0 : -1;
 }
 

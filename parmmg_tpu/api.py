@@ -519,15 +519,13 @@ def adapt_file(inmesh: str, insol: str, outmesh: str, hsiz: float,
     -> save, returning the graded ReturnStatus as an int. `insol` may be
     "" (implied -optim metric); `hsiz` <= 0 means "use the sol metric"."""
     from .io import medit
-    from .models.adapt import AdaptOptions, adapt as _adapt
+    from .models.adapt import adapt as _adapt
 
     try:
         mesh = medit.load_mesh(inmesh, insol or None)
         hs = hsiz if hsiz > 0 else None
         if nparts > 1:
-            from .models.distributed import (
-                DistOptions, adapt_distributed, merge_adapted,
-            )
+            from .models.distributed import adapt_distributed, merge_adapted
 
             st, comm, info = adapt_distributed(
                 mesh, DistOptions(hsiz=hs, niter=niter, nparts=nparts)

@@ -659,6 +659,11 @@ def retag_interfaces(stacked: Mesh, icap=None) -> Tuple[Mesh, ShardComm]:
             was_par = (trtag[s][real_slots] & tags.PARBDYBDY) != 0
             clear = real_slots[~at_ifc & was_par]
             trtag[s][clear] &= ~(tags.PARBDY | tags.PARBDYBDY)
+            # ...and unfreeze them: the REQUIRED that NOSURF marks as
+            # split-added must go with the interface, or the band behind
+            # a displaced front never adapts
+            syn_req = clear[(trtag[s][clear] & tags.NOSURF) != 0]
+            trtag[s][syn_req] &= ~(tags.REQUIRED | tags.NOSURF)
         # missing synthetic trias: interface faces with no tria at all
         live_now = np.nonzero(trmask[s])[0]
         have_rows = (

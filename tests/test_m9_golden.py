@@ -1,0 +1,93 @@
+"""Analytic golden-quality gates (SURVEY §4 implication).
+
+The reference CI passes on exit code (remesh completed + conformity,
+`cmake/testing/pmmg_tests.cmake:30-50`); these gates hold the output to
+EXTERNAL yardsticks instead: unit-mesh edge-length concentration for a
+constant metric, predicted element-count bands, minimum-quality floors,
+and surface fidelity against the analytic geometry the mesh discretizes.
+The reference binary itself cannot be built here (BASELINE.md: its
+Mmg/Metis are ExternalProject downloads, no network egress), so analytic
+truths replace golden files.
+"""
+
+import numpy as np
+import pytest
+
+from parmmg_tpu.core import adjacency, tags
+from parmmg_tpu.models.adapt import AdaptOptions, adapt
+from parmmg_tpu.ops import quality
+from parmmg_tpu.utils import conformity
+from parmmg_tpu.utils.gen import unit_cube_mesh
+
+
+@pytest.fixture(scope="module")
+def cube_uniform():
+    """Unit cube adapted to constant hsiz=0.1 — the adaptation_example0
+    CI configuration class (uniform size map)."""
+    mesh = unit_cube_mesh(6)
+    out, info = adapt(
+        mesh, AdaptOptions(hsiz=0.1, niter=2, max_sweeps=10, hgrad=None)
+    )
+    return out, info
+
+
+def test_uniform_hsiz_edge_length_concentration(cube_uniform):
+    """For a constant metric h, a unit mesh has metric edge lengths
+    concentrated in [1/sqrt(2), sqrt(2)] (Mmg's LSHRT/LLONG band): at
+    least 90% of edges must land inside, and the mean must sit within
+    10% of 1."""
+    out, _ = cube_uniform
+    m = adjacency.build_adjacency(out)
+    edges, emask, _, _ = adjacency.unique_edges(m, int(m.tcap * 1.7) + 64)
+    e = np.asarray(edges)[np.asarray(emask)]
+    p = np.asarray(out.vert)
+    ell = np.linalg.norm(p[e[:, 0]] - p[e[:, 1]], axis=1) / 0.1
+    frac_unit = ((ell >= 1 / np.sqrt(2)) & (ell <= np.sqrt(2))).mean()
+    assert frac_unit >= 0.90, f"only {frac_unit:.1%} unit edges"
+    # refinement overshoots slightly (splits lead, collapses lag): the
+    # mean settles a little under 1
+    assert 0.80 <= float(ell.mean()) <= 1.25, float(ell.mean())
+
+
+def test_uniform_hsiz_element_count_band(cube_uniform):
+    """Element count must land in the analytic band: a unit cube filled
+    with regular tets of edge h contains 6*sqrt(2)/h^3 elements
+    (regular-tet volume h^3/(6*sqrt(2))); unstructured packing and the
+    refinement overshoot put real meshes within a [0.5, 3]x band."""
+    out, _ = cube_uniform
+    ne = int(out.ntet)
+    ideal = 6.0 * np.sqrt(2.0) / 0.1**3
+    assert 0.5 * ideal <= ne <= 3.0 * ideal, (ne, ideal)
+
+
+def test_uniform_hsiz_quality_floor(cube_uniform):
+    """Minimum and mean quality floors for the uniform cube workload —
+    the qualhisto gate the reference only prints (quality_pmmg.c:156)."""
+    out, _ = cube_uniform
+    h = quality.quality_histogram(out)
+    assert float(h.qmin) > 0.2, float(h.qmin)
+    assert float(h.qavg) > 0.6, float(h.qavg)
+    rep = conformity.check_mesh(out)
+    assert rep.ok, str(rep)
+
+
+def test_flat_faces_stay_flat(cube_uniform):
+    """Surface fidelity vs the analytic geometry: every boundary vertex
+    of the adapted unit cube must lie exactly on one of the six planes
+    (flat faces: hausd controls only curved surfaces, so the gate is
+    machine precision scaled)."""
+    out, _ = cube_uniform
+    vm = np.asarray(out.vmask)
+    vt = np.asarray(out.vtag)
+    p = np.asarray(out.vert)
+    bdy = vm & ((vt & tags.BDY) != 0)
+    bp = p[bdy]
+    on_face = (np.abs(bp) < 1e-6) | (np.abs(bp - 1.0) < 1e-6)
+    assert on_face.any(axis=1).all(), "boundary vertex left the surface"
+    # total volume exact to f32 accumulation error
+    from parmmg_tpu.core.mesh import tet_volumes
+
+    vol = np.asarray(tet_volumes(out), np.float64)[
+        np.asarray(out.tmask)
+    ].sum()
+    assert vol == pytest.approx(1.0, rel=1e-5), vol
