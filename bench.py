@@ -27,15 +27,17 @@ import time
 
 # steady-state tets/sec of the default workload on the host CPU backend
 # (measured with a warm jit cache; see BASELINE.md "CPU anchor" row).
-# Round-2 M5/M6 kernels measured 1367.3; re-measured 2026-07-31 after the
-# round-3 kernel work (packed sorts, fused sweep loop, scatter layer):
-# 93,788 output tets in 44.1 s. The anchor moves WITH the kernels so
-# vs_baseline stays an honest same-code hardware ratio.
-CPU_ANCHOR_TPS = 2128.2
+# Round-2 M5/M6 kernels measured 1367.3; early round-3 kernel work
+# (packed sorts, fused sweep loop, scatter layer) measured 2128.2;
+# re-measured 2026-07-31 with the second round-3 pass (seg_broadcast,
+# early-exit MIS, platform-aware lowering): 93,828 output tets in
+# 46.8 s. Host wall-clock drifts a few percent with machine load —
+# anchors are refreshed the same day as the TPU measurement so
+# vs_baseline stays an honest same-code same-day hardware ratio.
+CPU_ANCHOR_TPS = 2003.5
 # CPU anchor for the small fallback workload (n=8, hsiz=0.08),
-# re-measured 2026-07-31 with the same round-3 kernels (24,604 output
-# tets in 3.14 s)
-CPU_ANCHOR_TPS_SMALL = 7832.5
+# same-day measurement (24,604 output tets in 4.09 s)
+CPU_ANCHOR_TPS_SMALL = 6015.7
 
 
 def _workload(n, hsiz):

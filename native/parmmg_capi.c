@@ -32,9 +32,15 @@
 #include <pthread.h>
 #include <string.h>
 
-static pthread_once_t init_once = PTHREAD_ONCE_INIT;
+static pthread_mutex_t init_lock = PTHREAD_MUTEX_INITIALIZER;
 
-static void init_python_once(void) {
+static int ensure_python(void) {
+    /* mutex-guarded (NOT pthread_once): concurrent first calls from
+     * multiple foreign threads must not race Py_InitializeEx, but a
+     * failed init (e.g. a PYTHONHOME the host app fixes later) must
+     * stay retryable on the next call */
+    int ok;
+    pthread_mutex_lock(&init_lock);
     if (!Py_IsInitialized()) {
         Py_InitializeEx(0);
         if (Py_IsInitialized()) {
@@ -44,13 +50,9 @@ static void init_python_once(void) {
             PyEval_SaveThread();
         }
     }
-}
-
-static int ensure_python(void) {
-    /* once-guarded: concurrent first calls from multiple foreign
-     * threads must not race Py_InitializeEx */
-    pthread_once(&init_once, init_python_once);
-    return Py_IsInitialized() ? 0 : -1;
+    ok = Py_IsInitialized();
+    pthread_mutex_unlock(&init_lock);
+    return ok ? 0 : -1;
 }
 
 /* Adapt `inmesh` (Medit ASCII) to the metric in `insol` (may be NULL or

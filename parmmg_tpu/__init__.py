@@ -6,7 +6,25 @@ SURVEY.md): flat sharded mesh arrays, batched remeshing kernels, SFC
 repartitioning, and collective-based interface exchange in place of MPI.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
+# version metadata surface of the reference's configure-time header
+# (`src/pmmgversion.h.in:31-39`: RELEASE/MAJOR/MINOR/PATCH/DATE macros)
+VERSION_MAJOR, VERSION_MINOR, VERSION_PATCH = (
+    int(x) for x in __version__.split(".")
+)
+RELEASE_DATE = "2026-07-31"
+COPYRIGHT = "TPU-native rebuild; reference ParMmg (c) Bx INP/INRIA"
 
-from .core.mesh import Mesh  # noqa: F401
-from .core import tags  # noqa: F401
+
+def version_eq(major: int, minor: int) -> bool:
+    """`PMMG_VERSION_EQ` role (reference `src/pmmgversion.h.in:40`)."""
+    return VERSION_MAJOR == major and VERSION_MINOR == minor
+
+
+def version_ge(major: int, minor: int) -> bool:
+    """`PMMG_VERSION_GE` role."""
+    return (VERSION_MAJOR, VERSION_MINOR) >= (major, minor)
+
+
+from .core.mesh import Mesh  # noqa: E402,F401
+from .core import tags  # noqa: E402,F401

@@ -19,11 +19,19 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native parallel tetrahedral remesher "
         "(capability parity with the ParMmg CLI)",
     )
-    p.add_argument("input", help="input .mesh (Medit ASCII)")
+    p.add_argument("input", nargs="?", default=None,
+                   help="input .mesh (Medit ASCII)")
     p.add_argument("-out", "-o", dest="out", default=None,
                    help="output mesh name (default <input>.o.mesh)")
     p.add_argument("-sol", "-met", dest="sol", default=None,
                    help="metric .sol file")
+    p.add_argument("-field", dest="field", default=None,
+                   help="solution-field .sol to interpolate from the "
+                   "input onto the adapted mesh")
+    p.add_argument("-noout", action="store_true",
+                   help="do not write the output mesh")
+    p.add_argument("-val", dest="print_val", action="store_true",
+                   help="print the default parameter values and exit")
     p.add_argument("-v", dest="verbose", type=int, default=1,
                    help="verbosity level")
     p.add_argument("-m", dest="mem", type=float, default=None,
@@ -41,8 +49,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ridge-detection dihedral angle (degrees)")
     p.add_argument("-nr", dest="no_angle", action="store_true",
                    help="disable angle detection")
+    p.add_argument("-hgradreq", type=float, default=None,
+                   help="gradation ratio propagated from required "
+                   "entities (<=0 disables)")
     p.add_argument("-optim", action="store_true",
                    help="keep mesh-implied sizes, only improve quality")
+    p.add_argument("-optimLES", dest="optim_les", action="store_true",
+                   help="strong mesh optimization for LES computations "
+                   "(iso only)")
+    p.add_argument("-A", dest="aniso", action="store_true",
+                   help="enable anisotropy (without metric file)")
+    p.add_argument("-nofem", action="store_true",
+                   help="do not force a finite-element mesh (accepted "
+                   "for parity; the batched operators never create the "
+                   "non-FE configurations Mmg must repair)")
     p.add_argument("-rn", dest="renumber", action="store_true",
                    help="Morton-order renumbering for locality (the "
                    "reference's Scotch renumbering role)")
@@ -79,13 +99,42 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def print_default_values() -> None:
+    """`-val`: print the default parameters (PMMG_defaultValues role,
+    reference `src/libparmmg_tools.c`)."""
+    from .models.distributed import DistOptions
+
+    d = DistOptions()
+    print("\nDefault parameters values:")
+    print("\n** Generic options")
+    print(f"verbosity (-v)          : {d.verbose}")
+    print("\n** Parameters")
+    print(f"niter (-niter)          : {d.niter}")
+    print(f"nparts (-nparts)        : {d.nparts}")
+    print(f"ifc layers (-nlayers)   : {d.ifc_layers}")
+    print(f"groups ratio            : {d.grps_ratio}")
+    print(f"angle detection (-ar)   : {d.angle}")
+    print(f"hgrad (-hgrad)          : {d.hgrad}")
+    print(f"hgradreq (-hgradreq)    : {d.hgradreq or 'off'}")
+    print("hausd (-hausd)          : 0.01 x bounding-box diagonal")
+    print("hmin / hmax             : off")
+    print(f"max sweeps per iter     : {d.max_sweeps}")
+    print(f"memory budget (-m)      : {d.mem_budget_mb or 'unlimited'}")
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.print_val:
+        print_default_values()
+        return 0
+    if args.input is None:
+        build_parser().error("an input mesh is required")
 
     import numpy as np
 
     from .io import medit
-    from .models.adapt import AdaptOptions, adapt
+    from .models.adapt import adapt
     from .models.distributed import (
         DistOptions,
         adapt_distributed,
@@ -102,12 +151,29 @@ def main(argv=None) -> int:
         None if (args.hgrad is not None and args.hgrad <= 0)
         else (args.hgrad if args.hgrad is not None else 1.3)
     )
+    hgradreq = (
+        None if (args.hgradreq is None or args.hgradreq <= 0)
+        else args.hgradreq
+    )
+
+    # local-parameter file (`PMMG_parsop`, reference
+    # `src/libparmmg_tools.c:573`): <mesh>.mmg3d / DEFAULT.mmg3d
+    from .io import parsop
+
+    local_params = ()
+    pf = parsop.default_param_file(args.input)
+    if pf is not None:
+        local_params = parsop.parse_local_params(pf)
+        if args.verbose >= 1:
+            print(f"  %% {pf}: {len(local_params)} local parameter(s)")
 
     opts = DistOptions(
         niter=args.niter,
         hsiz=args.hsiz, hmin=args.hmin, hmax=args.hmax,
-        hgrad=hgrad, hausd=args.hausd, angle=angle,
-        optim=args.optim,
+        hgrad=hgrad, hgradreq=hgradreq, hausd=args.hausd, angle=angle,
+        optim=args.optim or args.optim_les, optim_les=args.optim_les,
+        aniso=args.aniso, nofem=args.nofem,
+        local_params=local_params,
         noinsert=args.noinsert, noswap=args.noswap,
         nomove=args.nomove, nosurf=args.nosurf,
         verbose=args.verbose,
@@ -117,6 +183,16 @@ def main(argv=None) -> int:
         ifc_layers=args.ifc_layers,
         grps_ratio=args.grps_ratio,
     )
+
+    fields = field_ncomp = None
+    if args.field:
+        if args.dist_in:
+            # capability parity: the reference prints the same error
+            # (`src/parmmg.c:300`)
+            print("  ## Error: Distributed fields input not yet "
+                  "implemented.", file=sys.stderr)
+            return 1
+        fields, field_ncomp = medit.load_fields(args.field)
 
     with timers.phase("input"):
         if args.dist_in:
@@ -140,6 +216,22 @@ def main(argv=None) -> int:
             mesh = vtk_io.load_vtu(args.input)
         else:
             mesh = medit.load_mesh(args.input, args.sol)
+        if fields is not None:
+            # uniform attach for every centralized input format (the
+            # fields sol is independent of the mesh file format)
+            import jax.numpy as jnp
+
+            npo = int(mesh.npoin)
+            if len(fields) != npo:
+                print(f"  ## Error: -field has {len(fields)} entries "
+                      f"for {npo} vertices.", file=sys.stderr)
+                return 1
+            pad = np.zeros((mesh.pcap, fields.shape[1]))
+            pad[:npo] = fields
+            mesh = mesh.replace(
+                fields=jnp.asarray(pad, mesh.dtype),
+                field_ncomp=tuple(field_ncomp),
+            )
 
     if args.ls is not None:
         try:
@@ -191,16 +283,9 @@ def main(argv=None) -> int:
             stacked, comm, info = adapt_distributed(mesh, opts)
             mesh_out = None
         else:
-            aopts = AdaptOptions(
-                niter=opts.niter, hsiz=opts.hsiz, hmin=opts.hmin,
-                hmax=opts.hmax, hgrad=opts.hgrad, hausd=opts.hausd,
-                angle=opts.angle, optim=opts.optim,
-                noinsert=opts.noinsert, noswap=opts.noswap,
-                nomove=opts.nomove, nosurf=opts.nosurf,
-                mem_budget_mb=opts.mem_budget_mb,
-                verbose=opts.verbose,
-            )
-            mesh_out, info = adapt(mesh, aopts)
+            # DistOptions extends AdaptOptions: the single-shard driver
+            # just ignores the redistribution fields
+            mesh_out, info = adapt(mesh, opts)
 
     if args.verbose >= 1:
         print(quality.format_histogram(info["qual_in"],
@@ -217,6 +302,10 @@ def main(argv=None) -> int:
             print(quality.format_length_stats(
                 quality.length_stats(m_l, e_l, em_l)
             ))
+
+    if args.noout:
+        timers.report()
+        return 0
 
     with timers.phase("output"):
         # output mode follows the input mode unless overridden: distributed
@@ -269,6 +358,27 @@ def main(argv=None) -> int:
                 medit.save_mesh(mesh_out, out)
                 medit.save_met(mesh_out,
                                os.path.splitext(out)[0] + ".sol")
+        # interpolated solution fields (`-field` round trip, reference
+        # `src/parmmg.c:433`)
+        if args.field and not vtk:
+            if distributed_out and mesh_out is None:
+                # per-shard fields next to the per-shard meshes, so the
+                # numbering matches what was actually written (the
+                # reference cannot write distributed fields at all)
+                from .parallel.distribute import unstack_mesh
+
+                for r, shard in enumerate(unstack_mesh(stacked)):
+                    medit.save_fields(
+                        shard,
+                        os.path.splitext(medit.shard_filename(out, r))[0]
+                        + ".fields.sol",
+                    )
+            else:
+                if mesh_out is None:
+                    mesh_out = merge_adapted(stacked, comm)
+                medit.save_fields(
+                    mesh_out, os.path.splitext(out)[0] + ".fields.sol"
+                )
     timers.report()
     return 0
 

@@ -278,7 +278,18 @@ class ParMesh:
         elif param == Param.IPARAM_nosurf:
             o.nosurf = bool(value)
         elif param == Param.IPARAM_optim:
-            o.optim = bool(value)
+            o.optim = bool(value) or o.optim_les
+        elif param == Param.IPARAM_optimLES:
+            o.optim_les = bool(value)
+            # optim is implied by optimLES but must unlatch when it is
+            # cleared (unless IPARAM_optim was set on its own)
+            o.optim = o.optim_les or bool(
+                self.iparam.get(Param.IPARAM_optim, 0)
+            )
+        elif param == Param.IPARAM_nofem:
+            o.nofem = bool(value)
+        elif param == Param.IPARAM_anisosize:
+            o.aniso = bool(value)
         elif param == Param.IPARAM_angle:
             if not value:
                 o.angle = None
@@ -324,8 +335,10 @@ class ParMesh:
             o.hsiz = float(value)
         elif param == Param.DPARAM_hausd:
             o.hausd = float(value)
-        elif param in (Param.DPARAM_hgrad, Param.DPARAM_hgradreq):
+        elif param == Param.DPARAM_hgrad:
             o.hgrad = None if value <= 0 else float(value)
+        elif param == Param.DPARAM_hgradreq:
+            o.hgradreq = None if value <= 0 else float(value)
         elif param == Param.DPARAM_angleDetection:
             o.angle = float(value)
         self.dparam[param] = float(value)

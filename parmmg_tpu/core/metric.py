@@ -130,7 +130,7 @@ def implied_iso_metric(vert, tet, tmask, pcap, clip=(1e-30, 1e30)):
     p1 = vert[ev[..., 1]]
     d = jnp.linalg.norm(p1 - p0, axis=-1)  # [T,6]
     d = jnp.where(tmask[:, None], d, 0.0)
-    w = jnp.where(tmask[:, None], 1.0, 0.0)
+    w = jnp.where(tmask[:, None], jnp.ones_like(d), 0.0)
     acc = jnp.zeros(pcap, vert.dtype)
     cnt = jnp.zeros(pcap, vert.dtype)
     for k in (0, 1):
@@ -139,6 +139,56 @@ def implied_iso_metric(vert, tet, tmask, pcap, clip=(1e-30, 1e30)):
     h = acc / jnp.maximum(cnt, 1.0)
     h = jnp.where(cnt > 0, h, 1.0)
     return jnp.clip(h, *clip)[:, None]
+
+
+def implied_aniso_metric(vert, tet, tmask, pcap, ratio_max: float = 4.0):
+    """Per-vertex tensor implied by the current mesh (`-A` without a
+    metric file: Mmg's `MMG3D_doSol_ani` role, forwarded by the reference
+    at `src/libparmmg_tools.c:142` via `PMMG_IPARAM_anisosize`).
+
+    Least-squares fit of M so that every incident tet edge has unit
+    metric length (e^T M e = 1): accumulate the normal equations
+    N = sum r r^T, rhs = sum r with r(e) the sym6 quadratic-form row,
+    solve per vertex, then project to SPD with eigenvalues clamped to a
+    `ratio_max` band around the isotropic implied size."""
+    from .mesh import EDGE_VERTS
+
+    ev = tet[:, EDGE_VERTS].reshape(-1, 2)  # [6T, 2]
+    live = jnp.repeat(tmask, 6)
+    e = vert[ev[:, 1]] - vert[ev[:, 0]]
+    ex, ey, ez = e[:, 0], e[:, 1], e[:, 2]
+    # sym6 order (m11, m12, m13, m22, m23, m33)
+    r = jnp.stack(
+        [ex * ex, 2 * ex * ey, 2 * ex * ez, ey * ey, 2 * ey * ez, ez * ez],
+        axis=-1,
+    )
+    rr = r[:, :, None] * r[:, None, :]  # [6T, 6, 6]
+    w = live.astype(vert.dtype)
+    N = jnp.zeros((pcap, 6, 6), vert.dtype)
+    rhs = jnp.zeros((pcap, 6), vert.dtype)
+    for k in (0, 1):
+        idx = jnp.where(live, ev[:, k], pcap)
+        N = N.at[idx].add(rr * w[:, None, None], mode="drop")
+        rhs = rhs.at[idx].add(r * w[:, None], mode="drop")
+    # ridge regularization keeps rank-deficient stars (boundary fans,
+    # vertices with <6 distinct edge directions) solvable
+    tr = jnp.trace(N, axis1=-2, axis2=-1)
+    N = N + (1e-6 * jnp.maximum(tr, 1e-30) / 6.0)[:, None, None] * jnp.eye(
+        6, dtype=vert.dtype
+    )
+    m6 = jnp.linalg.solve(N, rhs[..., None])[..., 0]
+    # SPD projection, eigenvalues within ratio_max of the iso implied size
+    h_iso = implied_iso_metric(vert, tet, tmask, pcap)[:, 0]
+    lam_mid = 1.0 / jnp.maximum(h_iso, 1e-30) ** 2
+    lo = lam_mid / ratio_max**2
+    hi = lam_mid * ratio_max**2
+    wv, v = _sym_eigh(m6)
+    wv = jnp.clip(wv, lo[:, None], hi[:, None])
+    out = mat_to_sym6(jnp.einsum("...ik,...k,...jk->...ij", v, wv, v))
+    return jnp.where(
+        jnp.isfinite(out).all(-1, keepdims=True), out,
+        iso_to_sym6(h_iso[:, None]),
+    )
 
 
 def apply_hbounds(met: jax.Array, hmin: float | None, hmax: float | None):
@@ -158,12 +208,18 @@ def apply_hbounds(met: jax.Array, hmin: float | None, hmax: float | None):
 
 
 def gradate_iso(
-    vert, met, edges, emask, niter: int = 20, hgrad: float = 1.3
+    vert, met, edges, emask, niter: int = 20, hgrad: float = 1.3,
+    fixed=None,
 ):
     """Metric gradation: limit the ratio of sizes across each edge so that
     h grows at most geometrically with metric distance (Mmg's `-hgrad`;
     reference forwards it at `src/libparmmg_tools.c` -hgrad). Iterative
-    edge relaxation: h_b <- min(h_b, h_a + (hgrad-1) * l_ab_euclid)."""
+    edge relaxation: h_b <- min(h_b, h_a + (hgrad-1) * l_ab_euclid).
+
+    `fixed` ([PC] bool, optional) marks vertices whose size must not be
+    modified — the propagation *from required entities* mode of
+    `-hgradreq` (Mmg `MMG3D_gradsizreq`): pass the REQUIRED vertex mask
+    and the required sizes win while everything else relaxes."""
     loghg = jnp.log(hgrad)
 
     def body(_, h):
@@ -177,9 +233,61 @@ def gradate_iso(
         na = jnp.where(emask, jnp.minimum(ha, cap_a), ha)
         h = h.at[b, 0].min(nb, mode="drop")
         h = h.at[a, 0].min(na, mode="drop")
+        if fixed is not None:
+            h = jnp.where(fixed[:, None], met, h)
         return h
 
     return jax.lax.fori_loop(0, niter, body, met)
+
+
+def gradate_from_required(
+    vert, met, edges, emask, req, niter: int = 20, hgrad: float = 1.3
+):
+    """`-hgradreq` (Mmg `MMG3D_gradsizreq`): sizes propagate FROM
+    required vertices only — required sizes are authoritative and cap
+    their (transitive) neighborhoods at the hgradreq ratio; vertices
+    with no required entity in reach are untouched (with no required
+    vertices at all this is a no-op, unlike a plain gradation pass).
+
+    Implementation: an auxiliary field g starts at the required sizes
+    (+inf elsewhere) and relaxes along edges like gradate_iso; the final
+    size is min(h, g) off the required set. Aniso metrics propagate
+    their smallest directional size and are scaled finer by the
+    violation factor (scalar cap, conservative like gradate_aniso)."""
+    a, b = edges[:, 0], edges[:, 1]
+    d = jnp.linalg.norm(vert[b] - vert[a], axis=-1)
+    loghg = jnp.log(hgrad)
+    inf = jnp.asarray(jnp.inf, vert.dtype)
+    if met.shape[-1] == 1:
+        h = met[:, 0]
+    else:
+        # smallest directional size: 1/sqrt(lambda_max)
+        w, _ = _sym_eigh(met)
+        h = 1.0 / jnp.sqrt(jnp.maximum(w[..., -1], 1e-30))
+    g0 = jnp.where(req, h, inf)
+
+    def body(_, g):
+        ga, gb = g[a], g[b]
+        cap_b = jnp.where(
+            jnp.isfinite(ga),
+            ga * jnp.exp(loghg * d / jnp.maximum(ga, 1e-30)), inf,
+        )
+        cap_a = jnp.where(
+            jnp.isfinite(gb),
+            gb * jnp.exp(loghg * d / jnp.maximum(gb, 1e-30)), inf,
+        )
+        g = g.at[b].min(jnp.where(emask, cap_b, inf), mode="drop")
+        g = g.at[a].min(jnp.where(emask, cap_a, inf), mode="drop")
+        return g
+
+    g = jax.lax.fori_loop(0, niter, body, g0)
+    reached = jnp.isfinite(g) & ~req
+    if met.shape[-1] == 1:
+        capped = jnp.minimum(met[:, 0], g)
+        return jnp.where(reached, capped, met[:, 0])[:, None]
+    # aniso: scale the tensor finer by (h/g)^2 where the cap is violated
+    f = jnp.where(reached & (g < h), (h / jnp.maximum(g, 1e-30)) ** 2, 1.0)
+    return met * f[:, None]
 
 
 def _max_geneig(M: jax.Array, G: jax.Array) -> jax.Array:
@@ -198,7 +306,8 @@ def _max_geneig(M: jax.Array, G: jax.Array) -> jax.Array:
 
 
 def gradate_aniso(
-    vert, met, edges, emask, niter: int = 8, hgrad: float = 1.3
+    vert, met, edges, emask, niter: int = 8, hgrad: float = 1.3,
+    fixed=None,
 ):
     """Anisotropic metric gradation (the `-hgrad` control Mmg applies via
     `MMG3D_gradsiz_ani`; the reference forwards hgrad for aniso runs at
@@ -242,6 +351,9 @@ def gradate_aniso(
         logf = logf.at[jnp.where(ok, a, pcap)].max(
             jnp.where(jnp.isfinite(logfa), logfa, 0.0), mode="drop"
         )
-        return m6 * jnp.exp(logf)[:, None]
+        out = m6 * jnp.exp(logf)[:, None]
+        if fixed is not None:
+            out = jnp.where(fixed[:, None], met, out)
+        return out
 
     return jax.lax.fori_loop(0, niter, body, met)

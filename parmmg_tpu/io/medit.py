@@ -382,6 +382,24 @@ def save_met(mesh: Mesh, path: str) -> None:
     save_sol(path, d["met"], [t])
 
 
+_NCOMP_SOL = {v: k for k, v in _SOL_NCOMP.items()}
+
+
+def save_fields(mesh: Mesh, path: str) -> None:
+    """Save the interpolated solution fields (`-field` output, the
+    `PMMG_saveAllSols_centralized` role, reference `src/parmmg.c:433`)."""
+    d = mesh.to_numpy()
+    types = [_NCOMP_SOL[nc] for nc in d["field_ncomp"]]
+    save_sol(path, d["fields"], types)
+
+
+def load_fields(path: str):
+    """Read a solution-fields sol file: (values [n, sum(ncomp)], ncomp
+    tuple) for Mesh.from_numpy's fields/field_ncomp."""
+    vals, types = read_sol(path)
+    return vals, tuple(_SOL_NCOMP[t] for t in types)
+
+
 def shard_filename(path: str, rank: int) -> str:
     """`name.mesh -> name.<rank>.mesh` (reference `PMMG_insert_rankIndex:387`)."""
     base, ext = os.path.splitext(path)

@@ -1,0 +1,74 @@
+"""Mmg local-parameter files (`<mesh>.mmg3d`).
+
+The reference forwards these via `PMMG_parsop` (`src/libparmmg_tools.c:573`)
+to `MMG3D_parsop`: a text file holding per-reference hmin/hmax/hausd
+overrides, applied to the entities carrying that reference.
+
+Format (Mmg's, case-insensitive keywords)::
+
+    Parameters
+    <n>
+    <ref> <Vertex|Triangle|Tetrahedron> <hmin> <hmax> <hausd>
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Tuple
+
+
+class LocalParam(NamedTuple):
+    ref: int
+    elt: str        # "vertex" | "triangle" | "tetrahedron"
+    hmin: float
+    hmax: float
+    hausd: float
+
+
+_ELT_ALIASES = {
+    "vertex": "vertex", "vertices": "vertex",
+    "triangle": "triangle", "triangles": "triangle",
+    "tetrahedron": "tetrahedron", "tetrahedra": "tetrahedron",
+    "tetra": "tetrahedron",
+}
+
+
+def parse_local_params(path: str) -> Tuple[LocalParam, ...]:
+    """Parse a `.mmg3d` local-parameter file (MMG3D_parsop grammar)."""
+    with open(path) as f:
+        toks = []
+        for line in f:
+            line = line.split("#")[0]
+            toks.extend(line.split())
+    i = 0
+    while i < len(toks) and toks[i].lower() != "parameters":
+        i += 1
+    if i >= len(toks):
+        raise ValueError(f"no Parameters section in {path}")
+    i += 1
+    n = int(toks[i])
+    i += 1
+    out = []
+    for _ in range(n):
+        ref = int(toks[i])
+        elt = _ELT_ALIASES.get(toks[i + 1].lower())
+        if elt is None:
+            raise ValueError(
+                f"unknown local-parameter entity {toks[i + 1]!r} in {path}"
+            )
+        hmin, hmax, hausd = (float(t) for t in toks[i + 2 : i + 5])
+        out.append(LocalParam(ref, elt, hmin, hmax, hausd))
+        i += 5
+    return tuple(out)
+
+
+def default_param_file(meshpath: str) -> str | None:
+    """The `<mesh>.mmg3d` file MMG3D_parsop looks for next to the mesh,
+    falling back to `DEFAULT.mmg3d` in the same directory."""
+    root = os.path.splitext(meshpath)[0]
+    for cand in (root + ".mmg3d",
+                 os.path.join(os.path.dirname(meshpath) or ".",
+                              "DEFAULT.mmg3d")):
+        if os.path.exists(cand):
+            return cand
+    return None

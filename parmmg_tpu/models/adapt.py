@@ -17,8 +17,9 @@ from typing import List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from ..core import adjacency, metric as metric_mod
+from ..core import adjacency, metric as metric_mod, tags
 from ..core.mesh import Mesh, compact
 from ..ops import analysis, collapse, quality, smooth, split, swap
 
@@ -43,6 +44,23 @@ class AdaptOptions:
     # (no angle detection)
     angle: Optional[float] = 45.0
     optim: bool = False         # keep implied sizes (-optim)
+    # -optimLES: strong optimization for LES — implies optim; iso only
+    # (the reference rejects optimLES with an aniso metric)
+    optim_les: bool = False
+    # -A: anisotropy without a metric file (PMMG_IPARAM_anisosize,
+    # reference `src/libparmmg_tools.c:142`): tensor metric implied by
+    # the input mesh, or the given scalar sizes promoted to tensors
+    aniso: bool = False
+    # -nofem: allow non finite-element configurations. Accepted for call
+    # parity; the batched operators never create the edge-connections Mmg
+    # repairs in FEM mode, so there is nothing to relax (obviated).
+    nofem: bool = False
+    # -hgradreq: gradation ratio propagated FROM required entities (their
+    # sizes win); None = off (Mmg MMG3D_gradsizreq role)
+    hgradreq: Optional[float] = None
+    # parsop local parameters: per-reference hmin/hmax/hausd overrides
+    # (`PMMG_parsop`, reference `src/libparmmg_tools.c:573`)
+    local_params: tuple = ()
     noinsert: bool = False      # -noinsert: no splits
     nosurf: bool = False        # -nosurf: freeze the boundary surface
     noswap: bool = False        # -noswap
@@ -242,20 +260,33 @@ def prepare_metric(mesh: Mesh, opts: AdaptOptions, ecap: int) -> Mesh:
     """Metric setup: constant size / implied size / bounds / gradation —
     the role of `MMG3D_Set_constantSize` / `MMG3D_doSol` / gradation in the
     reference preprocessing (`src/libparmmg.c:128-205`)."""
+    if opts.optim_les and (opts.aniso or mesh.met.shape[1] == 6):
+        raise ValueError("-optimLES is incompatible with an aniso metric "
+                         "(reference parsar discipline)")
     met = mesh.met
     is_iso = met.shape[1] == 1
     if opts.hsiz is not None:
         met = metric_mod.constant_iso_metric(
             mesh.pcap, opts.hsiz, mesh.dtype
         )
-    elif is_iso and (opts.optim or not mesh.met_set):
+    elif is_iso and opts.aniso and not mesh.met_set:
+        # -A with no metric file: tensor metric implied by the mesh
+        met = metric_mod.implied_aniso_metric(
+            mesh.vert, mesh.tet, mesh.tmask, mesh.pcap
+        ).astype(mesh.dtype)
+        is_iso = False
+    elif is_iso and (opts.optim or opts.optim_les or not mesh.met_set):
         # no prescribed metric: default to the implied sizes (like -optim)
         met = metric_mod.implied_iso_metric(
             mesh.vert, mesh.tet, mesh.tmask, mesh.pcap
         ).astype(mesh.dtype)
+    if opts.aniso and met.shape[1] == 1:
+        # -A alongside scalar sizes (hsiz / scalar sol): promote to tensors
+        met = metric_mod.iso_to_sym6(met)
     met = metric_mod.apply_hbounds(met, opts.hmin, opts.hmax)
+    met = _apply_local_hbounds(mesh, met, opts.local_params)
     mesh = mesh.replace(met=met, met_set=True)
-    if opts.hgrad is not None:
+    if opts.hgrad is not None or opts.hgradreq is not None:
         # honor unique_edges' overflow contract: retry with a larger cap
         # so gradation sees every edge
         while True:
@@ -268,11 +299,67 @@ def prepare_metric(mesh: Mesh, opts: AdaptOptions, ecap: int) -> Mesh:
             if met.shape[1] == 1
             else metric_mod.gradate_aniso
         )
-        met = gradate(
-            mesh.vert, mesh.met, edges, emask, hgrad=opts.hgrad
+        met = mesh.met
+        # with -hgradreq active, required sizes are authoritative: the
+        # plain gradation must not relax them either (MMG3D_gradsizreq:
+        # "required sizes win")
+        req = (
+            ((mesh.vtag & tags.REQUIRED) != 0) & mesh.vmask
+            if opts.hgradreq is not None else None
         )
+        if opts.hgrad is not None:
+            met = gradate(mesh.vert, met, edges, emask, hgrad=opts.hgrad,
+                          fixed=req)
+        if opts.hgradreq is not None:
+            # second pass: propagation FROM required entities only
+            # (a no-op when the mesh has none)
+            met = metric_mod.gradate_from_required(
+                mesh.vert, met, edges, emask, req, hgrad=opts.hgradreq
+            )
         mesh = mesh.replace(met=met)
     return mesh
+
+
+def _apply_local_hbounds(mesh: Mesh, met, local_params):
+    """Per-reference hmin/hmax clamps from parsop local parameters,
+    applied to the vertices of the entities carrying each reference
+    (`MMG3D_parsop` semantics via `PMMG_parsop`,
+    reference `src/libparmmg_tools.c:573`)."""
+    for lp in local_params:
+        if lp.elt == "vertex":
+            sel = (mesh.vref == lp.ref) & mesh.vmask
+        else:
+            conn, refs, emask2 = (
+                (mesh.tria, mesh.trref, mesh.trmask)
+                if lp.elt == "triangle"
+                else (mesh.tet, mesh.tref, mesh.tmask)
+            )
+            hit = (refs == lp.ref) & emask2
+            sel = jnp.zeros(mesh.pcap, bool)
+            sel = sel.at[
+                jnp.where(hit[:, None], conn, mesh.pcap).reshape(-1)
+            ].max(True, mode="drop")
+        clamped = metric_mod.apply_hbounds(met, lp.hmin, lp.hmax)
+        met = jnp.where(sel[:, None], clamped, met)
+    return met
+
+
+def local_hausd_table(mesh: Mesh, opts: AdaptOptions, hausd: float):
+    """Per-tria-reference hausd lookup (refs inherit through remeshing, so
+    a ref-indexed table stays valid as the mesh evolves). Returns the
+    scalar unchanged when no local triangle hausd is set."""
+    trs = [lp for lp in opts.local_params
+           if lp.elt == "triangle" and lp.hausd > 0]
+    if not trs:
+        return hausd
+    rmax = max(
+        int(jax.device_get(jnp.max(jnp.where(mesh.trmask, mesh.trref, 0)))),
+        max(lp.ref for lp in trs),
+    )
+    table = np.full(rmax + 1, hausd, np.float64)
+    for lp in trs:
+        table[lp.ref] = lp.hausd
+    return jnp.asarray(table, mesh.dtype)
 
 
 def estimate_target_ntet(mesh: Mesh) -> int:
@@ -483,7 +570,7 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     mesh = ensure_capacity(mesh, opts)
     mesh = analysis.analyze(mesh, ang=opts.angle)
     mesh = prepare_metric(mesh, opts, int(mesh.tcap * emult[0]) + 64)
-    hausd = resolve_hausd(mesh, opts)
+    hausd = local_hausd_table(mesh, opts, resolve_hausd(mesh, opts))
     h0 = quality.quality_histogram(mesh)
 
     # pre-size capacities for the predicted unit mesh so sweeps compile
@@ -501,16 +588,42 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
         )
         try:
             _check_budget(mesh, opts, *want)
-        except RuntimeError:
-            pass
+        except RuntimeError as exc:
+            # intended degradation: grow incrementally under the budget
+            # instead — but leave a visible trace so budget-bound runs
+            # are diagnosable
+            presize_skipped = str(exc)
+            if opts.verbose >= 1:
+                print(f"  ## Warning: presizing skipped ({exc}); "
+                      "growing incrementally under the memory budget")
         else:
+            presize_skipped = None
             mesh = mesh.with_capacity(*want)
+    else:
+        presize_skipped = None
+
+    # snapshot for the solution-field post-pass (reference: per-iteration
+    # `PMMG_interpMetricsAndFields`, `src/libparmmg1.c:829`; here fields
+    # are re-pulled once from the input so relocation drift cannot
+    # accumulate)
+    has_sols = (
+        mesh.fields.shape[1] + mesh.ls.shape[1] + mesh.disp.shape[1]
+    ) > 0
+    # deep copy: the sweep loop donates its input buffers
+    old_snapshot = (
+        jax.tree_util.tree_map(jnp.copy, mesh) if has_sols else None
+    )
 
     history: List[dict] = []
     for it in range(opts.niter):
         mesh = run_batched_sweep_loop(mesh, opts, emult, history, it, hausd)
 
     mesh = compact(mesh)
+    if old_snapshot is not None:
+        from ..ops import interp
+
+        mesh = interp.interp_fields_only(mesh, old_snapshot)
     h1 = quality.quality_histogram(mesh)
-    info = dict(history=history, qual_in=h0, qual_out=h1)
+    info = dict(history=history, qual_in=h0, qual_out=h1,
+                presize_skipped=presize_skipped)
     return mesh, info

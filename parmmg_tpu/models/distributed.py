@@ -190,18 +190,12 @@ def remesh_phase(
 
 
 def interp_phase(st: Mesh, old: Mesh) -> Mesh:
-    """Per-shard interpolation from the pre-remesh snapshot —
-    `PMMG_interpMetricsAndFields` (`src/interpmesh_pmmg.c:663`; purely
-    shard-local, see SURVEY §3.4). Host loop over shards so the rare
-    exhaustive-location fallback can compact its failed subset host-side
-    (the walk itself is one batched device kernel per shard)."""
-    news = unstack_mesh(st)
-    olds = unstack_mesh(old)
-    out = [
-        interp.interp_metrics_and_fields(n, o)[0]
-        for n, o in zip(news, olds)
-    ]
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *out)
+    """Interpolation from the pre-remesh snapshot for ALL shards in one
+    vmapped device call — `PMMG_interpMetricsAndFields`
+    (`src/interpmesh_pmmg.c:663`; purely shard-local, see SURVEY §3.4).
+    The rare walk failures are rescued host-side inside
+    `interp.interp_stacked` (exhaustive closest-element per shard)."""
+    return interp.interp_stacked(st, old)
 
 
 # ---------------------------------------------------------------------------
@@ -257,7 +251,9 @@ def adapt_distributed(
     mesh = analysis.analyze(mesh, ang=opts.angle)
     ecap0 = int(mesh.tcap * 1.6) + 64
     mesh = prepare_metric(mesh, opts, ecap0)
-    hausd = resolve_hausd(mesh, opts)
+    from .adapt import local_hausd_table
+
+    hausd = local_hausd_table(mesh, opts, resolve_hausd(mesh, opts))
     h_in = quality.quality_histogram(mesh)
 
     # a mesh too small for nparts shards is grown single-shard first, so
@@ -520,6 +516,10 @@ def adapt_stacked_input(
         hi = jnp.max(jnp.where(w, stacked.vert, -jnp.inf), axis=(0, 1))
         diag = float(jax.device_get(jnp.linalg.norm(hi - lo)))
         hausd = 0.01 * (diag if diag > 0 else 1.0)
+    if opts.local_params:
+        from .adapt import local_hausd_table
+
+        hausd = local_hausd_table(stacked, opts, hausd)
     h_in = quality.merge_stacked_histograms(
         jax.vmap(quality.quality_histogram)(stacked)
     )

@@ -33,6 +33,7 @@ import numpy as np
 from ..core import tags
 from ..core.mesh import FACE_VERTS, Mesh
 from ..core.adjacency import build_adjacency
+from . import common
 
 # default feature-detection dihedral angle, degrees (the reference's
 # angle-detection default forwarded to Mmg, `-ar` flag)
@@ -74,8 +75,6 @@ def _sorted3(v):
 def _missing_face_info(mesh: Mesh):
     """Open tet faces (adja<0) with no matching tria: returns
     (need [TC,4] bool, count scalar). Requires fresh adjacency."""
-    from . import common
-
     open_face = (mesh.adja < 0) & mesh.tmask[:, None]
     fverts = mesh.tet[:, jnp.asarray(FACE_VERTS)]           # [TC,4,3]
     fkeys = _sorted3(fverts).reshape(-1, 3)                 # [4TC,3]
@@ -89,19 +88,37 @@ def _missing_face_info(mesh: Mesh):
     return need, jnp.sum(need.astype(jnp.int32))
 
 
+def _jit_retry(fn, *args):
+    """Invoke a jitted fn, retrying once after `jax.clear_caches()` on
+    the jax-0.9.0 executable/buffer mismatch ("Executable expected
+    parameter N of size X but got buffer with incompatible size Y"):
+    a stale cached executable occasionally receives a misaligned
+    argument list on re-invocation (observed only on the CPU backend,
+    sequence-dependent). Clearing the executable cache and recompiling
+    always recovers; the retry keeps long-running CLI/library sessions
+    alive."""
+    try:
+        return fn(*args)
+    except ValueError as e:
+        if "Executable expected parameter" not in str(e):
+            raise
+        jax.clear_caches()
+        return fn(*args)
+
+
 def synthesize_boundary_trias(mesh: Mesh) -> Mesh:
     """Append a boundary tria for every open tet face that has none —
     the role of Mmg's boundary-triangle completion inside `MMG3D_analys`
     (chkBdryTria). FACE_VERTS ordering makes the appended trias outward
     oriented. Host-growth of fcap when needed."""
-    need, cnt = _missing_face_info(mesh)
+    need, cnt = _jit_retry(_missing_face_info, mesh)
     n_need = int(cnt)
     if n_need == 0:
         return mesh
     nf0 = int(mesh.ntria)
     if nf0 + n_need > mesh.fcap:
         mesh = mesh.with_capacity(fcap=int((nf0 + n_need) * 1.3) + 8)
-        need, _ = _missing_face_info(mesh)
+        need, _ = _jit_retry(_missing_face_info, mesh)
     return _append_trias(mesh, need)
 
 
@@ -151,8 +168,6 @@ def tria_normals(mesh: Mesh):
        normals.
     Trias with no owner tet keep their stored winding.
     """
-    from . import common
-
     smask = surf_tria_mask(mesh)
     p0 = mesh.vert[mesh.tria[:, 0]]
     p1 = mesh.vert[mesh.tria[:, 1]]
@@ -194,8 +209,6 @@ def vertex_normals(mesh: Mesh) -> jax.Array:
     unit, area, ok = tria_normals(mesh)
     pcap = mesh.pcap
     w = jnp.where(ok, area, 0.0)
-    from . import common
-
     contrib = unit * w[:, None]
     acc = jnp.zeros((pcap, 3), mesh.vert.dtype)
     idx = jnp.where(ok[:, None], mesh.tria, pcap)
@@ -225,8 +238,6 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
     fcap = mesh.fcap
     unit, _, ok = tria_normals(mesh)
 
-    from . import common
-
     t = mesh.tria
     pairs = jnp.stack([t[:, [0, 1]], t[:, [1, 2]], t[:, [0, 2]]], axis=1)
     lo = jnp.minimum(pairs[..., 0], pairs[..., 1]).reshape(-1)
@@ -236,11 +247,9 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
     order, newgrp, live_sorted, slo, shi = common.sorted_pair_groups(
         lo, hi, dead, mesh.pcap
     )
-    gid = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
-    cnt_g = jnp.zeros(n3, jnp.int32).at[gid].add(
-        live_sorted.astype(jnp.int32)
+    cnt = common.seg_broadcast(
+        live_sorted.astype(jnp.int32), newgrp, jnp.add, 0
     )
-    cnt = cnt_g[gid]
     # manifold partner: runs of exactly 2
     eq_next = jnp.concatenate([newgrp[1:] == False, jnp.zeros(1, bool)])  # noqa: E712
     eq_prev = jnp.concatenate([jnp.zeros(1, bool), eq_next[:-1]])
@@ -289,14 +298,11 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
         etag_sorted | tags.NOM | tags.REQUIRED, etag_sorted,
     )
     # group tag = OR over members (a fan member's partner-less slots share
-    # the group verdict through the segment reduction)
-    gtag = jnp.zeros(n3, jnp.int32)
-    for bit in (tags.RIDGE, tags.REF, tags.NOM, tags.REQUIRED):
-        hasbit = jnp.zeros(n3, bool).at[gid].max(
-            (etag_sorted & bit) != 0
-        )
-        gtag = gtag | jnp.where(hasbit, bit, 0)
-    etag_g = gtag[gid]
+    # the group verdict through the segment reduction) — ONE segmented
+    # bitwise-OR scan instead of a scatter+gather round per tag bit
+    etag_g = common.seg_broadcast(
+        etag_sorted, newgrp, jnp.bitwise_or, 0
+    )
 
     first = jnp.zeros(n3, bool).at[order].set(newgrp & live_sorted,
                                               unique_indices=True)
@@ -313,8 +319,6 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
 def _merge_info(mesh: Mesh, first, prs, etag):
     """Which detected feature edges are new vs already stored; returns
     (new_sel [3FC] bool, n_new, match [3FC] idx into mesh.edge or -1)."""
-    from . import common
-
     elo = jnp.minimum(mesh.edge[:, 0], mesh.edge[:, 1])
     ehi = jnp.maximum(mesh.edge[:, 0], mesh.edge[:, 1])
     ekeys = jnp.stack(
@@ -500,6 +504,108 @@ def cross_shard_features(
             m = _merge_host_edges(m, arr[:, :2], arr[:, 2])
             m = classify_corners(m, cos_ang=cos_ang)
         out.append(m)
+    return cross_shard_singul(out, cos_ang)
+
+
+def cross_shard_singul(shards: list, cos_ang: float) -> list:
+    """Singularity classification of parallel points with *global* feature
+    counts — the `PMMG_singul` role (reference `src/analys_pmmg.c:1679`).
+
+    Per-shard `classify_corners` counts only the locally-visible feature
+    edges: a feature line crossing the interface at a vertex looks like a
+    line END (deg 1) on both sides and gets spuriously CORNER-frozen.
+    Here the feature-edge degree and direction sum at every interface
+    vertex are reduced over all shards — PARBDY-PARBDY edges (replicated
+    per side) deduplicated by global-id key — and the corner rule is
+    re-evaluated on the global counts. Input-REQUIRED corners are never
+    unset. (Cross-shard vertex-NORMAL agreement, the `hashNorver` loop at
+    `src/analys_pmmg.c:199-1386`, is obviated: PARBDY endpoints force
+    linear midpoints in split and are IMMOVABLE in smoothing — the same
+    no-surface-op discipline the reference enforces via MG_NOSURF.)"""
+    feature = tags.RIDGE | tags.REF | tags.NOM
+    gids_all = []
+    dirs_all = []
+    seen_pp = np.empty(0, np.int64)
+    for m in shards:
+        ed = np.asarray(m.edge)
+        live = np.asarray(m.edmask) & (
+            (np.asarray(m.edtag) & feature) != 0
+        )
+        if not live.any():
+            continue
+        e = ed[live]
+        vt = np.asarray(m.vtag)
+        vg = np.asarray(m.vglob)
+        v = np.asarray(m.vert)
+        a, b = e[:, 0], e[:, 1]
+        d = v[b] - v[a]
+        u = d / np.maximum(np.linalg.norm(d, axis=1), 1e-30)[:, None]
+        par_a = ((vt[a] & tags.PARBDY) != 0) & (vg[a] >= 0)
+        par_b = ((vt[b] & tags.PARBDY) != 0) & (vg[b] >= 0)
+        both = par_a & par_b
+        # replicated interface edges: count each global key once
+        # (vectorized dedup: unique within the shard, isin against the
+        # accumulated key array)
+        if both.any():
+            ga, gb = vg[a[both]], vg[b[both]]
+            glo, ghi = np.minimum(ga, gb), np.maximum(ga, gb)
+            keys = glo.astype(np.int64) * (2**31) + ghi
+            _, first = np.unique(keys, return_index=True)
+            fresh = np.zeros(len(keys), bool)
+            fresh[first] = True
+            fresh &= ~np.isin(keys, seen_pp)
+            seen_pp = np.concatenate([seen_pp, keys[fresh]])
+            ub = u[both][fresh]
+            gids_all.append(vg[a[both]][fresh])
+            dirs_all.append(ub)
+            gids_all.append(vg[b[both]][fresh])
+            dirs_all.append(-ub)
+        only_a = par_a & ~both
+        only_b = par_b & ~both
+        if only_a.any():
+            gids_all.append(vg[a[only_a]])
+            dirs_all.append(u[only_a])
+        if only_b.any():
+            gids_all.append(vg[b[only_b]])
+            dirs_all.append(-u[only_b])
+
+    if not gids_all:
+        return shards
+    gids = np.concatenate(gids_all)
+    dirs = np.concatenate(dirs_all)
+    ug, inv = np.unique(gids, return_inverse=True)
+    deg = np.bincount(inv, minlength=len(ug))
+    acc = np.zeros((len(ug), 3))
+    np.add.at(acc, inv, dirs)
+    bend2 = np.sum(acc * acc, axis=1)
+    sharp = bend2 > (2.0 - 2.0 * cos_ang)
+    corner_g = (deg == 1) | (deg >= 3) | ((deg == 2) & sharp)
+    gmax = int(ug.max()) + 1
+    is_corner = np.zeros(gmax, bool)
+    is_corner[ug] = corner_g
+    has_feat = np.zeros(gmax, bool)
+    has_feat[ug] = True
+
+    out = []
+    for m in shards:
+        vt = np.asarray(m.vtag).copy()
+        vg = np.asarray(m.vglob)
+        sel = (
+            ((vt & tags.PARBDY) != 0)
+            & (vg >= 0)
+            & (vg < gmax)
+            & np.asarray(m.vmask)
+        )
+        gsel = np.clip(vg, 0, gmax - 1)
+        want = sel & is_corner[gsel]
+        # clear locally-guessed corners on interface feature vertices
+        # (never user-required ones), then set the agreed ones
+        clear = (
+            sel & has_feat[gsel] & ~want & ((vt & tags.REQUIRED) == 0)
+        )
+        vt[clear] &= ~tags.CORNER
+        vt[want] |= tags.CORNER | tags.BDY
+        out.append(m.replace(vtag=jnp.asarray(vt)))
     return out
 
 

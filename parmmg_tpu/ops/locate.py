@@ -178,6 +178,86 @@ def exhaustive_locate(mesh: Mesh, pts: jax.Array, tchunk: int = 1024):
     return best_i, clamp_bary(lam)
 
 
+def tria_barycoords(c: jax.Array, p: jax.Array) -> jax.Array:
+    """Barycentric coords of the projection of p onto the tria plane.
+
+    c: [...,3,3] tria vertex coords, p: [...,3] -> [...,3] coords summing
+    to 1 (the 2D projected path of the reference,
+    `PMMG_barycoord2d_compute`, `src/barycoord_pmmg.c:135-237`)."""
+    a, b, d = c[..., 0, :], c[..., 1, :], c[..., 2, :]
+    v0 = b - a
+    v1 = d - a
+    v2 = p - a
+    d00 = jnp.einsum("...i,...i->...", v0, v0)
+    d01 = jnp.einsum("...i,...i->...", v0, v1)
+    d11 = jnp.einsum("...i,...i->...", v1, v1)
+    d20 = jnp.einsum("...i,...i->...", v2, v0)
+    d21 = jnp.einsum("...i,...i->...", v2, v1)
+    denom = d00 * d11 - d01 * d01
+    tiny = jnp.asarray(jnp.finfo(p.dtype).tiny, p.dtype)
+    denom = jnp.where(jnp.abs(denom) > tiny, denom, tiny)
+    lv = (d11 * d20 - d01 * d21) / denom
+    lw = (d00 * d21 - d01 * d20) / denom
+    return jnp.stack([1.0 - lv - lw, lv, lw], axis=-1)
+
+
+class BdyLocateResult(NamedTuple):
+    tria: jax.Array   # [Q] int32 best surface-tria slot
+    bary: jax.Array   # [Q,3] clamped barycentric coords on that tria
+    dist: jax.Array   # [Q] distance to the closest point used
+
+
+@partial(jax.jit, static_argnames=("window",))
+def bdy_locate(
+    mesh: Mesh, surf_mask: jax.Array, pts: jax.Array, window: int = 32
+) -> BdyLocateResult:
+    """Locate boundary points on the boundary triangulation — the
+    `PMMG_locatePointBdy` role (reference `src/locate_pmmg.c:587`).
+
+    Instead of the reference's serial tria walk with cone/wedge
+    classification, every query scans a `window` of surface trias around
+    its position in a Morton order of tria barycenters and keeps the one
+    whose (clamped-barycentric) closest point is nearest — a batched
+    nearest-tria search with the same interpolation-source semantics.
+    Corner/ridge points are REQUIRED and copied, not interpolated, so the
+    vertex/edge cone-wedge cases of the reference do not arise here."""
+    bc3 = jnp.mean(mesh.vert[mesh.tria], axis=1)  # [F,3]
+    lo = jnp.min(jnp.where(surf_mask[:, None], bc3, jnp.inf), axis=0)
+    hi = jnp.max(jnp.where(surf_mask[:, None], bc3, -jnp.inf), axis=0)
+    keys = sfc.morton_keys(bc3, lo, hi)
+    keys = jnp.where(surf_mask, keys, jnp.int32(2**30))
+    order = jnp.argsort(keys).astype(jnp.int32)
+    skeys = keys[order]
+    nlive = jnp.sum(surf_mask.astype(jnp.int32))
+    qkeys = sfc.morton_keys(pts, lo, hi)
+    pos = jnp.searchsorted(skeys, qkeys).astype(jnp.int32)
+
+    offs = jnp.arange(-window // 2, window - window // 2, dtype=jnp.int32)
+    cand_pos = jnp.clip(pos[:, None] + offs[None, :], 0,
+                        jnp.maximum(nlive - 1, 0))  # [Q,W]
+    cand = order[cand_pos]                           # [Q,W] tria slots
+    c = mesh.vert[mesh.tria[cand]]                   # [Q,W,3,3]
+    lam = clamp_bary(tria_barycoords(c, pts[:, None, :]))
+    closest = jnp.einsum("qwk,qwki->qwi", lam, c)
+    dist = jnp.linalg.norm(closest - pts[:, None, :], axis=-1)
+    dist = jnp.where(surf_mask[cand], dist, jnp.inf)
+    k = jnp.argmin(dist, axis=-1)
+    qi = jnp.arange(pts.shape[0])
+    return BdyLocateResult(cand[qi, k], lam[qi, k], dist[qi, k])
+
+
+def bucketed_fail_idx(fail_idx):
+    """Pad a failed-query index list to a power-of-2 bucket so the
+    exhaustive kernel compiles for few distinct shapes. Shared by every
+    exhaustive-fallback site."""
+    import numpy as np
+
+    bucket = max(8, 1 << (len(fail_idx) - 1).bit_length())
+    pad_idx = np.zeros(bucket, np.int32)
+    pad_idx[: len(fail_idx)] = fail_idx
+    return pad_idx
+
+
 def locate_points(
     mesh: Mesh,
     pts: jax.Array,
@@ -195,12 +275,9 @@ def locate_points(
     if fallback and not found_np.all():
         import numpy as np
 
-        # compact the failed subset on host and pad to a power-of-2 bucket so
-        # the exhaustive kernel compiles for few shapes
+        # compact the failed subset on host
         fail_idx = np.nonzero(~found_np)[0]
-        bucket = max(8, 1 << (len(fail_idx) - 1).bit_length())
-        pad_idx = np.zeros(bucket, np.int32)
-        pad_idx[: len(fail_idx)] = fail_idx
+        pad_idx = bucketed_fail_idx(fail_idx)
         fb_tet, fb_bary = exhaustive_locate(mesh, pts[jnp.asarray(pad_idx)])
         tet = res.tet.at[pad_idx[: len(fail_idx)]].set(fb_tet[: len(fail_idx)])
         bary = res.bary.at[pad_idx[: len(fail_idx)]].set(

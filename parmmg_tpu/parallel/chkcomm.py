@@ -79,13 +79,123 @@ def check_node_comm(
     )
 
 
+def check_face_edge_comm(stacked: Mesh, comm: ShardComm, dmesh) -> dict:
+    """Geometric face/edge-communicator invariants — the
+    `PMMG_check_extFaceComm` (barycenter agreement,
+    reference `src/chkcomm_pmmg.c:1027`) and `PMMG_check_extEdgeComm`
+    (midpoint agreement, `:605`) roles.
+
+    Interface trias (PARBDY|NOSURF) and interface feature edges are
+    replicated per shard and matched *by sorted global-vertex-id key*
+    across the all-gathered set: every pure-interface tria must appear on
+    exactly two shards, and every copy of a matched tria/edge must have
+    the same barycenter/midpoint. Returns dict(face_count_bad,
+    max_face_bc_err, max_edge_mid_err, edge_tag_mismatch).
+    """
+    from ..core import tags
+    from ..ops import common
+
+    def spread(rows, vals, valid, newgrp, order):
+        """Max per-group coordinate spread of `vals` over valid members
+        (rows pre-sorted by `order`, groups from `newgrp`)."""
+        n = rows.shape[0]
+        gid = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
+        sval = valid[order]
+        sv = vals[order]
+        hi = jnp.full((n, 3), -jnp.inf, sv.dtype).at[gid].max(
+            jnp.where(sval[:, None], sv, -jnp.inf)
+        )
+        lo = jnp.full((n, 3), jnp.inf, sv.dtype).at[gid].min(
+            jnp.where(sval[:, None], sv, jnp.inf)
+        )
+        d = jnp.where(jnp.isfinite(hi) & jnp.isfinite(lo), hi - lo, 0.0)
+        return jnp.max(d), gid, sval
+
+    def body(blk: Mesh, l2g_blk):
+        mesh = _squeeze(blk)
+        l2g = l2g_blk[0]
+        # --- interface trias, keyed by sorted global ids ----------------
+        pp = tags.pure_interface_tria(mesh.trtag) & mesh.trmask
+        g3 = jnp.sort(l2g[mesh.tria], axis=1)
+        g3 = jnp.where(pp[:, None], g3, -1)
+        bc = jnp.mean(mesh.vert[mesh.tria], axis=1)
+        G = jax.lax.all_gather(g3, AXIS).reshape(-1, 3)
+        B = jax.lax.all_gather(bc, AXIS).reshape(-1, 3)
+        V = jax.lax.all_gather(pp, AXIS).reshape(-1)
+        order, newgrp = common._row_order_groups(G, ~V, None)
+        face_err, gid, sval = spread(G, B, V, newgrp, order)
+        n = G.shape[0]
+        cnt = jnp.zeros(n, jnp.int32).at[gid].add(sval.astype(jnp.int32))
+        face_bad = jnp.sum((sval & (cnt[gid] != 2)).astype(jnp.int32))
+
+        # --- interface feature edges, keyed by sorted gid pairs ---------
+        par_v = (mesh.vtag & tags.PARBDY) != 0
+        e_ok = (
+            mesh.edmask
+            & par_v[jnp.clip(mesh.edge[:, 0], 0, mesh.pcap - 1)]
+            & par_v[jnp.clip(mesh.edge[:, 1], 0, mesh.pcap - 1)]
+        )
+        g2 = jnp.sort(l2g[mesh.edge], axis=1)
+        g2 = jnp.where(e_ok[:, None], g2, -1)
+        mid = jnp.mean(mesh.vert[mesh.edge], axis=1)
+        E = jax.lax.all_gather(g2, AXIS).reshape(-1, 2)
+        M = jax.lax.all_gather(mid, AXIS).reshape(-1, 3)
+        W = jax.lax.all_gather(e_ok, AXIS).reshape(-1)
+        T = jax.lax.all_gather(mesh.edtag, AXIS).reshape(-1)
+        order_e, newgrp_e = common._row_order_groups(E, ~W, None)
+        edge_err, gid_e, sval_e = spread(E, M, W, newgrp_e, order_e)
+        ne = E.shape[0]
+        # geometric feature bits must agree across copies (RIDGE/REF;
+        # parallel-discipline bits may legitimately differ per shard)
+        st = T[order_e] & (tags.RIDGE | tags.REF)
+        thi = jnp.zeros(ne, jnp.int32).at[gid_e].max(
+            jnp.where(sval_e, st, 0)
+        )
+        tlo = jnp.full(ne, 2**30, jnp.int32).at[gid_e].min(
+            jnp.where(sval_e, st, 2**30)
+        )
+        tag_mm = jnp.sum(
+            (sval_e & (thi[gid_e] != jnp.where(
+                tlo[gid_e] == 2**30, thi[gid_e], tlo[gid_e]
+            ))).astype(jnp.int32)
+        )
+        # every shard computed the same global answer; pmax just folds
+        return (
+            jax.lax.pmax(face_err, AXIS),
+            jax.lax.pmax(face_bad, AXIS),
+            jax.lax.pmax(edge_err, AXIS),
+            jax.lax.pmax(tag_mm, AXIS),
+        )
+
+    f = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=dmesh,
+            in_specs=(P(AXIS), P(AXIS)),
+            out_specs=(P(), P(), P(), P()),
+        )
+    )
+    face_err, face_bad, edge_err, tag_mm = f(stacked, comm.l2g)
+    return dict(
+        max_face_bc_err=float(face_err),
+        face_count_bad=int(face_bad),
+        max_edge_mid_err=float(edge_err),
+        edge_tag_mismatch=int(tag_mm),
+    )
+
+
 def assert_comm_ok(stacked, comm, dmesh, tol: float = 1e-12):
     rep = check_node_comm(stacked, comm, dmesh)
+    rep.update(check_face_edge_comm(stacked, comm, dmesh))
     ok = (
         rep["max_coord_err"] <= tol
         and rep["gid_mismatch"] == 0
         and rep["count_mismatch"] == 0
         and rep["valid_mismatch"] == 0
+        and rep["max_face_bc_err"] <= tol
+        and rep["face_count_bad"] == 0
+        and rep["max_edge_mid_err"] <= tol
+        and rep["edge_tag_mismatch"] == 0
     )
     if not ok:
         raise AssertionError(f"communicator check failed: {rep}")

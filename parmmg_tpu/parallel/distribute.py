@@ -20,6 +20,7 @@ pack/unpack, no MPI datatypes.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import List, Tuple
 
 import jax
@@ -289,6 +290,67 @@ def _pow2_at_least(n: int) -> int:
     return p
 
 
+_GID_INF = np.int32(2**31 - 1)
+
+
+@partial(jax.jit, static_argnames=("kv", "icap"))
+def _rebuild_comm_device(vglob, vmask, vtag, kv: int, icap: int):
+    """Device core of `rebuild_comm`: per-pair sorted-gid intersections
+    into fixed [D,D,icap] tables. `kv` bounds the per-shard interface
+    list, `icap` the per-pair shared list (both static; the host wrapper
+    sizes them and retries on overflow)."""
+    D, PC = vglob.shape
+    par = vmask & (vglob >= 0) & ((vtag & tags.PARBDY) != 0)
+    key = jnp.where(par, vglob, _GID_INF)
+    order = jnp.argsort(key, axis=1)[:, :kv].astype(jnp.int32)  # [D,kv]
+    gids = jnp.take_along_axis(key, order, axis=1)              # sorted
+    valid = gids < _GID_INF
+    nv = jnp.sum(par.astype(jnp.int32), axis=1)                 # [D]
+
+    # pairwise membership: for (s,r), is gids[s,k] present in gids[r]?
+    def member(g_s, v_s, g_r):
+        pos = jnp.searchsorted(g_r, g_s).astype(jnp.int32)
+        pos = jnp.clip(pos, 0, kv - 1)
+        return v_s & (g_r[pos] == g_s)
+
+    hit = jax.vmap(  # [D,D,kv]: hit[s,r,k]
+        lambda g_s, v_s: jax.vmap(lambda g_r: member(g_s, v_s, g_r))(gids),
+        in_axes=(0, 0),
+    )(gids, valid)
+    # a shard never communicates with itself
+    eye = jnp.eye(D, dtype=bool)
+    hit = hit & ~eye[:, :, None]
+    counts = jnp.sum(hit.astype(jnp.int32), axis=2)             # [D,D]
+
+    # pack each pair's hits (already in ascending-gid order, so both
+    # sides of a pair produce the same k-ordering) into icap slots
+    rank = jnp.cumsum(hit.astype(jnp.int32), axis=2) - 1
+    slots_b = jnp.broadcast_to(order[:, None, :], (D, D, kv))
+
+    def pack(hit_row, rank_row, slot_row):
+        tgt = jnp.where(hit_row & (rank_row < icap), rank_row, icap)
+        return jnp.full(icap + 1, -1, jnp.int32).at[tgt].set(
+            slot_row, mode="drop"
+        )[:icap]
+
+    comm_idx = jax.vmap(jax.vmap(pack))(hit, rank, slots_b)     # [D,D,icap]
+
+    # owner = lowest shard holding the gid (PMMG_count_nodes_par role)
+    lower = jnp.tril(jnp.ones((D, D), bool), k=-1)              # r < s
+    held_lower = jnp.any(hit & lower[:, :, None], axis=1)       # [D,kv]
+    own_list = valid & ~held_lower
+
+    def scat_owner(base, slot_row, val_row, v_row):
+        idx = jnp.where(v_row, slot_row, PC)
+        return base.at[idx].set(val_row, mode="drop")
+
+    owner = jax.vmap(scat_owner)(vmask, order, own_list, valid)
+    l2g = jnp.where(vmask, vglob, -1)
+    need = jnp.max(counts)
+    kv_need = jnp.max(nv)
+    return comm_idx, counts, l2g, owner, need, kv_need
+
+
 def rebuild_comm(stacked: Mesh, icap: int | None = None) -> ShardComm:
     """(Re-)derive `ShardComm` node tables from `Mesh.vglob`.
 
@@ -298,68 +360,62 @@ def rebuild_comm(stacked: Mesh, icap: int | None = None) -> ShardComm:
     their global ids through `compact()`, so the shared list of each shard
     pair is the gid-intersection of PARBDY vertices — sorted by gid,
     giving identical k-ordering on both sides (the invariant
-    `parallel/comm.py` halo exchange relies on). Host-side: tables are
-    static inputs rebuilt once per outer iteration.
+    `parallel/comm.py` halo exchange relies on). The intersection runs
+    on device (`_rebuild_comm_device`); the host only sizes the static
+    table capacities and checks for overflow (one scalar readback per
+    rebuild instead of fetching the whole vertex table).
     """
-    vglob = np.asarray(stacked.vglob)
-    vmask = np.asarray(stacked.vmask)
-    vtag = np.asarray(stacked.vtag)
-    D, PC = vglob.shape
-
-    par = vmask & (vglob >= 0) & ((vtag & tags.PARBDY) != 0)
-    slot_lists = [np.nonzero(par[s])[0] for s in range(D)]
-    gid_lists = [vglob[s][slot_lists[s]] for s in range(D)]
-    for s in range(D):
-        o = np.argsort(gid_lists[s])
-        gid_lists[s] = gid_lists[s][o]
-        slot_lists[s] = slot_lists[s][o]
-
-    pair_shared = {}
-    need = 1
-    for s in range(D):
-        for r in range(s + 1, D):
-            shared = np.intersect1d(gid_lists[s], gid_lists[r])
-            if len(shared):
-                pair_shared[(s, r)] = shared
-                need = max(need, len(shared))
-    if icap is None:
-        icap = _pow2_at_least(need)
-    elif need > icap:
-        raise ValueError(f"icap {icap} < largest shared list {need}")
-
-    comm_idx = np.full((D, D, icap), -1, np.int32)
-    counts = np.zeros((D, D), np.int32)
-    for (s, r), shared in pair_shared.items():
-        ls_idx = slot_lists[s][np.searchsorted(gid_lists[s], shared)]
-        lr_idx = slot_lists[r][np.searchsorted(gid_lists[r], shared)]
-        comm_idx[s, r, : len(shared)] = ls_idx
-        comm_idx[r, s, : len(shared)] = lr_idx
-        counts[s, r] = counts[r, s] = len(shared)
-
-    # owner = lowest shard holding the gid (PMMG_count_nodes_par dedup role)
-    owner = vmask.copy()
-    if pair_shared:
-        all_g = np.concatenate(gid_lists)
-        all_s = np.concatenate(
-            [np.full(len(g), s) for s, g in enumerate(gid_lists)]
+    D, PC = stacked.vglob.shape
+    par_counts = jnp.sum(
+        (stacked.vmask & (stacked.vglob >= 0)
+         & ((stacked.vtag & tags.PARBDY) != 0)).astype(jnp.int32),
+        axis=1,
+    )
+    kv = _pow2_at_least(max(int(jnp.max(par_counts)), 1))
+    kv = min(kv, PC)
+    want_icap = icap
+    while True:
+        use_icap = want_icap if want_icap is not None else kv
+        comm_idx, counts, l2g, owner, need, _ = _rebuild_comm_device(
+            stacked.vglob, stacked.vmask, stacked.vtag, kv, use_icap
         )
-        min_owner = np.full(all_g.max() + 1, D, np.int64)
-        np.minimum.at(min_owner, all_g, all_s)
-        for s in range(D):
-            sl = slot_lists[s]
-            owner[s, sl] = min_owner[gid_lists[s]] == s
-
+        need = int(need)
+        if need <= use_icap:
+            break
+        if want_icap is not None:
+            raise ValueError(f"icap {want_icap} < largest shared list {need}")
+        want_icap = _pow2_at_least(need)
+    if icap is None:
+        # size the tables to the largest PAIR list, not the per-shard
+        # total: kv over-pads every later halo exchange (a shard's
+        # interface is split among all its neighbors)
+        tight = _pow2_at_least(max(need, 1))
+        if tight < use_icap:
+            comm_idx, counts, l2g, owner, _, _ = _rebuild_comm_device(
+                stacked.vglob, stacked.vmask, stacked.vtag, kv, tight
+            )
     return ShardComm(
-        comm_idx=jnp.asarray(comm_idx),
-        counts=jnp.asarray(counts),
-        l2g=jnp.asarray(np.where(vmask, vglob, -1)),
-        owner=jnp.asarray(owner),
+        comm_idx=comm_idx, counts=counts, l2g=l2g, owner=owner
+    )
+
+
+@partial(jax.jit, donate_argnums=0)
+def _assign_gids_device(stacked: Mesh) -> Mesh:
+    vglob, vmask = stacked.vglob, stacked.vmask
+    new = vmask & (vglob < 0)
+    base = jnp.max(jnp.where(vmask & (vglob >= 0), vglob, -1)) + 1
+    counts = jnp.sum(new.astype(jnp.int32), axis=1)
+    offs = base + jnp.cumsum(counts) - counts        # exclusive scan
+    rank = jnp.cumsum(new.astype(jnp.int32), axis=1) - 1
+    newid = offs[:, None] + rank
+    return stacked.replace(
+        vglob=jnp.where(new, newid.astype(jnp.int32), vglob)
     )
 
 
 def assign_global_ids(stacked: Mesh) -> Mesh:
     """Give remeshing-created vertices (vglob == -1) fresh contiguous
-    global ids.
+    global ids — on device.
 
     The reference numbers output vertices owner-first across ranks
     (`PMMG_Compute_verticesGloNum`, `src/libparmmg.c:923`) — here every
@@ -367,17 +423,7 @@ def assign_global_ids(stacked: Mesh) -> Mesh:
     so numbering is an exclusive scan of per-shard new-vertex counts on
     top of the current global max; no halo agreement is required.
     """
-    vglob = np.asarray(stacked.vglob).copy()
-    vmask = np.asarray(stacked.vmask)
-    D = vglob.shape[0]
-    new = vmask & (vglob < 0)
-    base = int(vglob.max()) + 1 if (vglob >= 0).any() else 0
-    counts = new.sum(axis=1)
-    offs = base + np.concatenate([[0], np.cumsum(counts)[:-1]])
-    for s in range(D):
-        idx = np.nonzero(new[s])[0]
-        vglob[s, idx] = offs[s] + np.arange(len(idx))
-    return stacked.replace(vglob=jnp.asarray(vglob))
+    return _assign_gids_device(stacked)
 
 
 def stack_loaded_shards(

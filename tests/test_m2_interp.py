@@ -157,3 +157,71 @@ def test_locate_after_adapt(cube_mesh_path):
     new, res = interp.interp_metrics_and_fields(new, old)
     met = np.asarray(new.met)[np.asarray(new.vmask)]
     np.testing.assert_allclose(met, 0.3, atol=1e-9)
+
+
+def test_surface_locate_and_interp_beats_volume_path():
+    """`PMMG_locatePointBdy` role (reference `src/locate_pmmg.c:587`):
+    interpolating a *surface* metric for boundary points from the old
+    boundary triangulation must not be polluted by interior values the
+    way the volume walk is."""
+    from parmmg_tpu.core import tags
+    from parmmg_tpu.ops import analysis
+
+    old = gen.unit_cube_mesh(4, dtype=jnp.float64)
+    old = analysis.mark_boundary(old)
+    # metric: 0.1 on the boundary, 0.4 inside
+    bdy_v = (np.asarray(old.vtag) & tags.BDY) != 0
+    met = np.full((old.pcap, 1), 0.4)
+    met[bdy_v] = 0.1
+    old = old.replace(met=jnp.asarray(met), met_set=True)
+
+    # query points: tria barycenters nudged INWARD — the situation on a
+    # curved surface, where a refined boundary vertex lies inside the
+    # old polyhedral boundary
+    smask = analysis.surf_tria_mask(old)
+    sm_np = np.asarray(smask)
+    tr = np.asarray(old.tria)[sm_np]
+    bc = np.asarray(old.vert)[tr].mean(axis=1)
+    nrm, _, _ = analysis.tria_normals(old)
+    nrm = np.asarray(nrm)[sm_np]
+    delta = 0.05
+    pts = jnp.asarray(bc - delta * nrm)
+
+    # volume path
+    res = locate.locate_points(old, pts)
+    met_v, _, _, _ = interp.interp_at(old, res.tet, res.bary)
+    # surface path
+    bres = locate.bdy_locate(old, smask, pts)
+    met_s, _, _, _ = interp.interp_at_tria(old, bres.tria, bres.bary)
+
+    err_v = np.abs(np.asarray(met_v)[:, 0] - 0.1)
+    err_s = np.abs(np.asarray(met_s)[:, 0] - 0.1)
+    assert err_s.max() < 1e-12          # exact: all 3 sources on surface
+    assert err_v.max() > 0.01           # volume blends interior 0.4
+    # the nearest surface point is the barycenter delta away
+    d = np.asarray(bres.dist)
+    assert np.allclose(d, delta, atol=1e-6)
+
+
+def test_interp_dispatch_uses_surface_for_bdy_vertices():
+    """interp_metrics_and_fields routes BDY-tagged vertices through the
+    boundary triangulation (`src/interpmesh_pmmg.c:535-643` dispatch)."""
+    from parmmg_tpu.core import tags
+    from parmmg_tpu.ops import analysis
+
+    old = gen.unit_cube_mesh(3, dtype=jnp.float64)
+    old = analysis.mark_boundary(old)
+    bdy_v = (np.asarray(old.vtag) & tags.BDY) != 0
+    met = np.full((old.pcap, 1), 0.4)
+    met[bdy_v] = 0.1
+    old = old.replace(met=jnp.asarray(met), met_set=True)
+
+    # "new" mesh: same geometry, shifted boundary queries via a finer cube
+    new = gen.unit_cube_mesh(5, dtype=jnp.float64)
+    new = analysis.mark_boundary(new)
+    new, _ = interp.interp_metrics_and_fields(new, old)
+    met_n = np.asarray(new.met)[:, 0]
+    nb = (np.asarray(new.vtag) & tags.BDY) != 0
+    nreq = (np.asarray(new.vtag) & tags.REQUIRED) == 0
+    sel = nb & nreq & np.asarray(new.vmask)
+    assert np.abs(met_n[sel] - 0.1).max() < 1e-9

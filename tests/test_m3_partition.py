@@ -312,3 +312,41 @@ def test_parbdybdy_tria_roundtrip():
     assert btag[i] & (tags.REQUIRED | tags.NOSURF | tags.PARBDY | tags.PARBDYBDY) == 0
     assert btag[i] & tags.BDY
     assert int(back.ntria) == ntr0 + 1
+
+
+def test_chkcomm_face_edge_invariants(sharded, dmesh):
+    """Face/edge-communicator geometric checks pass on a clean split
+    (`PMMG_check_extFaceComm` / `_extEdgeComm` roles, reference
+    `src/chkcomm_pmmg.c:1027,605`)."""
+    stacked, c = sharded
+    st = shard.put_sharded(stacked, dmesh)
+    rep = chkcomm.check_face_edge_comm(st, c, dmesh)
+    assert rep["face_count_bad"] == 0
+    assert rep["max_face_bc_err"] <= 1e-12
+    assert rep["max_edge_mid_err"] <= 1e-12
+    assert rep["edge_tag_mismatch"] == 0
+
+
+def test_chkcomm_detects_face_corruption(sharded, dmesh):
+    """A displaced interface-tria copy must trip the barycenter check."""
+    stacked, c = sharded
+    trtag0 = np.asarray(stacked.trtag)[0]
+    trmask0 = np.asarray(stacked.trmask)[0]
+    pp = (
+        ((trtag0 & tags.PARBDY) != 0)
+        & ((trtag0 & tags.NOSURF) != 0)
+        & ((trtag0 & tags.PARBDYBDY) == 0)
+        & trmask0
+    )
+    f = np.nonzero(pp)[0]
+    assert len(f)
+    # move one vertex of one interface tria on shard 0 only — its copy on
+    # the peer shard keeps the true position, so the two barycenters split
+    tri = np.asarray(stacked.tria)[0, f[0]]
+    v = np.asarray(stacked.vert).copy()
+    v[0, tri[0]] += 0.2
+    bad = stacked.replace(vert=jnp.asarray(v))
+    rep = chkcomm.check_face_edge_comm(
+        shard.put_sharded(bad, dmesh), c, dmesh
+    )
+    assert rep["max_face_bc_err"] > 0.01

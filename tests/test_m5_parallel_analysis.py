@@ -74,3 +74,34 @@ def test_cross_shard_noop_on_smooth_sphere():
     shards = [analysis.analyze(m) for m in unstack_mesh(stacked)]
     shards = analysis.cross_shard_features(shards)
     assert len(ridge_gid_pairs(shards)) == 0
+
+
+def test_cross_shard_singul_no_spurious_corner():
+    """`PMMG_singul` role (reference `src/analys_pmmg.c:1679`): a ridge
+    line crossing the interface transversally looks like a line END
+    (local degree 1) on each side; the global classification must NOT
+    freeze the crossing vertex as a corner."""
+    n = 4
+    mesh = unit_cube_mesh(n)
+    tm = np.asarray(mesh.tmask)
+    bary = np.asarray(mesh.vert)[np.asarray(mesh.tet)].mean(axis=1)
+    part = np.where(bary[:, 0] > 0.5, 1, 0)  # split plane x=0.5
+    part[~tm] = -1
+    stacked, comm = split_mesh(mesh, part, 2)
+    shards = [analysis.analyze(m) for m in unstack_mesh(stacked)]
+    shards = analysis.cross_shard_features(shards)
+
+    # globally exactly the 8 cube corners — in particular NOT the points
+    # where the 4 x-direction cube edges pierce the x=0.5 interface
+    corners = {}
+    for m in shards:
+        vt = np.asarray(m.vtag)
+        vm = np.asarray(m.vmask)
+        vg = np.asarray(m.vglob)
+        v = np.asarray(m.vert)
+        for i in np.nonzero(vm & ((vt & tags.CORNER) != 0))[0]:
+            corners[int(vg[i])] = v[i]
+    pos = np.array(list(corners.values()))
+    assert len(corners) == 8, pos
+    # every corner is a true cube corner (all coords in {0,1})
+    assert np.all(np.isin(np.round(pos, 6), [0.0, 1.0]))

@@ -253,3 +253,241 @@ def test_aniso_adapt_converges():
     e = p[ev[:, 1]] - p[ev[:, 0]]
     span = np.abs(e)
     assert span[:, 1].mean() < 0.8 * span[:, 0].mean()
+
+
+def test_cli_fields_roundtrip(tmp_path):
+    """-field end-to-end: load a solution field, interpolate it through
+    adaptation, save `<out>.fields.sol` (the reference CI field family,
+    `pmmg_tests.cmake:215-241` + `src/parmmg.c:292-433`)."""
+    from parmmg_tpu.__main__ import main
+    from parmmg_tpu.core.mesh import Mesh
+    from parmmg_tpu.io import medit
+
+    raw = unit_cube(2)
+    src = str(tmp_path / "cube.mesh")
+    medit.save_mesh(Mesh.from_numpy(
+        raw["verts"], raw["tets"], trias=raw["trias"],
+        trrefs=raw["trrefs"]), src)
+    # scalar field = x coordinate (linear: midpoint interpolation exact)
+    # plus a constant 3-vector field
+    fld = str(tmp_path / "phys.sol")
+    vals = np.concatenate(
+        [raw["verts"][:, :1],
+         np.tile([1.0, 2.0, 3.0], (len(raw["verts"]), 1))], axis=1,
+    )
+    medit.save_sol(fld, vals, [medit.SOL_SCALAR, medit.SOL_VECTOR])
+    out = str(tmp_path / "cube.o.mesh")
+    rc = main([src, "-hsiz", "0.3", "-niter", "1", "-v", "0",
+               "-field", fld, "-out", out])
+    assert rc == 0
+    fout = str(tmp_path / "cube.o.fields.sol")
+    assert os.path.exists(fout)
+    fvals, ftypes = medit.read_sol(fout)
+    assert ftypes == [medit.SOL_SCALAR, medit.SOL_VECTOR]
+    m = medit.load_mesh(out)
+    d = m.to_numpy()
+    assert fvals.shape[0] == d["verts"].shape[0]
+    # the x-coordinate field tracks the vertices through remeshing
+    assert np.abs(fvals[:, 0] - d["verts"][:, 0]).max() < 1e-3
+    assert np.allclose(fvals[:, 1:4], [1.0, 2.0, 3.0], atol=1e-6)
+
+
+def test_cli_val_and_noout(tmp_path, capsys):
+    from parmmg_tpu.__main__ import main
+    from parmmg_tpu.core.mesh import Mesh
+    from parmmg_tpu.io import medit
+
+    assert main(["-val"]) == 0
+    assert "Default parameters" in capsys.readouterr().out
+
+    raw = unit_cube(2)
+    src = str(tmp_path / "cube.mesh")
+    medit.save_mesh(Mesh.from_numpy(
+        raw["verts"], raw["tets"], trias=raw["trias"],
+        trrefs=raw["trrefs"]), src)
+    out = str(tmp_path / "cube.o.mesh")
+    rc = main([src, "-hsiz", "0.3", "-niter", "1", "-v", "0",
+               "-noout", "-out", out])
+    assert rc == 0
+    assert not os.path.exists(out)
+
+
+def test_implied_aniso_metric_unit_lengths():
+    """-A implied tensor: on a uniform mesh the LS fit must give ~unit
+    metric length to the existing edges (MMG3D_doSol_ani role)."""
+    from parmmg_tpu.core import metric as mm
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    m = unit_cube_mesh(3, perturb=0.15)
+    met = mm.implied_aniso_metric(m.vert, m.tet, m.tmask, m.pcap)
+    from parmmg_tpu.core.mesh import EDGE_VERTS
+
+    ev = np.asarray(m.tet)[np.asarray(m.tmask)][:, EDGE_VERTS].reshape(-1, 2)
+    p = np.asarray(m.vert)
+    l_m = np.asarray(mm.edge_length_aniso(
+        jnp.asarray(p[ev[:, 0]]), jnp.asarray(p[ev[:, 1]]),
+        jnp.asarray(np.asarray(met)[ev[:, 0]]),
+        jnp.asarray(np.asarray(met)[ev[:, 1]]),
+    ))
+    assert 0.6 < np.median(l_m) < 1.5
+    # SPD everywhere
+    det = np.asarray(mm.metric_det(met))[np.asarray(m.vmask)]
+    assert det.min() > 0
+
+
+def test_cli_aniso_flag(tmp_path):
+    """-A without a metric file adapts under the implied tensor metric."""
+    from parmmg_tpu.__main__ import main
+    from parmmg_tpu.core.mesh import Mesh
+    from parmmg_tpu.io import medit
+    from parmmg_tpu.utils import conformity
+
+    raw = unit_cube(3)
+    src = str(tmp_path / "cube.mesh")
+    medit.save_mesh(Mesh.from_numpy(
+        raw["verts"], raw["tets"], trias=raw["trias"],
+        trrefs=raw["trrefs"]), src)
+    out = str(tmp_path / "cube.o.mesh")
+    rc = main([src, "-A", "-niter", "1", "-v", "0", "-out", out])
+    assert rc == 0
+    m = medit.load_mesh(out)
+    rep = conformity.check_mesh(m)
+    assert rep.ok, str(rep)
+    # tensor metric written (9 columns per tensor line in medit = sym 6)
+    sol = str(tmp_path / "cube.o.sol")
+    vals, types = medit.read_sol(sol)
+    assert types == [medit.SOL_TENSOR]
+
+
+def test_parsop_local_params(tmp_path):
+    """parsop local parameters: per-reference hmin/hmax clamps and the
+    per-tria-ref hausd table (`PMMG_parsop`,
+    reference `src/libparmmg_tools.c:573`)."""
+    from parmmg_tpu.io import parsop
+    from parmmg_tpu.models.adapt import (
+        AdaptOptions, local_hausd_table, prepare_metric,
+    )
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    pf = tmp_path / "cube.mmg3d"
+    pf.write_text(
+        "Parameters\n2\n"
+        "1 Triangles 0.05 0.15 0.002\n"
+        "2 Triangles 0.05 0.5  0.02\n"
+    )
+    lps = parsop.parse_local_params(str(pf))
+    assert len(lps) == 2 and lps[0].elt == "triangle"
+    assert parsop.default_param_file(str(tmp_path / "cube.mesh")) == str(pf)
+
+    m = unit_cube_mesh(2)
+    opts = AdaptOptions(hsiz=0.4, local_params=lps, hgrad=None)
+    m2 = prepare_metric(m, opts, int(m.tcap * 1.7) + 64)
+    met = np.asarray(m2.met)[:, 0]
+    tr = np.asarray(m.tria)[np.asarray(m.trmask)]
+    trref = np.asarray(m.trref)[np.asarray(m.trmask)]
+    v_ref1 = np.unique(tr[trref == 1])
+    assert np.all(met[v_ref1] <= 0.15 + 1e-12)
+    # vertices on no local-param face keep the global size
+    on_face = np.zeros(m.pcap, bool)
+    on_face[np.unique(tr[(trref == 1) | (trref == 2)])] = True
+    free = np.asarray(m.vmask) & ~on_face
+    assert np.allclose(met[free], 0.4)
+
+    table = local_hausd_table(m, opts, 0.01)
+    t = np.asarray(table)
+    assert t[1] == 0.002 and t[2] == 0.02 and t[3] == 0.01
+
+
+def test_hgradreq_required_sizes_win():
+    """-hgradreq: required vertices act as immutable gradation sources."""
+    from parmmg_tpu.core import adjacency, metric as mm
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    m = unit_cube_mesh(3)
+    h = np.full((m.pcap, 1), 0.1)
+    h[0] = 0.9  # a required vertex with a much coarser prescribed size
+    fixed = np.zeros(m.pcap, bool)
+    fixed[0] = True
+    edges, emask, _, _ = adjacency.unique_edges(m, int(m.tcap * 1.7) + 64)
+    # plain gradation (gradsiz) would shrink the coarse prescription
+    # toward its fine neighbors...
+    g0 = np.asarray(mm.gradate_iso(
+        m.vert, jnp.asarray(h), edges, emask, hgrad=1.2,
+    ))
+    assert g0[0, 0] < 0.3
+    # ...the -hgradreq pass keeps required sizes immutable
+    g = np.asarray(mm.gradate_iso(
+        m.vert, jnp.asarray(h), edges, emask, hgrad=1.2,
+        fixed=jnp.asarray(fixed),
+    ))
+    assert g[0, 0] == pytest.approx(0.9)    # required size wins
+    assert np.allclose(g[1:, 0][g[1:, 0] > 0], 0.1)  # others untouched
+
+
+def test_distributed_aniso_adapt():
+    """Aniso tensor metric through the distributed driver (VERDICT: the
+    reference CI torus-shock family runs multi-rank)."""
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_distributed, merge_adapted,
+    )
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    m = unit_cube_mesh(3)
+    met = np.zeros((m.pcap, 6))
+    met[:, 0] = 1 / 0.5**2
+    met[:, 3] = 1 / 0.15**2
+    met[:, 5] = 1 / 0.5**2
+    mesh = m.replace(met=jnp.asarray(met), met_set=True)
+    stacked, comm, info = adapt_distributed(
+        mesh, DistOptions(niter=1, max_sweeps=4, nparts=2,
+                          min_shard_elts=8, hgrad=1.3)
+    )
+    out = merge_adapted(stacked, comm)
+    rep = conformity.check_mesh(out)
+    assert rep.ok, str(rep)
+    d = out.to_numpy()
+    from parmmg_tpu.core.mesh import EDGE_VERTS
+
+    ev = d["tets"][:, EDGE_VERTS].reshape(-1, 2)
+    e = d["verts"][ev[:, 1]] - d["verts"][ev[:, 0]]
+    span = np.abs(e)
+    assert span[:, 1].mean() < 0.85 * span[:, 0].mean()
+
+
+def test_gradate_from_required_semantics():
+    """MMG3D_gradsizreq: propagation FROM required entities only — a
+    no-op without required vertices; caps neighbors of a fine required
+    size; leaves far vertices untouched."""
+    from parmmg_tpu.core import adjacency, metric as mm
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    m = unit_cube_mesh(4)
+    h = np.full((m.pcap, 1), 0.5)
+    edges, emask, _, _ = adjacency.unique_edges(m, int(m.tcap * 1.7) + 64)
+
+    # no required vertices: exact no-op (a plain gradation would relax)
+    req0 = np.zeros(m.pcap, bool)
+    g0 = np.asarray(mm.gradate_from_required(
+        m.vert, jnp.asarray(h), edges, emask, jnp.asarray(req0),
+        hgrad=1.3,
+    ))
+    assert np.array_equal(g0, h)
+
+    # a finer required size at corner 0 caps its neighborhood; the cap
+    # relaxes away at the hgradreq ratio and the far corner is untouched
+    h[0] = 0.3
+    req = np.zeros(m.pcap, bool)
+    req[0] = True
+    g = np.asarray(mm.gradate_from_required(
+        m.vert, jnp.asarray(h), edges, emask, jnp.asarray(req),
+        hgrad=1.3,
+    ))
+    assert g[0, 0] == pytest.approx(0.3)
+    a, b = np.asarray(edges[:, 0]), np.asarray(edges[:, 1])
+    em = np.asarray(emask)
+    nbr = np.unique(np.concatenate([b[(a == 0) & em], a[(b == 0) & em]]))
+    assert g[nbr, 0].max() < 0.45         # capped near the source
+    far = np.linalg.norm(np.asarray(m.vert) - np.asarray(m.vert)[0],
+                         axis=1) > 1.5
+    far &= np.asarray(m.vmask)
+    assert np.allclose(g[far, 0], 0.5)    # untouched far away
