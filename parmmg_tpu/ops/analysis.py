@@ -250,6 +250,8 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
     cnt = common.seg_broadcast(
         live_sorted.astype(jnp.int32), newgrp, jnp.add, 0
     )
+    # (the group-tag OR below shares this group structure but depends on
+    # etag_sorted, which itself depends on cnt — two separate scans)
     # manifold partner: runs of exactly 2
     eq_next = jnp.concatenate([newgrp[1:] == False, jnp.zeros(1, bool)])  # noqa: E712
     eq_prev = jnp.concatenate([jnp.zeros(1, bool), eq_next[:-1]])

@@ -102,6 +102,45 @@ def seg_broadcast(vals, newgrp, op, neutral):
     return tot
 
 
+def seg_broadcast_multi(newgrp, parts):
+    """Fused `seg_broadcast` for several reductions sharing one group
+    structure: `parts` is a list of (vals, op, neutral); all of them ride
+    ONE forward + ONE backward segmented scan with a tuple carry (the
+    scans are latency-bound, so k reductions cost ~the same as one).
+    Returns the per-element group totals in `parts` order."""
+    if not _split_scatter_cols():
+        return [seg_broadcast(v, newgrp, op, neu) for v, op, neu in parts]
+
+    def comb(a, b):
+        f1 = a[0]
+        f2 = b[0]
+        out = [f1 | f2]
+        for k, (_, op, _) in enumerate(parts, start=1):
+            out.append(jnp.where(f2, b[k], op(a[k], b[k])))
+        return tuple(out)
+
+    fwd = jax.lax.associative_scan(
+        comb, (newgrp, *[v for v, _, _ in parts])
+    )
+    lastflag = jnp.concatenate([newgrp[1:], jnp.ones(1, bool)])
+
+    def combr(a, b):
+        f1 = a[0]
+        f2 = b[0]
+        return (f1 | f2,) + tuple(
+            jnp.where(f2, b[k], a[k]) for k in range(1, len(parts) + 1)
+        )
+
+    ends = [
+        jnp.where(lastflag, fwd[k + 1], jnp.asarray(neu, fwd[k + 1].dtype))
+        for k, (_, _, neu) in enumerate(parts)
+    ]
+    tot = jax.lax.associative_scan(
+        combr, (lastflag, *ends), reverse=True
+    )
+    return list(tot[1:])
+
+
 def unique_oob(sel, target, cap):
     """Scatter index vector: `target` where `sel`, else a DISTINCT
     out-of-bounds value (cap + position) — keeps the whole index array
@@ -283,13 +322,12 @@ def _run_match(keys: jax.Array, query: jax.Array, bound=None):
     from_key = order < k
     big = jnp.int32(n)
     # group reductions over the SORTED domain: segmented scans, not
-    # scatter+gather (see seg_broadcast)
-    cnt_b = seg_broadcast(
-        from_key.astype(jnp.int32), newgrp, jnp.add, 0
-    )
-    min_b = seg_broadcast(
-        jnp.where(from_key, order, big), newgrp, jnp.minimum, big
-    )
+    # scatter+gather (see seg_broadcast); both reductions fused on one
+    # scan pair
+    cnt_b, min_b = seg_broadcast_multi(newgrp, [
+        (from_key.astype(jnp.int32), jnp.add, 0),
+        (jnp.where(from_key, order, big), jnp.minimum, big),
+    ])
     hit_sorted = cnt_b > 0
     idx_sorted = jnp.where(hit_sorted, min_b, -1)
     hit = jnp.zeros(n, bool).at[order].set(hit_sorted, unique_indices=True)
@@ -311,15 +349,11 @@ def _run_match2(keys: jax.Array, query: jax.Array, bound=None):
     order, newgrp = _row_order_groups(rows, invalid, bound)
     from_key = order < k
     big = jnp.int32(n)
-    cnt_sorted = seg_broadcast(
-        from_key.astype(jnp.int32), newgrp, jnp.add, 0
-    )
-    minidx = seg_broadcast(
-        jnp.where(from_key, order, big), newgrp, jnp.minimum, big
-    )
-    maxidx = seg_broadcast(
-        jnp.where(from_key, order, -1), newgrp, jnp.maximum, -1
-    )
+    cnt_sorted, minidx, maxidx = seg_broadcast_multi(newgrp, [
+        (from_key.astype(jnp.int32), jnp.add, 0),
+        (jnp.where(from_key, order, big), jnp.minimum, big),
+        (jnp.where(from_key, order, -1), jnp.maximum, -1),
+    ])
     # per-sorted-position values, scattered back to original row order;
     # the invalid mask lives in the ORIGINAL domain and applies last
     lo = jnp.where(cnt_sorted > 0, minidx, -1)

@@ -53,7 +53,11 @@ def interp_fields_only(new: Mesh, old: Mesh, max_steps: int = 64) -> Mesh:
     maintained by the operators and left untouched)."""
     if (new.fields.shape[1] + new.ls.shape[1] + new.disp.shape[1]) == 0:
         return new
-    res = locate.locate_points(old, new.vert, max_steps=max_steps)
+    # dead slots are zero-padded; locating (0,0,0) outside the domain
+    # would drive every one of them into the exhaustive fallback — aim
+    # them at a live vertex instead (slot 0 on compacted meshes)
+    pts = jnp.where(new.vmask[:, None], new.vert, new.vert[0])
+    res = locate.locate_points(old, pts, max_steps=max_steps)
     vids = old.tet[res.tet]
 
     def lin(a):
@@ -172,8 +176,11 @@ def _interp_all_shards(new: Mesh, old: Mesh, max_steps: int, surface: bool):
     over the leading shard axis). Returns (stacked mesh, found [D,PC])."""
 
     def one(n, o):
-        seeds = locate.morton_seeds(o, n.vert)
-        res = locate.walk_locate(o, n.vert, seeds, max_steps=max_steps)
+        # aim dead zero-padded slots at a live vertex so their walks
+        # terminate immediately (their values are discarded anyway)
+        pts = jnp.where(n.vmask[:, None], n.vert, n.vert[0])
+        seeds = locate.morton_seeds(o, pts)
+        res = locate.walk_locate(o, pts, seeds, max_steps=max_steps)
         return _apply_interp(n, o, res, surface), res.found
 
     return jax.vmap(one)(new, old)
@@ -190,6 +197,19 @@ def interp_stacked(
     _check_families(new, old)
     out, found = _interp_all_shards(new, old, max_steps, surface)
     need = ~(found | ~new.vmask)
+    if surface:
+        # vertices the surface path interpolated already carry the
+        # nearest-tria value — the volume rescue must not replace it
+        # with a nearest-tet guess (mirrors _apply_interp's on_bdy)
+        from .analysis import surf_tria_mask
+
+        smask_any = jax.vmap(lambda o: jnp.any(surf_tria_mask(o)))(old)
+        on_bdy = (
+            ((new.vtag & tags.BDY) != 0)
+            & ((new.vtag & tags.PARBDY) == 0)
+            & smask_any[:, None]
+        )
+        need = need & ~on_bdy
     if bool(jax.device_get(jnp.any(need))):
         import numpy as np
 

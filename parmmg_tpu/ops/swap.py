@@ -299,16 +299,32 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
         | dup[jnp.clip(ne0 + rank, 0, tcap - 1)]
     ) & win
     win2 = win & ~bad
-    tgt_a = common.unique_oob(win2, t_id, tcap)
-    tgt_b = common.unique_oob(win2, t2c, tcap)
+
+    def rebuild(_):
+        tgt_a2 = common.unique_oob(win2, t_id, tcap)
+        tgt_b2 = common.unique_oob(win2, t2c, tcap)
+        tgt_c2 = common.unique_oob(win2, ne0 + rank, tcap)
+        t_o = tet
+        t_o = common.scatter_rows(t_o, tgt_a2, cands[0], unique=True)
+        t_o = common.scatter_rows(t_o, tgt_b2, cands[1], unique=True)
+        t_o = common.scatter_rows(t_o, tgt_c2, cands[2], unique=True)
+        tm_o = tmask.at[tgt_c2].set(win2, mode="drop", unique_indices=True)
+        return t_o, tm_o
+
+    def keep(_):
+        return tet_out, tmask_out
+
+    if common._split_scatter_cols():
+        # interacting winners are rare once sweeps settle: skip the
+        # 12-column rebuild scatter round when there are none (each
+        # random-index scatter is ~ms on TPU; the cond is free on the
+        # common path)
+        tet_out, tmask_out = jax.lax.cond(jnp.any(bad), rebuild, keep, None)
+    else:
+        tet_out, tmask_out = rebuild(None)
     tgt_c = common.unique_oob(win2, ne0 + rank, tcap)
-    tet_out = tet
-    tet_out = common.scatter_rows(tet_out, tgt_a, cands[0], unique=True)
-    tet_out = common.scatter_rows(tet_out, tgt_b, cands[1], unique=True)
-    tet_out = common.scatter_rows(tet_out, tgt_c, cands[2], unique=True)
     tref_out = mesh.tref.at[tgt_c].set(mesh.tref[t_id], mode="drop",
                                        unique_indices=True)
-    tmask_out = tmask.at[tgt_c].set(win2, mode="drop", unique_indices=True)
 
     out = mesh.replace(tet=tet_out, tref=tref_out, tmask=tmask_out)
     return out, SwapStats(nswap32=jnp.int32(0),
