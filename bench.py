@@ -38,6 +38,11 @@ CPU_ANCHOR_TPS = 2003.5
 # CPU anchor for the small fallback workload (n=8, hsiz=0.08),
 # same-day measurement (24,604 output tets in 4.09 s)
 CPU_ANCHOR_TPS_SMALL = 6015.7
+# CPU anchor for the large workload (n=12, hsiz=0.04 -> ~201k tets,
+# same-day: 201,166 tets in 189.7 s). The CPU halves its rate at this
+# size (working set leaves cache) while the TPU holds steady — the
+# large config is the representative point for the 10M-tet north star.
+CPU_ANCHOR_TPS_LARGE = 1060.3
 
 
 def _workload(n, hsiz):
@@ -89,12 +94,16 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS):
 
 
 _CONFIGS = [
-    # (args, per-attempt timeout seconds, extra env). The TPU attempt
-    # gets a long budget: remote compilation of the fused sweep
-    # while_loop over the tunnel takes 10-20 minutes cold (execution is
+    # (args, per-attempt timeout seconds, extra env). The TPU attempts
+    # get long budgets: remote compilation of the fused sweep
+    # while_loop over the tunnel takes 10-45 minutes cold (execution is
     # seconds) — a short timeout records a CPU fallback even though the
     # TPU run would succeed (that is exactly what happened in round 2).
-    (dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 2100, {}),
+    # The large config goes first: it is where the TPU advantage shows
+    # (2.39x same-day CPU at ~204k tets vs 1.37x at ~94k; measured
+    # 2026-07-31) and the closest in-reach point to the 10M-tet target.
+    (dict(n=12, hsiz=0.04, anchor=CPU_ANCHOR_TPS_LARGE), 3300, {}),
+    (dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 1800, {}),
     (dict(n=8, hsiz=0.08, anchor=CPU_ANCHOR_TPS_SMALL), 600, {}),
     # last resort when the TPU tunnel is unusable: the same measurement
     # on the host CPU backend, honestly labeled via the "platform" field
