@@ -491,3 +491,32 @@ def test_gradate_from_required_semantics():
                          axis=1) > 1.5
     far &= np.asarray(m.vmask)
     assert np.allclose(g[far, 0], 0.5)    # untouched far away
+
+
+@pytest.mark.parametrize("flags", [
+    ["-optim"],
+    ["-optimLES"],
+    ["-noinsert"],
+    ["-noswap"],
+    ["-nomove"],
+    ["-nosurf"],
+    ["-hsiz", "0.35"],
+    ["-hausd", "0.002"],
+    ["-hsiz", "0.35", "-hgrad", "1.1"],
+    ["-nr"],
+    ["-ar", "30"],
+    ["-A"],
+    ["-hsiz", "0.35", "-hgradreq", "1.2"],
+], ids=lambda f: " ".join(f))
+def test_cli_option_sweep(tmp_path, flags):
+    """Option matrix on a curved (ball) mesh — the reference CI's sphere
+    option sweep (`cmake/testing/pmmg_tests.cmake:71-150`), pass
+    criterion = exit code like the reference."""
+    from parmmg_tpu.__main__ import main
+    from parmmg_tpu.io import medit
+    from parmmg_tpu.utils.gen import unit_ball_mesh
+
+    src = str(tmp_path / "ball.mesh")
+    medit.save_mesh(unit_ball_mesh(4), src)
+    rc = main([src, "-niter", "1", "-v", "0", "-noout", *flags])
+    assert rc == 0
