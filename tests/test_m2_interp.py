@@ -225,3 +225,60 @@ def test_interp_dispatch_uses_surface_for_bdy_vertices():
     nreq = (np.asarray(new.vtag) & tags.REQUIRED) == 0
     sel = nb & nreq & np.asarray(new.vmask)
     assert np.abs(met_n[sel] - 0.1).max() < 1e-9
+
+
+def test_interp_stacked_rescue_keeps_surface_values():
+    """A boundary vertex whose volume walk fails (nudged outside the old
+    shard) must keep its surface-path interpolation — the exhaustive
+    volume rescue may not overwrite it with interior-blended values."""
+    from parmmg_tpu.core import tags
+    from parmmg_tpu.ops import analysis
+    from parmmg_tpu.parallel.distribute import split_mesh, unstack_mesh
+
+    mesh = gen.unit_cube_mesh(4, dtype=jnp.float64)
+    tm = np.asarray(mesh.tmask)
+    bary = np.asarray(mesh.vert)[np.asarray(mesh.tet)].mean(axis=1)
+    part = np.where(bary[:, 0] > 0.5, 1, 0)
+    part[~tm] = -1
+    stacked, _ = split_mesh(mesh, part, 2)
+    shards = [analysis.analyze(m) for m in unstack_mesh(stacked)]
+    # metric: 0.1 on the true surface, 0.4 inside
+    olds = []
+    for m in shards:
+        bdy = ((np.asarray(m.vtag) & tags.BDY) != 0) & (
+            (np.asarray(m.vtag) & tags.PARBDY) == 0
+        )
+        met = np.full((m.pcap, 1), 0.4)
+        met[bdy] = 0.1
+        olds.append(m.replace(met=jnp.asarray(met), met_set=True))
+    old = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *olds)
+
+    # new = same shards, with one true-surface vertex of shard 0 nudged
+    # OUTSIDE the old domain so its walk cannot succeed
+    news = []
+    moved = None
+    for s, m in enumerate(olds):
+        v = np.asarray(m.vert).copy()
+        if s == 0:
+            vt = np.asarray(m.vtag)
+            vm = np.asarray(m.vmask)
+            cand = np.nonzero(
+                vm & ((vt & tags.BDY) != 0) & ((vt & tags.PARBDY) == 0)
+                & ((vt & tags.REQUIRED) == 0)
+            )[0]
+            moved = cand[0]
+            # push along the outward normal of the unit cube surface
+            p = v[moved]
+            outward = np.where(p > 0.5, 1.0, np.where(p < 0.5, -1.0, 0.0))
+            on_face = (np.abs(p) < 1e-9) | (np.abs(p - 1.0) < 1e-9)
+            v[moved] = p + 0.05 * outward * on_face
+        news.append(m.replace(vert=jnp.asarray(v),
+                              met=jnp.asarray(np.ones((m.pcap, 1)))))
+    new = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *news)
+
+    from parmmg_tpu.ops import interp
+
+    out = interp.interp_stacked(new, old)
+    got = float(np.asarray(out.met)[0, moved, 0])
+    # surface value, not the 0.4-blended interior rescue
+    assert abs(got - 0.1) < 1e-6, got
