@@ -35,14 +35,15 @@ import time
 # anchors are refreshed the same day as the TPU measurement so
 # vs_baseline stays an honest same-code same-day hardware ratio.
 CPU_ANCHOR_TPS = 2003.5
-# CPU anchor for the small fallback workload (n=8, hsiz=0.08),
-# same-day measurement (24,604 output tets in 4.09 s)
-CPU_ANCHOR_TPS_SMALL = 6015.7
 # CPU anchor for the large workload (n=12, hsiz=0.04 -> ~201k tets,
 # same-day: 201,166 tets in 189.7 s). The CPU halves its rate at this
 # size (working set leaves cache) while the TPU holds steady — the
 # large config is the representative point for the 10M-tet north star.
 CPU_ANCHOR_TPS_LARGE = 1060.3
+# CPU anchor for the xl workload (n=14, hsiz=0.03, ~390k tets): the CPU
+# rate stays flat once out of cache (1,031 tets/s measured 2026-07-31
+# round 3; see PERF_NOTES.md)
+CPU_ANCHOR_TPS_XL = 1031.0
 
 
 def _workload(n, hsiz):
@@ -60,11 +61,29 @@ def _workload(n, hsiz):
     )
 
 
+def _enable_compile_cache():
+    """Persistent XLA compile cache, TPU only. Compilation over the shared
+    TPU tunnel costs 10-45 min cold; a disk cache hit costs <1 s. The env
+    var JAX_COMPILATION_CACHE_DIR is not honored by this jax build, so the
+    config flag is set programmatically. The CPU backend segfaults with
+    the cache enabled (tests/conftest.py), so it is gated on platform."""
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        return
+    here = os.path.dirname(os.path.abspath(__file__))
+    jax.config.update("jax_compilation_cache_dir", os.path.join(here, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 1)
+
+
 def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS):
     import jax
 
     from parmmg_tpu.models.adapt import AdaptOptions, adapt
     from parmmg_tpu.ops import quality
+
+    _enable_compile_cache()
 
     opts = AdaptOptions(niter=niter, hsiz=hsiz, max_sweeps=max_sweeps, hgrad=None)
 
@@ -93,54 +112,82 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS):
     }
 
 
-_CONFIGS = [
-    # (args, per-attempt timeout seconds, extra env). The TPU attempts
-    # get long budgets: remote compilation of the fused sweep
-    # while_loop over the tunnel takes 10-45 minutes cold (execution is
-    # seconds) — a short timeout records a CPU fallback even though the
-    # TPU run would succeed (that is exactly what happened in round 2).
-    # The large config goes first: it is where the TPU advantage shows
-    # (2.39x same-day CPU at ~204k tets vs 1.37x at ~94k; measured
-    # 2026-07-31) and the closest in-reach point to the 10M-tet target.
-    (dict(n=12, hsiz=0.04, anchor=CPU_ANCHOR_TPS_LARGE), 3300, {}),
-    (dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 1800, {}),
-    (dict(n=8, hsiz=0.08, anchor=CPU_ANCHOR_TPS_SMALL), 600, {}),
-    # last resort when the TPU tunnel is unusable: the same measurement
-    # on the host CPU backend, honestly labeled via the "platform" field
-    (dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 480,
-     {"JAX_PLATFORMS": "cpu"}),
-]
+def _attempt(cfg, tmo, env_extra=None):
+    """Run one measurement in a subprocess; return its JSON line or None."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        env = dict(os.environ, **(env_extra or {}))
+        if env.get("JAX_PLATFORMS") == "cpu":
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             json.dumps(cfg)],
+            capture_output=True, text=True, timeout=tmo, cwd=here, env=env,
+        )
+        for line in reversed(out.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # truncated write (e.g. worker OOM-killed)
+    except subprocess.TimeoutExpired:
+        pass
+    return None
 
 
 def main():
+    """Print a parseable line EARLY, then improve on it.
+
+    The round-3 record was lost because the bench led with a 3300 s
+    large-workload attempt and the harness outer timeout fired before
+    any line was printed. Lesson applied: run the default workload
+    first under a tight cap and print its line IMMEDIATELY, then
+    opportunistically attempt the large config and print again — the
+    harness keeps the tail of stdout, so whichever lines land inside
+    its budget are on the record. The per-attempt caps assume a warm
+    persistent compile cache (pre-warmed in-round; see
+    _enable_compile_cache): a cache-hit TPU run finishes in ~1-3 min.
+    Worst-case time to FIRST line: 1200 + 480 = 1680 s.
+    """
     if "--worker" in sys.argv:
         cfg = json.loads(sys.argv[-1])
         print(json.dumps(run(**cfg)), flush=True)
         return
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    for cfg, tmo, env_extra in _CONFIGS:
-        try:
-            env = dict(os.environ, **env_extra)
-            if env_extra.get("JAX_PLATFORMS") == "cpu":
-                env.pop("PALLAS_AXON_POOL_IPS", None)
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--worker",
-                 json.dumps(cfg)],
-                capture_output=True, text=True, timeout=tmo, cwd=here,
-                env=env,
-            )
-            for line in reversed(out.stdout.strip().splitlines()):
-                if line.startswith("{"):
-                    print(line)
-                    return
-        except subprocess.TimeoutExpired:
-            continue
-    # every attempt timed out (tunnel unusable): still emit a line
-    print(json.dumps({
-        "metric": "tets_per_sec", "value": 0.0, "unit": "tet/s",
-        "vs_baseline": 0.0, "error": "all attempts timed out",
-    }))
+    # 1. default workload on TPU, tight cap: the must-land line
+    rec = _attempt(dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 1200)
+    if rec is not None and rec.get("platform") == "tpu":
+        print(json.dumps(rec), flush=True)
+    else:
+        # tunnel unusable. If attempt 1 silently fell back to the CPU
+        # backend its measurement is still honest (labeled via
+        # "platform") — keep it rather than re-running; re-run on CPU
+        # only when attempt 1 produced nothing at all.
+        cpu = rec if rec is not None else _attempt(
+            dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 480,
+            {"JAX_PLATFORMS": "cpu"})
+        print(json.dumps(cpu) if cpu is not None else json.dumps({
+            "metric": "tets_per_sec", "value": 0.0, "unit": "tet/s",
+            "vs_baseline": 0.0, "error": "all attempts timed out",
+        }), flush=True)
+        return
+
+    # 2. opportunistic: the large workload, where the TPU advantage
+    # shows (2.39x same-day CPU at ~204k tets vs 1.37x at ~94k) and the
+    # closest in-reach point to the 10M-tet north star. Known-good n=12
+    # first; the n=14 experiment (which has killed the tunnel worker
+    # before — PERF_NOTES.md) only runs after a large line is already
+    # on the record. A line is printed only when it improves the
+    # record: parsed, on-TPU, larger workload than the default line.
+    for cfg, tmo in (
+        (dict(n=12, hsiz=0.04, anchor=CPU_ANCHOR_TPS_LARGE), 1500),
+        (dict(n=14, hsiz=0.03, anchor=CPU_ANCHOR_TPS_XL), 1500),
+    ):
+        big = _attempt(cfg, tmo)
+        if big is not None and big.get("platform") == "tpu":
+            print(json.dumps(big), flush=True)
+        else:
+            break
 
 
 if __name__ == "__main__":
