@@ -71,6 +71,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-nomove", action="store_true")
     p.add_argument("-nosurf", action="store_true",
                    help="freeze the boundary surface exactly")
+    p.add_argument("-opnbdy", action="store_true",
+                   help="preserve open internal boundaries (same-ref "
+                        "internal trias) as adapted surface")
     # parallel controls
     p.add_argument("-niter", type=int, default=3,
                    help="outer remesh-repartition iterations")
@@ -83,7 +86,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-groups-ratio", dest="grps_ratio", type=float,
                    default=2.0, help="max shard imbalance before SFC recut")
     p.add_argument("-mesh-size", dest="mesh_size", type=int, default=None,
-                   help="accepted for parity (remesher target size)")
+                   help="remesher target size (maps to the per-shard "
+                        "pre-split growth floor)")
     p.add_argument("-pure-partitioning", action="store_true",
                    help="partition + save only, no remeshing")
     p.add_argument("-distributed-output", dest="dist_out",
@@ -175,7 +179,7 @@ def main(argv=None) -> int:
         aniso=args.aniso, nofem=args.nofem,
         local_params=local_params,
         noinsert=args.noinsert, noswap=args.noswap,
-        nomove=args.nomove, nosurf=args.nosurf,
+        nomove=args.nomove, nosurf=args.nosurf, opnbdy=args.opnbdy,
         verbose=args.verbose,
         mem_budget_mb=args.mem,
         nparts=args.nparts,
@@ -183,6 +187,10 @@ def main(argv=None) -> int:
         ifc_layers=args.ifc_layers,
         grps_ratio=args.grps_ratio,
     )
+    if args.mesh_size:
+        # the reference's remesher target size (-mesh-size,
+        # PMMG_REMESHER_TARGET_MESH_SIZE role): per-shard growth floor
+        opts.min_shard_elts = args.mesh_size
 
     fields = field_ncomp = None
     if args.field:

@@ -58,6 +58,10 @@ class Param(enum.IntEnum):
     IPARAM_niter = 22
     IPARAM_distributedOutput = 23
     IPARAM_nparts = 24        # TPU addition: shard count (devices)
+    # lagrangian motion (reference PMMG_IPARAM_lag, src/libparmmg.h:63):
+    # present so API-compatible callers get the reference's clean
+    # rejection (src/libparmmg.c:69-73) instead of an attribute error
+    IPARAM_lag = 25
     # double parameters
     DPARAM_angleDetection = 32
     DPARAM_hmin = 33
@@ -314,9 +318,46 @@ class ParMesh:
         elif param == Param.IPARAM_mem:
             # -m: memory budget in MB per shard (zaldy_pmmg.c role)
             o.mem_budget_mb = float(value) if value > 0 else None
+        elif param == Param.IPARAM_opnbdy:
+            o.opnbdy = bool(value)
+        elif param == Param.IPARAM_lag:
+            # the reference rejects lagrangian motion up-front
+            # (src/libparmmg.c:69-73); same diagnostic here
+            if value >= 0:
+                raise ValueError(
+                    "lagrangian motion (IPARAM_lag) is not implemented"
+                )
+        elif param == Param.IPARAM_debug:
+            # debug mode arms the communicator invariant checks each
+            # iteration (the reference's assert-rich debug builds,
+            # chkcomm asserts at phase boundaries, src/libparmmg.c:326)
+            o.check_comm = bool(value)
+        elif param == Param.IPARAM_meshSize:
+            # remesher target size: in the shard=device design the
+            # closest knob is the pre-split growth floor per shard
+            # (PMMG_REMESHER_TARGET_MESH_SIZE role, src/parmmg.h:209)
+            if value > 0:
+                o.min_shard_elts = int(value)
+        elif param in (Param.IPARAM_octree, Param.IPARAM_metisRatio):
+            # genuinely obviated: no PROctree in the batched kernels, no
+            # Metis graph in the SFC partitioner — warn instead of
+            # silently accepting
+            import warnings
+
+            warnings.warn(
+                f"{param.name} has no effect in the TPU runtime "
+                "(obviated: batched kernels use no octree; partitioning "
+                "is SFC-based, not Metis)", stacklevel=2,
+            )
+        elif param == Param.IPARAM_globalNum:
+            # numbering is always available lazily via
+            # get_vertex_glonum / get_triangle_glonum /
+            # get_node_communicator_owners; the flag is call parity
+            # only (remembered below for get_iparameter)
+            pass
         else:
-            # accepted for call-site parity (mem/debug/octree/... have no
-            # TPU-side effect yet); remembered for get_iparameter
+            # accepted for call-site parity; remembered for
+            # get_iparameter
             pass
         self.iparam[param] = int(value)
         return ReturnStatus.SUCCESS
@@ -510,6 +551,65 @@ class ParMesh:
 
     def get_metric_sols(self):
         return self._result_mesh().to_numpy()["met"]
+
+    def get_vertex_glonum(self):
+        """Global vertex numbering of the result
+        (`PMMG_Compute_verticesGloNum` role, reference
+        `src/libparmmg.c:923`). Distributed result: list of per-shard
+        [np] arrays (interface vertices share one id); centralized:
+        one contiguous 0..np-1 array (a single-shard run never assigns
+        vglob, whose column would read -1)."""
+        if self.stacked is not None:
+            vglob = np.asarray(self.stacked.vglob)
+            vmask = np.asarray(self.stacked.vmask)
+            return [vglob[s][vmask[s]] for s in range(vglob.shape[0])]
+        d = self._result_mesh().to_numpy()
+        return np.arange(len(d["verts"]), dtype=np.int64)
+
+    def get_triangle_glonum(self):
+        """Global triangle numbering of the distributed result
+        (`PMMG_Compute_trianglesGloNum` role, reference
+        `src/libparmmg.c:464`): list of per-shard [nt] arrays over the
+        live trias; synthetic interface trias read -1, replicated
+        boundary trias share one id."""
+        if self.stacked is None:
+            d = self._result_mesh().to_numpy()
+            return np.arange(len(d["trias"]), dtype=np.int64)
+        from .parallel.distribute import assign_triangle_gids
+
+        gids = assign_triangle_gids(self.stacked)
+        trmask = np.asarray(self.stacked.trmask)
+        return [gids[s][trmask[s]] for s in range(gids.shape[0])]
+
+    def get_node_communicator_owners(self):
+        """Per shard: (owner_rank [np], global_id [np], nunique, ntot)
+        over that shard's interface vertices — the
+        `PMMG_Get_NodeCommunicator_owners` role (reference
+        `src/libparmmg.h:2499`). The owner is the lowest shard sharing
+        the vertex; nunique counts each interface vertex once globally,
+        ntot counts replicas."""
+        if self.comm is None:
+            raise ValueError("no distributed result; run with nparts > 1")
+        l2g = np.asarray(self.comm.l2g)
+        owner = np.asarray(self.comm.owner)
+        D = l2g.shape[0]
+        live = l2g >= 0
+        # interface = gid held by MORE THAN ONE shard (l2g covers every
+        # live vertex, so multiplicity separates interior from shared)
+        gmax = int(l2g.max(initial=0)) + 1
+        mult = np.zeros(gmax, np.int64)
+        owner_rank = np.full(gmax, 2**30, np.int64)
+        for s in range(D):
+            g = l2g[s][live[s]]
+            np.add.at(mult, g, 1)
+            np.minimum.at(owner_rank, g, s)
+        ifc = live & (mult[np.maximum(l2g, 0)] > 1)
+        ntot = int(ifc.sum())
+        nunique = int(owner[ifc].sum())
+        return [
+            (owner_rank[l2g[s][ifc[s]]], l2g[s][ifc[s]], nunique, ntot)
+            for s in range(D)
+        ]
 
     def save_mesh(self, path: str):
         from .io import medit

@@ -28,6 +28,11 @@ NOSURF = 1 << 9     # required only because parallel, not user-required
 #                     adds it, merge strips it together with the
 #                     REQUIRED it marks as split-added)
 OVERLAP = 1 << 10   # belongs to a halo/ghost overlap region
+OPNBDY = 1 << 11    # open-boundary tria: internal surface with the same
+#                     tet ref on both sides, preserved and adapted as a
+#                     real surface in -opnbdy mode (the MG_OPNBDY role;
+#                     reference PMMG_IPARAM_opnbdy, src/libparmmg.h:64,
+#                     tag special case src/tag_pmmg.c:267)
 
 # A vertex with any of these cannot be moved by smoothing:
 IMMOVABLE = REQUIRED | CORNER | PARBDY

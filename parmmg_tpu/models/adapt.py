@@ -65,6 +65,10 @@ class AdaptOptions:
     nosurf: bool = False        # -nosurf: freeze the boundary surface
     noswap: bool = False        # -noswap
     nomove: bool = False        # -nomove
+    # -opnbdy: preserve open internal boundaries (same-ref internal
+    # trias) as adapted surface (PMMG_IPARAM_opnbdy, reference
+    # `src/libparmmg.h:64`; tag special case `src/tag_pmmg.c:267`)
+    opnbdy: bool = False
     # convergence: stop sweeping when ops this sweep < frac * ntet
     converge_frac: float = 0.005
     # capacity management
@@ -568,7 +572,7 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     emult = [1.6]
 
     mesh = ensure_capacity(mesh, opts)
-    mesh = analysis.analyze(mesh, ang=opts.angle)
+    mesh = analysis.analyze(mesh, ang=opts.angle, opnbdy=opts.opnbdy)
     mesh = prepare_metric(mesh, opts, int(mesh.tcap * emult[0]) + 64)
     hausd = local_hausd_table(mesh, opts, resolve_hausd(mesh, opts))
     h0 = quality.quality_histogram(mesh)

@@ -42,6 +42,7 @@ from ..parallel.distribute import (
     split_mesh,
     unstack_mesh,
 )
+from ..parallel import partition as partition_mod
 from ..parallel.partition import sfc_partition
 from .adapt import (
     AdaptOptions,
@@ -189,13 +190,22 @@ def remesh_phase(
     )
 
 
-def interp_phase(st: Mesh, old: Mesh) -> Mesh:
+def interp_phase(st: Mesh, old: Mesh,
+                 opts: AdaptOptions | None = None) -> Mesh:
     """Interpolation from the pre-remesh snapshot for ALL shards in one
     vmapped device call — `PMMG_interpMetricsAndFields`
     (`src/interpmesh_pmmg.c:663`; purely shard-local, see SURVEY §3.4).
     The rare walk failures are rescued host-side inside
-    `interp.interp_stacked` (exhaustive closest-element per shard)."""
-    return interp.interp_stacked(st, old)
+    `interp.interp_stacked` (exhaustive closest-element per shard).
+    The wedge threshold of the surface path follows the session's
+    feature angle (-ar); -nr disables the demotion."""
+    import math as _math
+
+    if opts is None or opts.angle is None:
+        cw = -1.0  # no feature detection: nothing counts as cross-ridge
+    else:
+        cw = _math.cos(_math.radians(opts.angle))
+    return interp.interp_stacked(st, old, cos_wedge=cw)
 
 
 # ---------------------------------------------------------------------------
@@ -248,7 +258,7 @@ def adapt_distributed(
 
     # --- preprocess (reference PMMG_preprocessMesh, src/libparmmg.c:128) --
     mesh = adjacency.build_adjacency(mesh)
-    mesh = analysis.analyze(mesh, ang=opts.angle)
+    mesh = analysis.analyze(mesh, ang=opts.angle, opnbdy=opts.opnbdy)
     ecap0 = int(mesh.tcap * 1.6) + 64
     mesh = prepare_metric(mesh, opts, ecap0)
     from .adapt import local_hausd_table
@@ -270,7 +280,12 @@ def adapt_distributed(
             break
 
     # --- distribute (reference PMMG_distribute_mesh) ----------------------
-    part = np.asarray(jax.device_get(sfc_partition(mesh, nparts)))
+    # metric-aware weights: balance the PREDICTED output elements, so a
+    # localized-refinement metric (torus-shock class) doesn't skew the
+    # shards after the first iteration's splits (PMMG_computeWgt role)
+    part = np.asarray(jax.device_get(sfc_partition(
+        mesh, nparts, partition_mod.metric_weights(mesh)
+    )))
     stacked, comm = split_mesh(
         mesh, part, nparts, build_shard_adjacency=False
     )
@@ -357,7 +372,7 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
     stacked = jax.vmap(compact)(stacked)
 
     # interpolate metric + fields from the snapshot
-    stacked = interp_phase(stacked, old)
+    stacked = interp_phase(stacked, old, opts)
 
     if opts.check_comm:
         from ..parallel import chkcomm
@@ -392,6 +407,9 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
         color = migrate_mod.displace_colors(
             stacked, comm, nparts, round_id=0, layers=opts.ifc_layers
         )
+        # reattach any component the front pinched off (the
+        # PMMG_check_reachability role) before committing the move
+        color = migrate_mod.fix_contiguity(stacked, color, nparts)
         cnts = np.asarray(jax.device_get(
             migrate_mod.migration_counts(stacked, color, nparts)
         ))
@@ -466,7 +484,9 @@ def _rebalance_full(stacked: Mesh, comm: ShardComm, nparts: int):
     fallback (the displaced partition skewed too far). Centralizes the
     mesh once; the steady-state path is `parallel.migrate`."""
     merged = adjacency.build_adjacency(merge_shards(stacked, comm))
-    part = np.asarray(jax.device_get(sfc_partition(merged, nparts)))
+    part = np.asarray(jax.device_get(sfc_partition(
+        merged, nparts, partition_mod.metric_weights(merged)
+    )))
     return split_mesh(
         merged, part, nparts, assume_adjacency=True,
         build_shard_adjacency=False,
@@ -498,7 +518,7 @@ def adapt_stacked_input(
     shards = []
     ecap0 = int(stacked.tet.shape[1] * 1.6) + 64
     for m in unstack_mesh(stacked):
-        shards.append(analysis.analyze(m, ang=opts.angle))
+        shards.append(analysis.analyze(m, ang=opts.angle, opnbdy=opts.opnbdy))
     if opts.angle is not None:
         shards = analysis.cross_shard_features(shards, ang=opts.angle)
     shards = [prepare_metric(m, opts, ecap0) for m in shards]

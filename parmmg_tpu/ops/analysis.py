@@ -139,6 +139,35 @@ def _append_trias(mesh: Mesh, need: jax.Array) -> Mesh:
     return mesh.replace(tria=tria, trref=trref, trtag=trtag, trmask=trmask)
 
 
+@partial(jax.jit, donate_argnums=0)
+def mark_opnbdy(mesh: Mesh) -> Mesh:
+    """Tag internal same-ref trias as open boundaries (-opnbdy mode).
+
+    An input tria whose two owner tets share a ref is an open internal
+    surface (baffle/crack sheet); in opnbdy mode it is preserved and
+    adapted as real surface (reference `PMMG_IPARAM_opnbdy`,
+    `src/libparmmg.h:64`; the tag discipline special case
+    `src/tag_pmmg.c:267`). Tags the tria OPNBDY|BDY and its vertices
+    BDY; `tria_normals` then includes it in the surface (rim edges fall
+    out of `_detect_feature_edges`' open-border rule). Synthetic
+    NOSURF interface trias are never open boundaries."""
+    fverts = mesh.tet[:, jnp.asarray(FACE_VERTS)]
+    fkeys = _sorted3(fverts).reshape(-1, 3)
+    fkeys = jnp.where(jnp.repeat(mesh.tmask, 4)[:, None], fkeys, -1)
+    smask = surf_tria_mask(mesh)
+    trkeys = _sorted3(jnp.where(smask[:, None], mesh.tria, -1))
+    fid1, fid2, cnt = common.match_rows2(fkeys, trkeys, bound=mesh.pcap)
+    ref1 = mesh.tref[jnp.maximum(fid1, 0) // 4]
+    ref2 = mesh.tref[jnp.maximum(fid2, 0) // 4]
+    opn = smask & (cnt >= 2) & (ref1 == ref2)
+    trtag = jnp.where(opn, mesh.trtag | tags.OPNBDY | tags.BDY, mesh.trtag)
+    vb = jnp.zeros(mesh.pcap, bool)
+    idx = jnp.where(opn[:, None], mesh.tria, mesh.pcap)
+    vb = vb.at[idx.reshape(-1)].set(True, mode="drop")
+    vtag = jnp.where(vb & mesh.vmask, mesh.vtag | tags.BDY, mesh.vtag)
+    return mesh.replace(trtag=trtag, vtag=vtag)
+
+
 # ---------------------------------------------------------------------------
 # oriented normals
 # ---------------------------------------------------------------------------
@@ -192,10 +221,17 @@ def tria_normals(mesh: Mesh):
     t_ref = jnp.where(use2, t2, t1)
     f_ref = jnp.where(use2, jnp.maximum(fid2, 0), jnp.maximum(fid1, 0)) % 4
     opp = mesh.vert[mesh.tet[t_ref, f_ref]]         # opposite vertex
-    flip = (cnt > 0) & (jnp.einsum("fi,fi->f", raw, p0 - opp) < 0)
+    # open-boundary trias (-opnbdy, tagged by mark_opnbdy) ARE surface
+    # despite equal refs; a sheet has no owner-derived orientation, so
+    # they keep the stored (file) winding — consistent along the sheet
+    opn = (mesh.trtag & tags.OPNBDY) != 0
+    flip = (
+        (cnt > 0) & ~opn
+        & (jnp.einsum("fi,fi->f", raw, p0 - opp) < 0)
+    )
     raw = jnp.where(flip[:, None], -raw, raw)
     nrm = jnp.linalg.norm(raw, axis=1)
-    ok = smask & (nrm > 0) & ~same_ref
+    ok = smask & (nrm > 0) & (~same_ref | opn)
     unit = raw / jnp.maximum(nrm, 1e-30)[:, None]
     return unit, 0.5 * nrm, ok
 
@@ -450,9 +486,10 @@ def cross_shard_features(
     import math as _math
 
     cos_ang = _math.cos(_math.radians(ang))
-    # collect interface-edge rows from every shard
-    rows = {}  # (glo, ghi) -> list of (shard, unit normal, trref)
-    locs = {}  # (glo, ghi) -> list of (shard, lo_slot, hi_slot)
+    # collect (gid-pair, normal, ref, shard, local slots) rows from every
+    # shard — one vectorized block per (shard, tria-edge) combination, no
+    # per-entity work
+    blk = []
     for s, m in enumerate(shards):
         unit, _, ok = tria_normals(m)
         unit = np.asarray(unit)
@@ -464,46 +501,61 @@ def cross_shard_features(
         par = ((vt & tags.PARBDY) != 0) & (vg >= 0)
         for e0, e1 in ((0, 1), (1, 2), (0, 2)):
             a, b = tria[:, e0], tria[:, e1]
-            sel = ok & par[a] & par[b]
-            for fi in np.nonzero(sel)[0]:
-                ga, gb = int(vg[a[fi]]), int(vg[b[fi]])
-                key = (min(ga, gb), max(ga, gb))
-                rows.setdefault(key, []).append(
-                    (s, unit[fi], int(trref[fi]))
-                )
-                la, lb = int(a[fi]), int(b[fi])
-                if ga > gb:
-                    la, lb = lb, la
-                locs.setdefault(key, []).append((s, la, lb))
+            idx = np.nonzero(ok & par[a] & par[b])[0]
+            if not len(idx):
+                continue
+            la, lb = a[idx].astype(np.int64), b[idx].astype(np.int64)
+            ga, gb = vg[la].astype(np.int64), vg[lb].astype(np.int64)
+            swap = ga > gb
+            blk.append((
+                np.where(swap, gb, ga), np.where(swap, ga, gb),
+                unit[idx], trref[idx].astype(np.int64),
+                np.full(len(idx), s, np.int64),
+                np.where(swap, lb, la), np.where(swap, la, lb),
+            ))
+    if not blk:
+        return cross_shard_singul(shards, cos_ang)
+    glo, ghi, nrm, ref, shd, llo, lhi = (
+        np.concatenate([b[k] for b in blk]) for k in range(7)
+    )
 
-    # classify keys whose trias live on DIFFERENT shards (same-shard
-    # pairs were already handled by the local detection)
-    new_edges = {s: [] for s in range(len(shards))}  # (lo,hi,tag)
-    for key, lst in rows.items():
-        shards_in = {s for s, _, _ in lst}
-        if len(shards_in) < 2:
-            continue
-        etag = 0
-        if len(lst) == 2:
-            (s1, n1, r1), (s2, n2, r2) = lst
-            if float(np.dot(n1, n2)) < cos_ang:
-                etag |= tags.RIDGE
-            if r1 != r2:
-                etag |= tags.REF
-        else:  # cross-shard non-manifold fan
-            etag |= tags.NOM | tags.REQUIRED
-        if not etag:
-            continue
-        for s, la, lb in locs[key]:
-            new_edges[s].append((la, lb, etag))
+    # group rows by gid-pair key (sort-merge join, the device-friendly
+    # shape: one all_gather of these arrays + the same sort on multi-host)
+    order = np.lexsort((ghi, glo))
+    glo, ghi, nrm, ref, shd, llo, lhi = (
+        x[order] for x in (glo, ghi, nrm, ref, shd, llo, lhi)
+    )
+    newgrp = np.concatenate(
+        [[True], (glo[1:] != glo[:-1]) | (ghi[1:] != ghi[:-1])]
+    )
+    starts = np.nonzero(newgrp)[0]
+    gid = np.cumsum(newgrp) - 1
+    counts = np.diff(np.append(starts, len(glo)))
+    # cross-shard groups only (same-shard pairs were already handled by
+    # the local detection)
+    cross = (
+        np.maximum.reduceat(shd, starts) > np.minimum.reduceat(shd, starts)
+    )
+    etag_g = np.zeros(len(starts), np.int64)
+    two = counts == 2
+    i0 = starts[two]
+    if len(i0):
+        dot = np.einsum("ij,ij->i", nrm[i0], nrm[i0 + 1])
+        etag_g[two] = (
+            np.where(dot < cos_ang, tags.RIDGE, 0)
+            | np.where(ref[i0] != ref[i0 + 1], tags.REF, 0)
+        )
+    etag_g[counts > 2] = tags.NOM | tags.REQUIRED  # cross-shard NOM fan
+    etag_g[~cross] = 0
 
+    row_etag = etag_g[gid]
+    emit = row_etag != 0
     out = []
     for s, m in enumerate(shards):
-        if new_edges[s]:
-            arr = np.array(
-                sorted(set(new_edges[s])), np.int64
-            )
-            m = _merge_host_edges(m, arr[:, :2], arr[:, 2])
+        sel = emit & (shd == s)
+        if sel.any():
+            pairs = np.stack([llo[sel], lhi[sel]], axis=1)
+            m = _merge_host_edges(m, pairs, row_etag[sel])
             m = classify_corners(m, cos_ang=cos_ang)
         out.append(m)
     return cross_shard_singul(out, cos_ang)
@@ -614,41 +666,58 @@ def cross_shard_singul(shards: list, cos_ang: float) -> list:
 def _merge_host_edges(mesh: Mesh, pairs: np.ndarray, etags: np.ndarray) -> Mesh:
     """OR tags into matching stored feature edges / append the missing
     ones, then re-propagate vertex tags (host-side variant of
-    `_apply_features` for the cross-shard pass)."""
+    `_apply_features` for the cross-shard pass). Sort-merge join on
+    canonical (lo*pcap+hi) keys — vectorized, no per-edge Python."""
     edge = np.asarray(mesh.edge)
     edmask = np.asarray(mesh.edmask).copy()
     edtag = np.asarray(mesh.edtag).copy()
     edref = np.asarray(mesh.edref)
+
+    P = np.int64(mesh.pcap)
+    lo = np.minimum(pairs[:, 0], pairs[:, 1]).astype(np.int64)
+    hi = np.maximum(pairs[:, 0], pairs[:, 1]).astype(np.int64)
+    key = lo * P + hi
+    # dedup incoming pairs, OR-combining their tags
+    order = np.argsort(key, kind="stable")
+    ks, ts = key[order], np.asarray(etags, np.int64)[order]
+    first = np.concatenate([[True], ks[1:] != ks[:-1]])
+    starts = np.nonzero(first)[0]
+    ukey = ks[starts]
+    utag = np.bitwise_or.reduceat(ts, starts)
+
     live = np.nonzero(edmask)[0]
-    existing = {
-        (min(int(edge[i, 0]), int(edge[i, 1])),
-         max(int(edge[i, 0]), int(edge[i, 1]))): i
-        for i in live
-    }
-    to_add = []
-    for (a, b), t in zip(pairs, etags):
-        key = (min(int(a), int(b)), max(int(a), int(b)))
-        i = existing.get(key)
-        if i is not None:
-            edtag[i] |= int(t)
-        else:
-            to_add.append((key[0], key[1], int(t)))
+    ekey = (
+        np.minimum(edge[live, 0], edge[live, 1]).astype(np.int64) * P
+        + np.maximum(edge[live, 0], edge[live, 1])
+    )
+    eorder = np.argsort(ekey)
+    if len(ekey):
+        pos = np.clip(np.searchsorted(ekey[eorder], ukey), 0, len(ekey) - 1)
+        hit = ekey[eorder[pos]] == ukey
+        edtag[live[eorder[pos[hit]]]] |= utag[hit]
+    else:
+        hit = np.zeros(len(ukey), bool)
+
+    n_add = int((~hit).sum())
     ned = int(edmask.sum())
-    if ned + len(to_add) > mesh.ecap:
-        mesh = mesh.with_capacity(ecap=int((ned + len(to_add)) * 1.3) + 8)
-        edge = np.asarray(mesh.edge)
+    if ned + n_add > mesh.ecap:
+        mesh = mesh.with_capacity(ecap=int((ned + n_add) * 1.3) + 8)
         m2 = np.asarray(mesh.edmask)
         e2 = np.asarray(mesh.edtag).copy()
         e2[: len(edtag)] = edtag
         edmask, edtag = m2.copy(), e2
+        edge = np.asarray(mesh.edge)
         edref = np.asarray(mesh.edref)
     edge = edge.copy()
     edref = edref.copy()
-    for k, (a, b, t) in enumerate(to_add):
-        edge[ned + k] = (a, b)
-        edtag[ned + k] = t
-        edref[ned + k] = 0
-        edmask[ned + k] = True
+    if n_add:
+        slots = np.nonzero(~edmask)[0][:n_add]
+        akey = ukey[~hit]
+        edge[slots, 0] = akey // P
+        edge[slots, 1] = akey % P
+        edtag[slots] = utag[~hit]
+        edref[slots] = 0
+        edmask[slots] = True
     mesh = mesh.replace(
         edge=jnp.asarray(edge), edtag=jnp.asarray(edtag),
         edref=jnp.asarray(edref), edmask=jnp.asarray(edmask),
@@ -660,12 +729,17 @@ def analyze(
     mesh: Mesh,
     ang: float | None = ANG_DEFAULT,
     features: bool = True,
+    opnbdy: bool = False,
 ) -> Mesh:
     """Entry analysis pass — the `MMG3D_analys` role: adjacency, boundary
     completion + marking, and (unless `features=False` / `ang is None`,
-    the `-nr` no-angle-detection mode) ridge/corner detection."""
+    the `-nr` no-angle-detection mode) ridge/corner detection. With
+    `opnbdy`, internal same-ref trias are preserved as open-boundary
+    surface (`-opnbdy`, reference `src/libparmmg.h:64`)."""
     mesh = build_adjacency(mesh)
     mesh = synthesize_boundary_trias(mesh)
+    if opnbdy:
+        mesh = mark_opnbdy(mesh)
     mesh = mark_boundary(mesh)
     if features and ang is not None:
         mesh = detect_features(mesh, ang)

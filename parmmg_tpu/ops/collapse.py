@@ -256,35 +256,69 @@ def collapse_short_edges(
     # Select → evaluate → commit, iterated. One round of the
     # 2-vertex-ball arena MIS is far too sparse for bulk coarsening (a
     # candidate must be the strict minimum of its whole 2-hop
-    # neighborhood), so winners claim their arena tets and further
-    # selection rounds pick among candidates whose arenas are untouched.
-    # Disjoint arenas keep simultaneous application safe: any vertex
-    # shared by two collapses would put a claimed tet in both arenas, so
-    # each tet and each vertex still joins at most one winner. Rejected
-    # winners release their claim so they stop starving their
-    # neighborhoods (the serial kernel simply moves to the next edge;
-    # this is the batched equivalent).
-    def touched_edges(tflag):
-        vb = jnp.zeros(pcap, bool)
-        idx = jnp.where((tflag & tmask)[:, None], tet, pcap)
-        vb = vb.at[idx.reshape(-1)].set(True, mode="drop")
-        return vb[src] | vb[dst]
+    # neighborhood), so committed winners keep occupying their arenas
+    # while further rounds pick among the remaining candidates.
+    #
+    # Each selection round is ONE arena max-propagation. Candidates
+    # carry a per-sweep UNIQUE f32-exact integer rank (shorter edge =
+    # higher rank, exact ties broken by a hashed index so uniform
+    # meshes don't serialize on spatially-sorted edge ids), and
+    # committed winners participate with +inf: a candidate whose arena
+    # overlaps a committed winner sees +inf and can never win, which
+    # implements arena claiming with no extra scatter/gather rounds
+    # (the previous scheme spent 2 propagation rounds on the two-phase
+    # priority+hash compare and a 3rd on explicit tet claiming — 3x the
+    # HBM traffic for the same winner sets). Rejected winners are
+    # excluded from the +inf set, so their arenas are released and stop
+    # starving their neighborhoods (the serial kernel simply moves to
+    # the next edge; this is the batched equivalent). Disjoint arenas
+    # keep simultaneous application safe: each tet and each vertex
+    # joins at most one winner.
+    if ecap < (1 << 24):
+        h24 = (
+            jnp.arange(ecap, dtype=jnp.uint32) * jnp.uint32(2654435761)
+        ) & jnp.uint32(0xFFFFFF)
+        order = jnp.lexsort((h24, jnp.where(cand, l, jnp.inf)))
+        rnk = (
+            jnp.zeros(ecap, jnp.float32)
+            .at[order]
+            .set(jnp.arange(ecap, 0, -1, dtype=jnp.float32))
+        )
 
-    def claim_tets(w):
-        vb = jnp.zeros(pcap, bool)
-        vb = vb.at[jnp.where(w, src, pcap)].set(True, mode="drop")
-        vb = vb.at[jnp.where(w, dst, pcap)].set(True, mode="drop")
-        return jnp.any(vb[tet], axis=1) & tmask
+        def select_round(w_acc, rej, sup):
+            """One round: winners + newly-suppressed candidates.
+
+            A candidate that sees +inf is permanently blocked by a
+            committed winner; it must LEAVE the candidate pool (not
+            merely lose), else its own rank keeps suppressing its
+            neighborhood forever — candidates two hops from a winner
+            would starve."""
+            active = cand & ~w_acc & ~rej & ~sup
+            pv = jnp.where(active, rnk, -jnp.inf)
+            pv = jnp.where(w_acc, jnp.inf, pv)
+            best = gather_arena(scatter_arena(pv))
+            return active & (rnk >= best), active & jnp.isinf(best)
+    else:
+        # ranks stop being f32-exact beyond 2^24 edges: fall back to
+        # the two-phase compare (priority then hashed index)
+        def select_round(w_acc, rej, sup):
+            active = cand & ~w_acc & ~rej & ~sup
+            blocked = gather_arena(
+                scatter_arena(jnp.where(w_acc, 1.0, -jnp.inf))
+            ) > 0.0
+            w = common.two_phase_winners(
+                -l, active & ~blocked, scatter_arena, gather_arena
+            )
+            return w, active & blocked
 
     # initial carries derived from mesh data (not fresh constants) so
     # they inherit the device-varying type under shard_map — a literal
     # jnp.zeros carry is 'unvarying' and the loop body would change its
     # type on the first iteration
     zero_e = cand & False
-    zero_t = tmask & False
 
     if common._split_scatter_cols():
-        # TPU: each two-phase round is a fixed ~20ms of scatter/gather
+        # TPU: each propagation round is fixed scatter/gather cost
         # whether or not it finds work, so the selection loops exit as
         # soon as a round adds no winners (the common case once the mesh
         # converges) and the validity evaluation is skipped when the
@@ -294,70 +328,65 @@ def collapse_short_edges(
         # keeps the fixed fori_loop below.
         def sel_cond(carry):
             _, _, _, k, got = carry
-            return (k < 4) & got
+            return (k < 5) & got
 
         def sel_body(carry):
-            w_acc, claimed, rej, k, _ = carry
-            c = cand & ~touched_edges(claimed) & ~w_acc & ~rej
-            w = common.two_phase_winners(-l, c, scatter_arena,
-                                         gather_arena)
-            return (w_acc | w, claimed | claim_tets(w), rej, k + 1,
-                    jnp.any(w))
+            w_acc, rej, sup, k, _ = carry
+            w, sup_add = select_round(w_acc, rej, sup)
+            return (w_acc | w, rej, sup | sup_add, k + 1, jnp.any(w))
 
         def outer_cond(carry):
-            _, _, _, _, _, k, got = carry
+            _, _, _, _, k, got = carry
             return (k < 3) & got
 
         def outer_body(carry):
-            win_acc, rej_g, rej_s, rej_t, claimed, k, _ = carry
+            win_acc, rej_g, rej_s, rej_t, k, _ = carry
             rej = rej_g | rej_s | rej_t
+            # suppression resets each outer round: eval may reject
+            # winners, releasing arenas the suppressed candidates need
             trial, _, _, _, _ = jax.lax.while_loop(
                 sel_cond, sel_body,
-                (win_acc, claimed, rej, jnp.int32(0), jnp.any(cand)),
+                (win_acc, rej, zero_e, jnp.int32(0), jnp.any(cand)),
             )
             new_any = jnp.any(trial & ~win_acc)
 
             def do_eval(_):
                 acc, rg, rs, rt, _aux = eval_winners(trial)
-                return (acc, rej_g | rg, rej_s | rs, rej_t | rt,
-                        claim_tets(acc))
+                return acc, rej_g | rg, rej_s | rs, rej_t | rt
 
             def skip_eval(_):
                 # selection added nothing: the carried set was already
                 # validated in the previous round
-                return win_acc, rej_g, rej_s, rej_t, claimed
+                return win_acc, rej_g, rej_s, rej_t
 
-            acc, rg_o, rs_o, rt_o, clm = jax.lax.cond(
+            acc, rg_o, rs_o, rt_o = jax.lax.cond(
                 new_any, do_eval, skip_eval, None
             )
-            return acc, rg_o, rs_o, rt_o, clm, k + 1, new_any
+            return acc, rg_o, rs_o, rt_o, k + 1, new_any
 
-        win_acc, rej_g, rej_s, rej_t, _, _, _ = jax.lax.while_loop(
+        win_acc, rej_g, rej_s, rej_t, _, _ = jax.lax.while_loop(
             outer_cond, outer_body,
-            (zero_e, zero_e, zero_e, zero_e, zero_t, jnp.int32(0),
+            (zero_e, zero_e, zero_e, zero_e, jnp.int32(0),
              jnp.any(cand)),
         )
     else:
         def sel_body_f(_, carry):
-            w_acc, claimed, rej = carry
-            c = cand & ~touched_edges(claimed) & ~w_acc & ~rej
-            w = common.two_phase_winners(-l, c, scatter_arena,
-                                         gather_arena)
-            return w_acc | w, claimed | claim_tets(w), rej
+            w_acc, rej, sup = carry
+            w, sup_add = select_round(w_acc, rej, sup)
+            return w_acc | w, rej, sup | sup_add
 
         def outer_body_f(_, carry):
-            win_acc, rej_g, rej_s, rej_t, claimed = carry
+            win_acc, rej_g, rej_s, rej_t = carry
             rej = rej_g | rej_s | rej_t
             trial, _, _ = jax.lax.fori_loop(
-                0, 4, sel_body_f, (win_acc, claimed, rej)
+                0, 5, sel_body_f, (win_acc, rej, zero_e)
             )
             acc, rg, rs, rt, _aux = eval_winners(trial)
-            return (acc, rej_g | rg, rej_s | rs, rej_t | rt,
-                    claim_tets(acc))
+            return acc, rej_g | rg, rej_s | rs, rej_t | rt
 
-        win_acc, rej_g, rej_s, rej_t, _ = jax.lax.fori_loop(
+        win_acc, rej_g, rej_s, rej_t = jax.lax.fori_loop(
             0, 3, outer_body_f,
-            (zero_e, zero_e, zero_e, zero_e, zero_t),
+            (zero_e, zero_e, zero_e, zero_e),
         )
     # Cheap final pass: winners were fully validated inside the loop;
     # re-derive only the apply intermediates (scatter/compare, no

@@ -101,7 +101,8 @@ def _check_families(new: Mesh, old: Mesh):
             )
 
 
-def _apply_interp(new: Mesh, old: Mesh, res, surface: bool) -> Mesh:
+def _apply_interp(new: Mesh, old: Mesh, res, surface: bool,
+                  cos_wedge: float = locate._COS_WEDGE) -> Mesh:
     """Pure (vmappable) application step: pull values at the located
     tets, overlay the surface path for BDY vertices, respect REQUIRED."""
     met_q, ls_q, disp_q, f_q = interp_at(old, res.tet, res.bary)
@@ -109,8 +110,16 @@ def _apply_interp(new: Mesh, old: Mesh, res, surface: bool) -> Mesh:
     if surface:
         from .analysis import surf_tria_mask
 
+        from .analysis import vertex_normals
+
         smask = surf_tria_mask(old)
-        bres = locate.bdy_locate(old, smask, new.vert)
+        # query normals from the NEW surface arm the cone/wedge
+        # discipline: near a ridge the source tria must be on the
+        # query's own side of the feature (src/locate_pmmg.c:209-384)
+        bres = locate.bdy_locate(
+            old, smask, new.vert, normals=vertex_normals(new),
+            cos_wedge=cos_wedge,
+        )
         # PARBDY interface vertices are BDY-tagged but lie on the
         # synthetic interface (excluded from smask) — their nearest TRUE
         # surface tria can be arbitrarily far, so they stay on the
@@ -153,6 +162,7 @@ def interp_metrics_and_fields(
     old: Mesh,
     max_steps: int = 64,
     surface: bool = True,
+    cos_wedge: float = locate._COS_WEDGE,
 ) -> tuple[Mesh, locate.LocateResult]:
     """Locate every valid new vertex in `old` and pull met/ls/disp/fields.
 
@@ -167,11 +177,12 @@ def interp_metrics_and_fields(
     """
     _check_families(new, old)
     res = locate.locate_points(old, new.vert, max_steps=max_steps)
-    return _apply_interp(new, old, res, surface), res
+    return _apply_interp(new, old, res, surface, cos_wedge), res
 
 
-@partial(jax.jit, static_argnames=("max_steps", "surface"))
-def _interp_all_shards(new: Mesh, old: Mesh, max_steps: int, surface: bool):
+@partial(jax.jit, static_argnames=("max_steps", "surface", "cos_wedge"))
+def _interp_all_shards(new: Mesh, old: Mesh, max_steps: int, surface: bool,
+                       cos_wedge: float):
     """One device program: walk-locate + interpolate EVERY shard (vmapped
     over the leading shard axis). Returns (stacked mesh, found [D,PC])."""
 
@@ -181,13 +192,14 @@ def _interp_all_shards(new: Mesh, old: Mesh, max_steps: int, surface: bool):
         pts = jnp.where(n.vmask[:, None], n.vert, n.vert[0])
         seeds = locate.morton_seeds(o, pts)
         res = locate.walk_locate(o, pts, seeds, max_steps=max_steps)
-        return _apply_interp(n, o, res, surface), res.found
+        return _apply_interp(n, o, res, surface, cos_wedge), res.found
 
     return jax.vmap(one)(new, old)
 
 
 def interp_stacked(
-    new: Mesh, old: Mesh, max_steps: int = 64, surface: bool = True
+    new: Mesh, old: Mesh, max_steps: int = 64, surface: bool = True,
+    cos_wedge: float = locate._COS_WEDGE,
 ) -> Mesh:
     """Stacked-shard interpolation: one vmapped device call for all
     shards, with a host rescue (exhaustive closest-element search) only
@@ -195,7 +207,7 @@ def interp_stacked(
     per-shard host loop the driver used to run (VERDICT r2: no
     O(global-mesh) host work inside `_one_iteration`)."""
     _check_families(new, old)
-    out, found = _interp_all_shards(new, old, max_steps, surface)
+    out, found = _interp_all_shards(new, old, max_steps, surface, cos_wedge)
     need = ~(found | ~new.vmask)
     if surface:
         # vertices the surface path interpolated already carry the

@@ -207,20 +207,36 @@ class BdyLocateResult(NamedTuple):
     dist: jax.Array   # [Q] distance to the closest point used
 
 
+# default wedge threshold: cos 45 deg, the default feature angle.
+# Callers with a configured -ar pass cos(angle) so the demotion
+# threshold agrees with where the session's ridges actually are.
+_COS_WEDGE = 0.70710678
+
+
 @partial(jax.jit, static_argnames=("window",))
 def bdy_locate(
-    mesh: Mesh, surf_mask: jax.Array, pts: jax.Array, window: int = 32
+    mesh: Mesh, surf_mask: jax.Array, pts: jax.Array, window: int = 32,
+    normals: jax.Array | None = None, cos_wedge: float = _COS_WEDGE,
 ) -> BdyLocateResult:
     """Locate boundary points on the boundary triangulation — the
     `PMMG_locatePointBdy` role (reference `src/locate_pmmg.c:587`).
 
-    Instead of the reference's serial tria walk with cone/wedge
-    classification, every query scans a `window` of surface trias around
-    its position in a Morton order of tria barycenters and keeps the one
-    whose (clamped-barycentric) closest point is nearest — a batched
-    nearest-tria search with the same interpolation-source semantics.
-    Corner/ridge points are REQUIRED and copied, not interpolated, so the
-    vertex/edge cone-wedge cases of the reference do not arise here."""
+    Instead of the reference's serial tria walk, every query scans a
+    `window` of surface trias around its position in a Morton order of
+    tria barycenters and keeps the one whose (clamped-barycentric)
+    closest point is nearest — a batched nearest-tria search with the
+    same interpolation-source semantics.
+
+    `normals` ([Q,3] unit query normals, optional) carries the role of
+    the reference's cone/wedge vertex/edge classification
+    (`PMMG_locatePointInCone/InWedge`, `src/locate_pmmg.c:209-384`):
+    within a discretization-error band of a feature line BOTH sides are
+    equally near, and raw distance can pick the tria across the ridge —
+    interpolating the metric across the feature. A candidate whose plane
+    normal deviates from the query normal past the ridge threshold is
+    demoted (distance penalty, not exclusion: a query with no compatible
+    candidate still gets its geometric nearest). Zero query normals
+    (volume/non-surface queries) disable the test for that query."""
     bc3 = jnp.mean(mesh.vert[mesh.tria], axis=1)  # [F,3]
     lo = jnp.min(jnp.where(surf_mask[:, None], bc3, jnp.inf), axis=0)
     hi = jnp.max(jnp.where(surf_mask[:, None], bc3, -jnp.inf), axis=0)
@@ -241,7 +257,21 @@ def bdy_locate(
     closest = jnp.einsum("qwk,qwki->qwi", lam, c)
     dist = jnp.linalg.norm(closest - pts[:, None, :], axis=-1)
     dist = jnp.where(surf_mask[cand], dist, jnp.inf)
-    k = jnp.argmin(dist, axis=-1)
+    score = dist
+    if normals is not None:
+        raw = jnp.cross(c[..., 1, :] - c[..., 0, :],
+                        c[..., 2, :] - c[..., 0, :])
+        tn = raw / jnp.maximum(
+            jnp.linalg.norm(raw, axis=-1), 1e-30
+        )[..., None]
+        # |dot|: candidate orientation (winding) must not matter
+        dot = jnp.abs(jnp.einsum("qi,qwi->qw", normals, tn))
+        has_n = jnp.linalg.norm(normals, axis=-1) > 0.5  # unit or zero
+        wrong_side = has_n[:, None] & (dot < cos_wedge)
+        pen = jnp.linalg.norm(hi - lo)  # dominates any in-window dist
+        score = jnp.where(wrong_side & jnp.isfinite(dist),
+                          dist + pen, dist)
+    k = jnp.argmin(score, axis=-1)
     qi = jnp.arange(pts.shape[0])
     return BdyLocateResult(cand[qi, k], lam[qi, k], dist[qi, k])
 

@@ -229,6 +229,17 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
     )
     edge_exists = common.sorted_membership(ekeys, equery, bound=mesh.pcap)
 
+    # the face must not carry a stored tria: a 2-3 swap deletes the
+    # face, which would orphan a material-interface or open-boundary
+    # (-opnbdy) surface tria glued between same- or different-ref tets
+    fsort = jnp.sort(fv, axis=1)
+    trkeys = jnp.sort(
+        jnp.where(mesh.trmask[:, None], mesh.tria, -1), axis=1
+    )
+    face_has_tria = common.sorted_membership(
+        trkeys, jnp.where(valid[:, None], fsort, -1), bound=mesh.pcap
+    )
+
     # three new tets around (d1,d2)
     x, y, z = fv[:, 0], fv[:, 1], fv[:, 2]
     cands = [
@@ -256,6 +267,7 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
         valid
         & (old_min < QTHRESH)
         & ~edge_exists
+        & ~face_has_tria
         & vol_ok
         & (new_min > GAIN * old_min)
     )

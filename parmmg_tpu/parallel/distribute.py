@@ -426,6 +426,35 @@ def assign_global_ids(stacked: Mesh) -> Mesh:
     return _assign_gids_device(stacked)
 
 
+def assign_triangle_gids(stacked: Mesh) -> np.ndarray:
+    """[D,FC] int64 global triangle ids for true-surface trias; -1 on
+    dead slots and on synthetic NOSURF interface trias.
+
+    The triangle side of the distributed-output contract
+    (`PMMG_Compute_trianglesGloNum`, reference `src/libparmmg.c:464`): a
+    PARBDYBDY tria replicated on both sides of an interface gets ONE id
+    (both replicas read the same number; the lowest shard is the owner),
+    ids are contiguous from 0 in sorted vertex-gid-triple order. Host,
+    connectivity-only, sort-merge — no per-entity Python."""
+    tria = np.asarray(jax.device_get(stacked.tria))
+    trmask = np.asarray(jax.device_get(stacked.trmask))
+    trtag = np.asarray(jax.device_get(stacked.trtag))
+    vglob = np.asarray(jax.device_get(stacked.vglob))
+    D, FC = trmask.shape
+    out = np.full((D, FC), -1, np.int64)
+    real = trmask & ~tags.pure_interface_tria(trtag)
+    s_i, f_i = np.nonzero(real)
+    if not len(s_i):
+        return out
+    g3 = np.sort(vglob[s_i[:, None], tria[s_i, f_i]], axis=1).astype(np.int64)
+    order = np.lexsort((g3[:, 2], g3[:, 1], g3[:, 0]))
+    gs = g3[order]
+    newkey = np.concatenate([[True], np.any(gs[1:] != gs[:-1], axis=1)])
+    gid_sorted = np.cumsum(newkey) - 1
+    out[s_i[order], f_i[order]] = gid_sorted
+    return out
+
+
 def stack_loaded_shards(
     raws,
     dtype=None,

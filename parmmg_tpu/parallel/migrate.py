@@ -208,6 +208,132 @@ def displace_colors(
     return jax.lax.fori_loop(0, layers, body, color0)
 
 
+def fix_contiguity(
+    stacked: Mesh, color: jax.Array, nparts: int, rounds: int = 2
+):
+    """Reattach stranded color components after front displacement — the
+    `PMMG_fix_contiguity` / `PMMG_check_reachability` role (reference
+    `src/moveinterfaces_pmmg.c:475-700`): the advancing front can pinch
+    off an island destined for a shard it no longer touches; left alone
+    the island stays frozen interface forever (its faces never become
+    interior). Connected components of the same-color tet graph
+    (within-shard face adjacency + cross-shard gid-matched open faces)
+    are labeled by pointer-jumping min-label propagation; each color
+    keeps its heaviest component and every other component is reassigned
+    to its majority adjacent color. Host-side but connectivity-only
+    (int arrays) and fully vectorized, like `retag_interfaces`.
+
+    Takes/returns the [D,T] color array of `displace_colors`.
+    """
+    col = np.asarray(jax.device_get(color)).copy()
+    adja = np.asarray(jax.device_get(stacked.adja))
+    tmask = np.asarray(jax.device_get(stacked.tmask))
+    tet = np.asarray(jax.device_get(stacked.tet))
+    vglob = np.asarray(jax.device_get(stacked.vglob))
+    S, TC = col.shape
+    N = S * TC
+    live = tmask.reshape(-1)
+    colf = col.reshape(-1)
+
+    # --- adjacency pairs: within-shard faces --------------------------
+    nb = adja >> 2
+    valid = (adja >= 0) & tmask[:, :, None]
+    t_id = np.broadcast_to(np.arange(TC)[None, :, None], nb.shape)
+    base = (np.arange(S) * TC)[:, None, None]
+    base = np.broadcast_to(base, nb.shape)
+    a_in = (base + t_id)[valid]
+    b_in = (base + np.where(valid, nb, 0))[valid]
+    once = a_in < b_in
+    pairs_a = [a_in[once]]
+    pairs_b = [b_in[once]]
+
+    # --- cross-shard: open faces matched by sorted gid triples --------
+    open_f = (adja < 0) & tmask[:, :, None]
+    s_i, t_i, f_i = np.nonzero(open_f)
+    if len(s_i):
+        fv = np.asarray(FACE_VERTS)
+        corners = tet[s_i, t_i][np.arange(len(t_i))[:, None], fv[f_i]]
+        g3 = np.sort(vglob[s_i[:, None], corners], axis=1).astype(np.int64)
+        node = s_i.astype(np.int64) * TC + t_i
+        order = np.lexsort((g3[:, 2], g3[:, 1], g3[:, 0]))
+        g3s, nodes = g3[order], node[order]
+        samekey = np.all(g3s[1:] == g3s[:-1], axis=1)
+        # matched interface faces come in pairs; gid>=0 guards unset ids
+        ok = samekey & np.all(g3s[1:] >= 0, axis=1)
+        pairs_a.append(nodes[:-1][ok])
+        pairs_b.append(nodes[1:][ok])
+    A = np.concatenate(pairs_a)
+    B = np.concatenate(pairs_b)
+
+    for _ in range(rounds):
+        same = (colf[A] == colf[B]) & (colf[A] >= 0)
+        a, b = A[same], B[same]
+
+        # min-label propagation with pointer jumping (converges in
+        # O(log N) rounds on mesh-like graphs)
+        lab = np.arange(N, dtype=np.int64)
+        for _ in range(64):
+            l2 = lab.copy()
+            np.minimum.at(l2, a, lab[b])
+            np.minimum.at(l2, b, lab[a])
+            l2 = np.minimum(l2, l2[l2])
+            l2 = np.minimum(l2, l2[l2])
+            if (l2 == lab).all():
+                break
+            lab = l2
+        while True:
+            l2 = lab[lab]
+            if (l2 == lab).all():
+                break
+            lab = l2
+
+        sel = live & (colf >= 0)
+        roots, inv, cnts = np.unique(
+            lab[sel], return_inverse=True, return_counts=True
+        )
+        if not len(roots):
+            break
+        root_col = np.zeros(len(roots), np.int64)
+        root_col[inv] = colf[sel]  # every member shares the color
+        # heaviest component per color survives
+        byc = np.lexsort((cnts, root_col))
+        last = np.concatenate(
+            [root_col[byc][1:] != root_col[byc][:-1], [True]]
+        )
+        main_roots = roots[byc[last]]
+        stranded_root = np.ones(len(roots), bool)
+        stranded_root[np.searchsorted(roots, main_roots)] = False
+        if not stranded_root.any():
+            break
+
+        # majority adjacent color per stranded component, over the
+        # color-crossing adjacency edges
+        diff = (colf[A] != colf[B]) & (colf[A] >= 0) & (colf[B] >= 0)
+        ca = np.concatenate([A[diff], B[diff]])
+        cb = np.concatenate([B[diff], A[diff]])
+        ra = lab[ca]
+        ri = np.searchsorted(roots, ra)
+        inb = (ri < len(roots)) & (roots[np.minimum(ri, len(roots) - 1)]
+                                   == ra)
+        strand_e = inb & stranded_root[np.minimum(ri, len(roots) - 1)]
+        if not strand_e.any():
+            break
+        er, ec = ra[strand_e], colf[cb[strand_e]]
+        key = er * np.int64(nparts) + ec
+        uk, kcnt = np.unique(key, return_counts=True)
+        kr = uk // nparts
+        byr = np.lexsort((kcnt, kr))
+        lastr = np.concatenate([kr[byr][1:] != kr[byr][:-1], [True]])
+        win_root, win_col = kr[byr[lastr]], (uk % nparts)[byr[lastr]]
+        dest = np.full(N, -1, np.int64)
+        dest[win_root] = win_col
+        node_sel = sel & (dest[lab] >= 0)
+        # only stranded members move (main components are not in dest)
+        colf[node_sel] = dest[lab[node_sel]]
+
+    return jnp.asarray(colf.reshape(S, TC).astype(np.int32))
+
+
 # ---------------------------------------------------------------------------
 # migration (pack -> exchange -> integrate), device
 # ---------------------------------------------------------------------------

@@ -32,6 +32,29 @@ def tet_morton_keys(mesh: Mesh) -> jax.Array:
     return jnp.where(live, keys, jnp.int32(2**30))
 
 
+def metric_weights(mesh: Mesh) -> jax.Array:
+    """[TC] predicted output-element count per tet under the current
+    metric — the balance weight proportional to the number of elements
+    to be *created* (the `PMMG_computeWgt` role, reference
+    `src/metis_pmmg.c:280`): vol(t)·sqrt(det M) is the integrand of
+    `estimate_target_ntet`. Cutting on these weights keeps the partition
+    balanced AFTER the splits a localized-refinement metric will cause,
+    not just before. A floor keeps zero-density regions from collapsing
+    onto one shard."""
+    from ..core import metric as metric_mod
+    from ..core.mesh import tet_volumes
+
+    vol = jnp.abs(tet_volumes(mesh))
+    dens = metric_mod.metric_det(mesh.met)
+    dens_t = jnp.mean(jnp.sqrt(jnp.maximum(dens[mesh.tet], 0.0)), axis=1)
+    w = (vol * dens_t).astype(jnp.float32)
+    mean_w = jnp.sum(jnp.where(mesh.tmask, w, 0.0)) / jnp.maximum(
+        jnp.sum(mesh.tmask.astype(jnp.float32)), 1.0
+    )
+    w = jnp.maximum(w, 1e-3 * jnp.maximum(mean_w, 1e-30))
+    return jnp.where(mesh.tmask, w, 0.0)
+
+
 @partial(jax.jit, static_argnames=("nparts",))
 def sfc_partition(
     mesh: Mesh,

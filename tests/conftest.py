@@ -38,6 +38,12 @@ REF_EX0 = pathlib.Path("/root/reference/libexamples/adaptation_example0")
 REF_EX1 = pathlib.Path("/root/reference/libexamples/adaptation_example1")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running gate (bench-scale workloads)"
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Workaround for a jaxlib CPU-compiler segfault: after many large

@@ -282,3 +282,46 @@ def test_interp_stacked_rescue_keeps_surface_values():
     got = float(np.asarray(out.met)[0, moved, 0])
     # surface value, not the 0.4-blended interior rescue
     assert abs(got - 0.1) < 1e-6, got
+
+
+def test_bdy_locate_cone_wedge_no_cross_ridge():
+    """Near a feature line both sides are equally near, and raw distance
+    can hand a query to the tria ACROSS the ridge; with query normals
+    the wedge discipline (`PMMG_locatePointInCone/InWedge` role,
+    reference src/locate_pmmg.c:209-384) keeps it on its own side.
+
+    Fixture: two square sheets meeting at a 90-degree ridge along the
+    x-axis — A horizontal (normal +z), B vertical (normal +y). The query
+    sits a hair below plane A right at the ridge (discretization sag),
+    geometrically CLOSER to B."""
+    import jax.numpy as jnp
+
+    from parmmg_tpu.core.mesh import Mesh
+    from parmmg_tpu.ops import locate
+
+    verts = np.array([
+        [0, 0, 0], [1, 0, 0],          # ridge
+        [0, 0.5, 0], [1, 0.5, 0],      # sheet A (z=0, y>0)
+        [0, 0, -0.5], [1, 0, -0.5],    # sheet B (y=0, z<0)
+    ], np.float64)
+    trias = np.array(
+        [[0, 1, 3], [0, 3, 2], [0, 1, 5], [0, 5, 4]], np.int32
+    )
+    mesh = Mesh.from_numpy(verts, np.zeros((0, 4), np.int32), trias=trias)
+    smask = mesh.trmask
+
+    # belongs to A (normal +z) but is nearer to B
+    pts = jnp.asarray(np.array([[0.5, 0.0004, -0.001]]), mesh.dtype)
+    plain = locate.bdy_locate(mesh, smask, pts, window=8)
+    assert int(plain.tria[0]) in (2, 3), "fixture no longer reproduces"
+
+    nq = jnp.asarray(np.array([[0.0, 0.0, 1.0]]), mesh.dtype)
+    guided = locate.bdy_locate(mesh, smask, pts, window=8, normals=nq)
+    assert int(guided.tria[0]) in (0, 1), (
+        "wedge discipline failed to keep the query on its own side"
+    )
+    # far from the ridge the penalty changes nothing
+    far = jnp.asarray(np.array([[0.5, 0.3, 0.002]]), mesh.dtype)
+    a = locate.bdy_locate(mesh, smask, far, window=8)
+    b = locate.bdy_locate(mesh, smask, far, window=8, normals=nq)
+    assert int(a.tria[0]) == int(b.tria[0])

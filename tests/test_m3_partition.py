@@ -65,6 +65,32 @@ def test_partition_weighted(cube):
     assert wsum.max() / wsum.min() < 1.5
 
 
+def test_partition_metric_weights(cube):
+    """Metric-aware weights (the PMMG_computeWgt role, reference
+    src/metis_pmmg.c:280) balance the PREDICTED output elements: under a
+    localized-refinement metric the weighted cut gives the refined
+    corner fewer tets NOW so shards stay balanced after the splits."""
+    import jax.numpy as jnp
+
+    # sharp refinement in one corner: h 10x smaller -> ~1000x density
+    hv = np.full(cube.pcap, 0.2, np.float64)
+    vert = np.asarray(cube.vert)
+    hv[np.linalg.norm(vert - 0.15, axis=1) < 0.3] = 0.02
+    # iso metric stores the size h directly (metric_det -> 1/h^6)
+    m = cube.replace(met=jnp.asarray(hv[:, None], cube.dtype), met_set=True)
+    w = np.asarray(partition.metric_weights(m))
+    tm = np.asarray(cube.tmask)
+    assert (w[tm] > 0).all() and (w[~tm] == 0).all()
+    part = np.asarray(partition.sfc_partition(m, 4, weights=jnp.asarray(w)))
+    wsum = np.array([w[tm][part[tm] == s].sum() for s in range(4)])
+    # predicted-element balance good...
+    assert wsum.max() / wsum.min() < 1.5
+    # ...which REQUIRES a skewed tet-count balance (the refined corner
+    # holds most of the predicted weight in far fewer current tets)
+    counts = np.bincount(part[tm], minlength=4)
+    assert counts.max() > 1.5 * counts.min()
+
+
 def test_split_covers_mesh(cube, parts, sharded):
     stacked, c = sharded
     per = distribute.unstack_mesh(stacked)

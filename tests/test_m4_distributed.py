@@ -206,6 +206,49 @@ def test_interface_displacement_refines_frozen_bands():
     assert counts[False] < 0.5 * counts[True], counts
 
 
+def test_fix_contiguity_reattaches_pinched_island():
+    """A component the front pinched off gets reassigned to its majority
+    neighbor color (the PMMG_fix_contiguity / PMMG_check_reachability
+    role, reference src/moveinterfaces_pmmg.c:475-700); main components
+    and every other tet stay untouched."""
+    import jax
+
+    from parmmg_tpu.core import adjacency as adj
+    from parmmg_tpu.parallel import migrate as mig
+    from parmmg_tpu.parallel.distribute import (
+        assign_global_ids, split_mesh,
+    )
+    from parmmg_tpu.parallel.partition import sfc_partition
+
+    mesh = adj.build_adjacency(unit_cube_mesh(5))
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 2)))
+    stacked, _ = split_mesh(mesh, part, 2)
+    stacked = assign_global_ids(stacked)
+    stacked = jax.vmap(adj.build_adjacency)(stacked)
+
+    # colors = shard ids, then strand one interior shard-0 tet as a
+    # fake color-1 island: every face neighbor live and color 0
+    adja = np.asarray(jax.device_get(stacked.adja))
+    tmask = np.asarray(jax.device_get(stacked.tmask))
+    color = np.where(tmask, np.arange(2)[:, None], -1).astype(np.int32)
+    interior = tmask[0] & (adja[0] >= 0).all(axis=1)
+    nb0 = adja[0] >> 2
+    nb_ok = interior & np.array([
+        tmask[0][nb0[t]].all() and interior[nb0[t]].all()
+        for t in range(len(nb0))
+    ])
+    island = int(np.nonzero(nb_ok)[0][0])
+    color[0, island] = 1
+
+    fixed = np.asarray(jax.device_get(mig.fix_contiguity(
+        stacked, jnp.asarray(color), 2
+    )))
+    assert fixed[0, island] == 0, "island not reattached"
+    keep = np.ones_like(color, bool)
+    keep[0, island] = False
+    assert (fixed[keep] == color[keep]).all(), "non-island colors changed"
+
+
 def test_device_migration_conserves_and_retags():
     """One displacement + fixed-slot migration round (parallel.migrate):
     tets conserved, every shard conformal, interface discipline

@@ -119,3 +119,49 @@ def test_cube_ridges_preserved_under_coarsening():
     # f32 mesh: per-tet volumes carry f32 rounding; the sum is exact to
     # ~n*eps_f32, not 1e-9
     assert vol == pytest.approx(1.0, rel=1e-6)
+
+
+def test_opnbdy_preserves_internal_sheet():
+    """-opnbdy: an internal same-ref tria sheet (a baffle with an open
+    rim inside the volume) survives adaptation as real surface — the
+    reference's opnbdy_peninsula/island CI class
+    (cmake/testing/pmmg_tests.cmake:152-165; tag special case
+    src/tag_pmmg.c:267). The sheet keeps its area, the rim stays a
+    feature line, and the mesh remains conformal."""
+    from parmmg_tpu.core.mesh import FACE_VERTS, Mesh
+    from parmmg_tpu.utils import gen
+
+    n = 4
+    raw = gen.unit_cube(n)
+    verts, tets = raw["verts"], raw["tets"]
+    fv = tets[:, FACE_VERTS].reshape(-1, 3)
+    c = verts[fv]                                     # [F,3,3]
+    onplane = np.all(np.abs(c[:, :, 2] - 0.5) < 1e-9, axis=1)
+    half = c[:, :, 0].max(axis=1) <= 0.5 + 1e-9       # peninsula: x<=1/2
+    sheet = np.unique(np.sort(fv[onplane & half], axis=1), axis=0)
+    assert len(sheet) == 2 * (n // 2) * n             # sanity: 2 tria/cell
+    trias = np.concatenate([raw["trias"], sheet])
+    trrefs = np.concatenate(
+        [raw["trrefs"], np.full(len(sheet), 9, np.int32)]
+    )
+    mesh = Mesh.from_numpy(verts, tets, trias=trias, trrefs=trrefs,
+                           headroom=3.0)
+
+    out, _ = adapt(mesh, AdaptOptions(
+        hsiz=0.15, niter=1, opnbdy=True, hgrad=None, max_sweeps=8,
+    ))
+
+    trmask = np.asarray(out.trmask)
+    opn = trmask & ((np.asarray(out.trtag) & tags.OPNBDY) != 0)
+    assert opn.any(), "sheet trias vanished"
+    tri = np.asarray(out.tria)[opn]
+    v = np.asarray(out.vert)
+    ar = 0.5 * np.linalg.norm(np.cross(
+        v[tri[:, 1]] - v[tri[:, 0]], v[tri[:, 2]] - v[tri[:, 0]]
+    ), axis=1)
+    assert abs(ar.sum() - 0.5) < 0.05, f"sheet area drifted: {ar.sum()}"
+    # the sheet stayed flat (z == 0.5 within hausd) and inside its half
+    sverts = np.unique(tri)
+    assert np.abs(v[sverts, 2] - 0.5).max() < 0.02
+    rep = conformity.check_mesh(out)
+    assert rep.ok, str(rep)

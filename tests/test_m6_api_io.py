@@ -454,6 +454,59 @@ def test_distributed_aniso_adapt():
     assert span[:, 1].mean() < 0.85 * span[:, 0].mean()
 
 
+def test_global_numbering_and_owner_getters():
+    """The distributed-output contract (VERDICT Missing #6): triangle
+    global numbering (`PMMG_Compute_trianglesGloNum` role, reference
+    src/libparmmg.c:464) and node-communicator owner getters
+    (`PMMG_Get_NodeCommunicator_owners`, src/libparmmg.h:2499)."""
+    from parmmg_tpu.api import Param, ParMesh
+    from parmmg_tpu.core import tags as T
+    from parmmg_tpu.utils.gen import unit_cube
+
+    raw = unit_cube(4)
+    pm = ParMesh(nparts=2)
+    pm.set_mesh_size(np_=len(raw["verts"]), ne=len(raw["tets"]),
+                     nt=len(raw["trias"]))
+    pm.set_vertices(raw["verts"])
+    pm.set_tetrahedra(raw["tets"])
+    pm.set_triangles(raw["trias"], raw["trrefs"])
+    pm.set_iparameter(Param.IPARAM_niter, 1)
+    pm.set_iparameter(Param.IPARAM_globalNum, 1)
+    pm.set_dparameter(Param.DPARAM_hsiz, 0.3)
+    pm.opts.min_shard_elts = 8
+    pm.opts.max_sweeps = 4
+    assert pm.parmmglib_centralized() == 0
+
+    # vertex gids: every live vertex numbered, interface ids shared
+    vg = pm.get_vertex_glonum()
+    assert len(vg) == 2 and all((g >= 0).all() for g in vg)
+    allg = np.concatenate(vg)
+    # total distinct ids == merged vertex count (each interface vertex
+    # counted once)
+    assert len(np.unique(allg)) == len(pm.get_vertices()[0])
+
+    # triangle gids: contiguous over distinct true-surface trias;
+    # synthetic interface trias are -1
+    tg = pm.get_triangle_glonum()
+    cat = np.concatenate(tg)
+    real = cat[cat >= 0]
+    assert len(real) > 0
+    assert real.max() == len(np.unique(real)) - 1
+    # replicas of one physical tria never disagree: count of distinct
+    # ids equals the merged mesh's tria count
+    assert len(np.unique(real)) == len(pm.get_triangles()[0])
+
+    # owners: lowest shard owns; counts consistent
+    own = pm.get_node_communicator_owners()
+    ranks0, gids0, nuni, ntot = own[0]
+    assert ntot >= nuni > 0
+    assert ((ranks0 == 0) | (ranks0 == 1)).all()
+    # a vertex shared by shards 0 and 1 is owned by 0
+    shared = np.intersect1d(gids0, own[1][1])
+    r_by_gid = {g: r for g, r in zip(gids0, ranks0)}
+    assert all(r_by_gid[g] == 0 for g in shared)
+
+
 def test_gradate_from_required_semantics():
     """MMG3D_gradsizreq: propagation FROM required entities only — a
     no-op without required vertices; caps neighbors of a fine required
@@ -512,10 +565,17 @@ def test_cli_option_sweep(tmp_path, flags):
     """Option matrix on a curved (ball) mesh — the reference CI's sphere
     option sweep (`cmake/testing/pmmg_tests.cmake:71-150`), pass
     criterion = exit code like the reference."""
+    import jax
+
     from parmmg_tpu.__main__ import main
     from parmmg_tpu.io import medit
     from parmmg_tpu.utils.gen import unit_ball_mesh
 
+    # each flag combo compiles its own programs anyway; dropping the
+    # executable caches first keeps the jaxlib CPU compiler state small
+    # (its documented crash mode is the NEXT big compile after many —
+    # see conftest._clear_jax_caches_between_modules)
+    jax.clear_caches()
     src = str(tmp_path / "ball.mesh")
     medit.save_mesh(unit_ball_mesh(4), src)
     rc = main([src, "-niter", "1", "-v", "0", "-noout", *flags])

@@ -39,3 +39,92 @@ def test_capi_adapt_file(tmp_path):
     m = medit.load_mesh(out)
     rep = conformity.check_mesh(m)
     assert rep.ok, str(rep)
+
+
+def test_capi_staged_arrays_roundtrip():
+    """Drive the staged-arrays C ABI end-to-end through ctypes: stage a
+    cube from raw buffers (1-based connectivity like the reference API),
+    adapt, and read the result back — the foreign-caller workflow of
+    `PMMG_Init_parMesh` + `PMMG_Set_*` + `PMMG_parmmglib_centralized` +
+    `PMMG_Get_*` (reference `src/API_functions_pmmg.c`)."""
+    import ctypes
+    import os
+
+    import numpy as np
+
+    from parmmg_tpu.api import Param
+    from parmmg_tpu.utils.gen import unit_cube
+
+    so = os.path.join(os.path.dirname(__file__), "..", "native",
+                      "libparmmg_capi.so")
+    if not os.path.exists(so):
+        pytest.skip("libparmmg_capi.so not built")
+    lib = ctypes.CDLL(so)
+    C = ctypes
+    dp, ip = C.POINTER(C.c_double), C.POINTER(C.c_int)
+    lib.pmmgtpu_init.restype = C.c_void_p
+    lib.pmmgtpu_init.argtypes = [C.c_int]
+    lib.pmmgtpu_free.argtypes = [C.c_void_p]
+    lib.pmmgtpu_set_vertices.argtypes = [C.c_void_p, dp, ip, C.c_int]
+    lib.pmmgtpu_set_tetrahedra.argtypes = [C.c_void_p, ip, ip, C.c_int]
+    lib.pmmgtpu_set_triangles.argtypes = [C.c_void_p, ip, ip, C.c_int]
+    lib.pmmgtpu_set_metric.argtypes = [C.c_void_p, dp, C.c_int, C.c_int]
+    lib.pmmgtpu_set_iparameter.argtypes = [C.c_void_p, C.c_int, C.c_int]
+    lib.pmmgtpu_set_dparameter.argtypes = [C.c_void_p, C.c_int, C.c_double]
+    lib.pmmgtpu_run.argtypes = [C.c_void_p]
+    lib.pmmgtpu_get_meshsize.argtypes = [C.c_void_p, ip, ip, ip]
+    lib.pmmgtpu_get_vertices.argtypes = [C.c_void_p, dp, ip, C.c_int]
+    lib.pmmgtpu_get_tetrahedra.argtypes = [C.c_void_p, ip, ip, C.c_int]
+    lib.pmmgtpu_get_metric.argtypes = [C.c_void_p, dp, C.c_int, C.c_int]
+    h = lib.pmmgtpu_init(1)
+    assert h
+
+    raw = unit_cube(3)
+    verts = np.ascontiguousarray(raw["verts"], np.float64)
+    tets = np.ascontiguousarray(raw["tets"] + 1, np.int32)
+    trias = np.ascontiguousarray(raw["trias"] + 1, np.int32)
+    trrefs = np.ascontiguousarray(raw["trrefs"], np.int32)
+
+    def ptr(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    H = ctypes.c_void_p(h)
+    assert lib.pmmgtpu_set_vertices(
+        H, ptr(verts, ctypes.c_double), None, len(verts)) == 0
+    assert lib.pmmgtpu_set_tetrahedra(
+        H, ptr(tets, ctypes.c_int), None, len(tets)) == 0
+    assert lib.pmmgtpu_set_triangles(
+        H, ptr(trias, ctypes.c_int), ptr(trrefs, ctypes.c_int),
+        len(trias)) == 0
+    met = np.full((len(verts), 1), 0.25, np.float64)
+    assert lib.pmmgtpu_set_metric(
+        H, ptr(met, ctypes.c_double), len(verts), 1) == 0
+    assert lib.pmmgtpu_set_iparameter(
+        H, int(Param.IPARAM_niter), 1) == 0
+    assert lib.pmmgtpu_set_dparameter(
+        H, int(Param.DPARAM_hsiz), 0.25) == 0
+
+    assert lib.pmmgtpu_run(H) == 0
+
+    np_o, ne_o, nt_o = ctypes.c_int(), ctypes.c_int(), ctypes.c_int()
+    assert lib.pmmgtpu_get_meshsize(
+        H, ctypes.byref(np_o), ctypes.byref(ne_o), ctypes.byref(nt_o)) == 0
+    assert ne_o.value > len(tets), "adaptation did not refine"
+
+    vout = np.empty((np_o.value, 3), np.float64)
+    vref = np.empty(np_o.value, np.int32)
+    tout = np.empty((ne_o.value, 4), np.int32)
+    tref = np.empty(ne_o.value, np.int32)
+    mout = np.empty((np_o.value, 1), np.float64)
+    assert lib.pmmgtpu_get_vertices(
+        H, ptr(vout, ctypes.c_double), ptr(vref, ctypes.c_int),
+        np_o.value) == 0
+    assert lib.pmmgtpu_get_tetrahedra(
+        H, ptr(tout, ctypes.c_int), ptr(tref, ctypes.c_int),
+        ne_o.value) == 0
+    assert lib.pmmgtpu_get_metric(
+        H, ptr(mout, ctypes.c_double), np_o.value, 1) == 0
+    # 1-based connectivity referencing the returned vertex block
+    assert tout.min() >= 1 and tout.max() <= np_o.value
+    assert np.isfinite(vout).all() and (mout > 0).all()
+    assert lib.pmmgtpu_free(H) == 0
