@@ -407,12 +407,18 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
         color = migrate_mod.displace_colors(
             stacked, comm, nparts, round_id=0, layers=opts.ifc_layers
         )
-        # reattach any component the front pinched off (the
-        # PMMG_check_reachability role) before committing the move
-        color = migrate_mod.fix_contiguity(stacked, color, nparts)
         cnts = np.asarray(jax.device_get(
             migrate_mod.migration_counts(stacked, color, nparts)
         ))
+        if cnts.max() > 0:
+            # the front moved: reattach any component it pinched off
+            # (the PMMG_check_reachability role) before committing. The
+            # repair is host connectivity-only work, so it is gated on
+            # actual movement — an idle front cannot strand anything.
+            color = migrate_mod.fix_contiguity(stacked, color, nparts)
+            cnts = np.asarray(jax.device_get(
+                migrate_mod.migration_counts(stacked, color, nparts)
+            ))
         shard_ne = np.asarray(
             jax.device_get(jnp.sum(stacked.tmask, axis=1))
         )

@@ -139,6 +139,18 @@ def _append_trias(mesh: Mesh, need: jax.Array) -> Mesh:
     return mesh.replace(tria=tria, trref=trref, trtag=trtag, trmask=trmask)
 
 
+def _tria_owner_match(mesh: Mesh, smask: jax.Array):
+    """Owner tet faces of each tria by sorted-triple sort-merge:
+    (fid1, fid2, cnt) with fids into the flat 4*TC face slots — shared
+    by `tria_normals` and `mark_opnbdy` (one definition of the most
+    expensive matching step of surface analysis)."""
+    fverts = mesh.tet[:, jnp.asarray(FACE_VERTS)]
+    fkeys = _sorted3(fverts).reshape(-1, 3)
+    fkeys = jnp.where(jnp.repeat(mesh.tmask, 4)[:, None], fkeys, -1)
+    trkeys = _sorted3(jnp.where(smask[:, None], mesh.tria, -1))
+    return common.match_rows2(fkeys, trkeys, bound=mesh.pcap)
+
+
 @partial(jax.jit, donate_argnums=0)
 def mark_opnbdy(mesh: Mesh) -> Mesh:
     """Tag internal same-ref trias as open boundaries (-opnbdy mode).
@@ -151,12 +163,8 @@ def mark_opnbdy(mesh: Mesh) -> Mesh:
     BDY; `tria_normals` then includes it in the surface (rim edges fall
     out of `_detect_feature_edges`' open-border rule). Synthetic
     NOSURF interface trias are never open boundaries."""
-    fverts = mesh.tet[:, jnp.asarray(FACE_VERTS)]
-    fkeys = _sorted3(fverts).reshape(-1, 3)
-    fkeys = jnp.where(jnp.repeat(mesh.tmask, 4)[:, None], fkeys, -1)
     smask = surf_tria_mask(mesh)
-    trkeys = _sorted3(jnp.where(smask[:, None], mesh.tria, -1))
-    fid1, fid2, cnt = common.match_rows2(fkeys, trkeys, bound=mesh.pcap)
+    fid1, fid2, cnt = _tria_owner_match(mesh, smask)
     ref1 = mesh.tref[jnp.maximum(fid1, 0) // 4]
     ref2 = mesh.tref[jnp.maximum(fid2, 0) // 4]
     opn = smask & (cnt >= 2) & (ref1 == ref2)
@@ -203,12 +211,7 @@ def tria_normals(mesh: Mesh):
     p2 = mesh.vert[mesh.tria[:, 2]]
     raw = jnp.cross(p1 - p0, p2 - p0)               # |raw| = 2*area
     # owner tet faces: match sorted triples (internal faces match twice)
-    fverts = mesh.tet[:, jnp.asarray(FACE_VERTS)]
-    fkeys = _sorted3(fverts).reshape(-1, 3)
-    fkeys = jnp.where(jnp.repeat(mesh.tmask, 4)[:, None], fkeys, -1)
-    trkeys = _sorted3(jnp.where(smask[:, None], mesh.tria, -1))
-    fid1, fid2, cnt = common.match_rows2(fkeys, trkeys,
-                                         bound=mesh.pcap)  # into 4*TC
+    fid1, fid2, cnt = _tria_owner_match(mesh, smask)  # into 4*TC
     t1 = jnp.maximum(fid1, 0) // 4
     t2 = jnp.maximum(fid2, 0) // 4
     ref1 = mesh.tref[t1]
@@ -301,6 +304,13 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
     tri_of = order // 3
     tri_partner = jnp.maximum(partner_sorted, 0) // 3
     dot = jnp.einsum("si,si->s", unit[tri_of], unit[tri_partner])
+    # open-boundary sheets keep their stored winding, which a file may
+    # not orient consistently: between two OPNBDY trias the dihedral
+    # test must be winding-independent (|dot|), or a mixed-winding flat
+    # sheet would read as wall-to-wall fake ridges and feature-lock
+    opn_t = (mesh.trtag & tags.OPNBDY) != 0
+    both_opn = opn_t[tri_of] & opn_t[tri_partner]
+    dot = jnp.where(both_opn, jnp.abs(dot), dot)
     refdiff = mesh.trref[tri_of] != mesh.trref[tri_partner]
     has_partner = partner_sorted >= 0
     # NB: synthetic interface trias (PARBDY|NOSURF) never reach these

@@ -193,31 +193,52 @@ def swap_32(
 
 @partial(jax.jit, donate_argnums=0)
 def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
-    """2-3 face swap sweep. Requires FRESH adjacency; leaves it stale."""
+    """2-3 face swap sweep. Requires FRESH adjacency; leaves it stale.
+
+    The expensive work (three candidate-tet quality/volume evaluations,
+    edge/tria membership sorts, winner selection, apply scatters) runs
+    on a COMPACTED candidate set: the cheap prefilter (interior face,
+    both tets live, pair quality below QTHRESH) admits few faces once
+    sweeps settle, so the 4*TC face slots are sorted worst-pair-first
+    and only the first tcap//2 evaluated — ~8x fewer rows through the
+    heavy path. If more faces prequalify than the bucket holds (only in
+    violent early sweeps), the overflow is the BEST-quality pairs,
+    which are retried next sweep — the Jacobi schedule already assumes
+    multiple passes."""
     tcap = mesh.tcap
     tet, tmask, adja = mesh.tet, mesh.tmask, mesh.adja
     ne0 = mesh.ntet
-    ncand_cap = tcap * 4
 
-    # candidate faces: interior, t < neighbor (dedupe)
-    t_id = jnp.broadcast_to(
-        jnp.arange(tcap, dtype=jnp.int32)[:, None], (tcap, 4)
-    ).reshape(-1)
-    f_id = jnp.broadcast_to(
-        jnp.arange(4, dtype=jnp.int32)[None, :], (tcap, 4)
-    ).reshape(-1)
-    nb = adja.reshape(-1)
-    t2 = nb // 4
-    valid = (nb >= 0) & tmask[jnp.clip(t2, 0, tcap - 1)] & tmask[t_id]
-    t2c = jnp.clip(t2, 0, tcap - 1)
-    valid = valid & (t_id < t2c)
+    # cheap prefilter over all 4*TC face slots
+    nb_full = adja.reshape(-1)
+    t_id_full = jnp.arange(tcap * 4, dtype=jnp.int32) // 4
+    t2_full = jnp.clip(nb_full // 4, 0, tcap - 1)
+    q_all = common.quality_of(mesh.vert, mesh.met, tet)
+    pre = (
+        (nb_full >= 0)
+        & tmask[t2_full]
+        & tmask[t_id_full]
+        & (t_id_full < t2_full)          # each face once
+        & (jnp.minimum(q_all[t_id_full], q_all[t2_full]) < QTHRESH)
+    )
 
-    fvidx = jnp.asarray(FACE_VERTS)[f_id]               # [N,3] local slots
-    fv = jnp.take_along_axis(tet[t_id], fvidx, axis=1)  # [N,3] vertex ids
+    # compact, worst pair first
+    K = max(256, tcap // 2)
+    sortkey = jnp.where(
+        pre, jnp.minimum(q_all[t_id_full], q_all[t2_full]), jnp.inf
+    )
+    pick = jnp.argsort(sortkey)[:K].astype(jnp.int32)
+    t_id = pick // 4
+    f_id = pick % 4
+    nb = nb_full[pick]
+    t2c = jnp.clip(nb // 4, 0, tcap - 1)
+    valid = pre[pick]
+
+    fvidx = jnp.asarray(FACE_VERTS)[f_id]               # [K,3] local slots
+    fv = jnp.take_along_axis(tet[t_id], fvidx, axis=1)  # [K,3] vertex ids
     d1 = tet[t_id, f_id]
     d2 = tet[t2c, nb % 4]
 
-    q_all = common.quality_of(mesh.vert, mesh.met, tet)
     old_min = jnp.minimum(q_all[t_id], q_all[t2c])
 
     # edge (d1,d2) must not already exist

@@ -165,3 +165,47 @@ def test_opnbdy_preserves_internal_sheet():
     assert np.abs(v[sverts, 2] - 0.5).max() < 0.02
     rep = conformity.check_mesh(out)
     assert rep.ok, str(rep)
+
+
+def test_opnbdy_mixed_winding_no_fake_ridges():
+    """Medit does not guarantee orientation for internal trias: a sheet
+    with alternating winding must not read as wall-to-wall fake ridges
+    (the dihedral test between two OPNBDY trias is winding-independent).
+    """
+    from parmmg_tpu.core.mesh import FACE_VERTS, Mesh
+    from parmmg_tpu.ops import analysis
+    from parmmg_tpu.utils import gen
+
+    n = 4
+    raw = gen.unit_cube(n)
+    verts, tets = raw["verts"], raw["tets"]
+    fv = tets[:, FACE_VERTS].reshape(-1, 3)
+    c = verts[fv]
+    onplane = np.all(np.abs(c[:, :, 2] - 0.5) < 1e-9, axis=1)
+    half = c[:, :, 0].max(axis=1) <= 0.5 + 1e-9
+    sheet = np.unique(np.sort(fv[onplane & half], axis=1), axis=0)
+    # scramble winding: flip every other tria
+    sheet[::2] = sheet[::2, ::-1]
+    trias = np.concatenate([raw["trias"], sheet])
+    trrefs = np.concatenate(
+        [raw["trrefs"], np.full(len(sheet), 9, np.int32)]
+    )
+    mesh = Mesh.from_numpy(verts, tets, trias=trias, trrefs=trrefs)
+    mesh = analysis.analyze(mesh, opnbdy=True)
+
+    # no RIDGE feature edge strictly interior to the flat sheet
+    ed = np.asarray(mesh.edge)
+    live = np.asarray(mesh.edmask) & (
+        (np.asarray(mesh.edtag) & tags.RIDGE) != 0
+    )
+    v = np.asarray(mesh.vert)
+    eps = 1e-6
+    interior = (
+        (np.abs(v[:, 2] - 0.5) < eps)
+        & (v[:, 0] > eps) & (v[:, 0] < 0.5 - eps)
+        & (v[:, 1] > eps) & (v[:, 1] < 1 - eps)
+    )
+    bad = live & interior[ed[:, 0]] & interior[ed[:, 1]]
+    assert not bad.any(), (
+        f"{int(bad.sum())} fake ridges inside a flat mixed-winding sheet"
+    )

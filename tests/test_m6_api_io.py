@@ -39,6 +39,12 @@ def test_api_manual_io_roundtrip():
     assert verts.shape == (npo, 3) and tets.shape == (ne, 4)
     met = pm.get_metric_sols()
     assert met.shape[0] == npo
+    # centralized global numbering: contiguous 0..np-1 (a single-shard
+    # run never fills Mesh.vglob; the getter must not surface its -1s)
+    vg = pm.get_vertex_glonum()
+    assert vg.shape == (npo,) and vg[0] == 0 and vg[-1] == npo - 1
+    tg = pm.get_triangle_glonum()
+    assert len(tg) == nt and (tg >= 0).all()
 
 
 def test_api_required_entities_survive():

@@ -27,6 +27,17 @@ def main():
     from parmmg_tpu.utils.gen import unit_cube_mesh
 
     print(f"platform: {jax.devices()[0].platform}", flush=True)
+    if jax.devices()[0].platform == "tpu":
+        # share bench.py's persistent compile cache (tunnel compiles
+        # cost minutes; disk hits cost <1s). CPU-unsafe, TPU only.
+        import os as _os
+        import sys as _sys
+
+        _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))))
+        from bench import _enable_compile_cache
+
+        _enable_compile_cache()
     est = int(12.0 / hsiz**3)
     mesh = unit_cube_mesh(
         n,
