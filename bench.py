@@ -27,18 +27,19 @@ import time
 
 # steady-state tets/sec of the default workload on the host CPU backend
 # (measured with a warm jit cache; see BASELINE.md "CPU anchor" row).
-# Round-2 M5/M6 kernels measured 1367.3; early round-3 kernel work
-# (packed sorts, fused sweep loop, scatter layer) measured 2128.2;
-# re-measured 2026-07-31 with the second round-3 pass (seg_broadcast,
-# early-exit MIS, platform-aware lowering): 93,828 output tets in
-# 46.8 s. Host wall-clock drifts a few percent with machine load —
-# anchors are refreshed the same day as the TPU measurement so
-# vs_baseline stays an honest same-code same-day hardware ratio.
-CPU_ANCHOR_TPS = 2003.5
-# CPU anchor for the large workload (n=12, hsiz=0.04 -> ~201k tets,
-# same-day: 201,166 tets in 189.7 s). The CPU halves its rate at this
-# size (working set leaves cache) while the TPU holds steady — the
-# large config is the representative point for the 10M-tet north star.
+# History: round-2 M5/M6 kernels 1367.3; round-3 passes 2128.2 /
+# 2003.5; re-measured 2026-08-01 with the round-4 kernels (rank-MIS
+# collapse, compacted swap23): 93,976 output tets in 44.3 s. Host
+# wall-clock drifts a few percent with machine load — anchors are
+# refreshed the same day as the TPU measurement so vs_baseline stays
+# an honest same-code same-day hardware ratio.
+CPU_ANCHOR_TPS = 2122.7
+# CPU anchor for the large workload (n=12, hsiz=0.04 -> ~200k tets):
+# 1,060.3 measured idle 2026-07-31 (round-3 tree); the round-4 tree
+# measured 878.5 under host contention — the idle round-3 figure is
+# kept as the honest anchor. The CPU halves its rate at this size
+# (working set leaves cache) while the TPU holds steady — the large
+# config is the representative point for the 10M-tet north star.
 CPU_ANCHOR_TPS_LARGE = 1060.3
 # CPU anchor for the xl workload (n=14, hsiz=0.03, ~390k tets): the CPU
 # rate stays flat once out of cache (1,031 tets/s measured 2026-07-31

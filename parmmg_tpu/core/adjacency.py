@@ -74,6 +74,7 @@ def build_adjacency(mesh: Mesh) -> Mesh:
     return mesh.replace(adja=adja_flat.reshape(tc, 4))
 
 
+@partial(jax.jit, static_argnames=("ecap",))
 def unique_edges(mesh: Mesh, ecap: int):
     """Extract unique undirected edges of the valid tets.
 

@@ -93,11 +93,7 @@ class SweepStats(NamedTuple):
     split_capped: jax.Array
 
 
-@partial(
-    jax.jit,
-    static_argnames=("ecap", "noinsert", "noswap", "nomove", "nosurf"),
-)
-def remesh_sweep(
+def _sweep_body(
     mesh: Mesh,
     ecap: int,
     noinsert: bool = False,
@@ -106,10 +102,18 @@ def remesh_sweep(
     nosurf: bool = False,
     hausd: float = 0.01,
 ):
-    """One fused sweep: split → collapse → swaps → smooth.
+    """One sweep: split → collapse → swaps → smooth.
 
     Compaction (the batched `MMG3D_pack`/`PMMG_packParMesh` analog) runs
-    before operators that allocate, so live entities form array prefixes."""
+    before operators that allocate, so live entities form array prefixes.
+
+    Called two ways: under the `remesh_sweep`/`remesh_sweeps` jit (ONE
+    fused device program — best runtime, but its XLA compile grows
+    super-linearly with the array shapes: >2h on the TPU tunnel at
+    ~850k-tet capacities), or DIRECTLY for large meshes, where each
+    constituent op runs as its own jitted program (measured: single ops
+    compile in seconds even at 5M rows — the blowup is whole-program
+    scheduling, not op codegen)."""
     mesh = compact(mesh)
     edges, emask, t2e, n_unique = adjacency.unique_edges(mesh, ecap)
     if not noinsert:
@@ -158,6 +162,19 @@ def remesh_sweep(
         n_unique=n_unique,
         split_capped=s_split.capped,
     )
+
+
+remesh_sweep = partial(
+    jax.jit,
+    static_argnames=("ecap", "noinsert", "noswap", "nomove", "nosurf"),
+)(_sweep_body)
+
+# above this tet capacity the sweep runs UNFUSED (per-op programs +
+# per-sweep host loop): whole-program XLA scheduling at such shapes
+# costs hours on the tunnel, while per-op compiles cost seconds and
+# the extra dispatch round trips (~115 ms each) are noise against the
+# multi-second sweeps of meshes this size
+UNFUSED_TCAP = 600_000
 
 
 # history columns of remesh_sweeps: one int32 row per executed sweep
@@ -515,16 +532,31 @@ def run_batched_sweep_loop(
     while done < budget:
         mesh = ensure_capacity(mesh, opts)
         ecap = int(mesh.tcap * emult[0]) + 64
-        mesh, hist, n_done = remesh_sweeps(
-            mesh, jnp.int32(budget - done), ecap, opts.max_sweeps,
-            noinsert=opts.noinsert, noswap=opts.noswap,
-            nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
-            converge_frac=opts.converge_frac,
-            grow_trigger=opts.grow_trigger,
-        )
-        n = int(n_done)
-        if n == 0:
-            break
+        if mesh.tcap > UNFUSED_TCAP:
+            # large mesh: one sweep per call, each op its own program
+            # (fused whole-program compile takes hours at these shapes)
+            mesh, stats = _sweep_body(
+                mesh, ecap, noinsert=opts.noinsert, noswap=opts.noswap,
+                nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
+            )
+            hist = jnp.stack([
+                stats.nsplit, stats.ncollapse, stats.nswap, stats.nmoved,
+                mesh.ntet.astype(jnp.int32),
+                mesh.npoin.astype(jnp.int32),
+                stats.n_unique, stats.split_capped.astype(jnp.int32),
+            ])[None, :]
+            n = 1
+        else:
+            mesh, hist, n_done = remesh_sweeps(
+                mesh, jnp.int32(budget - done), ecap, opts.max_sweeps,
+                noinsert=opts.noinsert, noswap=opts.noswap,
+                nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
+                converge_frac=opts.converge_frac,
+                grow_trigger=opts.grow_trigger,
+            )
+            n = int(n_done)
+            if n == 0:
+                break
         import numpy as _np
 
         rows = _np.asarray(jax.device_get(hist))[:n]

@@ -147,8 +147,16 @@ def ensure_capacity_stacked(st: Mesh, opts: AdaptOptions) -> Mesh:
 # ---------------------------------------------------------------------------
 
 def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions, hausd: float):
+    from .adapt import UNFUSED_TCAP, _sweep_body
+
+    # same fused/unfused dispatch as the single-shard engine: above
+    # UNFUSED_TCAP per-shard capacity, whole-program XLA scheduling
+    # costs hours (PERF_NOTES round 4) — vmapping the plain body keeps
+    # each constituent op its own (batched) compiled program, since the
+    # inner jits remain compile boundaries under vmap
+    body = _sweep_body if st.tet.shape[1] > UNFUSED_TCAP else remesh_sweep
     fn = partial(
-        remesh_sweep,
+        body,
         ecap=ecap,
         noinsert=opts.noinsert,
         noswap=opts.noswap,

@@ -304,13 +304,23 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
     tri_of = order // 3
     tri_partner = jnp.maximum(partner_sorted, 0) // 3
     dot = jnp.einsum("si,si->s", unit[tri_of], unit[tri_partner])
-    # open-boundary sheets keep their stored winding, which a file may
-    # not orient consistently: between two OPNBDY trias the dihedral
-    # test must be winding-independent (|dot|), or a mixed-winding flat
-    # sheet would read as wall-to-wall fake ridges and feature-lock
+    # Open-boundary sheets keep their stored winding, which a file may
+    # not orient consistently. Winding consistency across the shared
+    # edge is detectable: coherently-oriented neighbors traverse it in
+    # OPPOSITE directions. Only an INCONSISTENT OPNBDY pair gets the
+    # sign-flipped (negated-dot) test — a mixed-winding flat sheet must
+    # not read as wall-to-wall fake ridges, while sharp folds of a
+    # consistently-wound sheet keep the full signed dihedral test.
     opn_t = (mesh.trtag & tags.OPNBDY) != 0
     both_opn = opn_t[tri_of] & opn_t[tri_partner]
-    dot = jnp.where(both_opn, jnp.abs(dot), dot)
+    # cyclic traversal direction per slot: pairs are stored (01, 12, 02)
+    # — the 02 slot is the REVERSE of the tria's cyclic third edge (20),
+    # so its stored-order flag must be flipped before comparing
+    fwd = (pairs[..., 0] < pairs[..., 1])              # [FC,3]
+    is02 = jnp.zeros((1, 3), bool).at[0, 2].set(True)
+    cyc = (fwd ^ is02).reshape(-1)
+    same_dir = cyc[order] == cyc[jnp.maximum(partner_sorted, 0)]
+    dot = jnp.where(both_opn & same_dir, -dot, dot)
     refdiff = mesh.trref[tri_of] != mesh.trref[tri_partner]
     has_partner = partner_sorted >= 0
     # NB: synthetic interface trias (PARBDY|NOSURF) never reach these

@@ -230,3 +230,24 @@ def test_split_respects_required_triangles(cube_mesh_path):
     s0 = {tuple(sorted(t)) for t in tria0.tolist()}
     s2 = {tuple(sorted(t)) for t in tria2.tolist()}
     assert s0 == s2
+
+
+def test_unfused_sweep_path_matches(monkeypatch):
+    """Above UNFUSED_TCAP the sweep runs per-op instead of as one fused
+    program (whole-program XLA scheduling costs hours at large shapes on
+    TPU while per-op compiles cost seconds). The path must produce a
+    conforming unit mesh exactly like the fused one."""
+    import parmmg_tpu.models.adapt as A
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    monkeypatch.setattr(A, "UNFUSED_TCAP", 64)
+    mesh = unit_cube_mesh(4)
+    out, info = A.adapt(mesh, A.AdaptOptions(
+        hsiz=0.18, niter=1, max_sweeps=6, hgrad=None
+    ))
+    rep = conformity.check_mesh(out)
+    assert rep.ok, str(rep)
+    assert int(out.ntet) > 500
+    h = quality.quality_histogram(out)
+    assert float(h.qavg) > 0.7
+    assert len(info["history"]) >= 2  # one record per sweep

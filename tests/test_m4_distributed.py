@@ -312,3 +312,24 @@ def test_device_migration_conserves_and_retags():
     rep = check_mesh(merged)
     assert rep.ok, str(rep)
     assert int(merged.ntet) == ne0
+
+
+def test_distributed_unfused_sweep_path(monkeypatch):
+    """Above UNFUSED_TCAP the stacked sweep dispatches per-op instead of
+    one fused program (the same large-shape compile guard as the
+    single-shard engine; the north-star shards exceed the threshold)."""
+    import parmmg_tpu.models.adapt as A
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_distributed, merge_adapted,
+    )
+
+    monkeypatch.setattr(A, "UNFUSED_TCAP", 64)
+    mesh = unit_cube_mesh(3)
+    stacked, comm, info = adapt_distributed(
+        mesh, DistOptions(niter=1, max_sweeps=3, nparts=2, hsiz=0.25,
+                          min_shard_elts=8, hgrad=None)
+    )
+    out = merge_adapted(stacked, comm)
+    rep = check_mesh(out)
+    assert rep.ok, str(rep)
+    assert int(out.ntet) > 162
