@@ -35,12 +35,11 @@ import time
 # an honest same-code same-day hardware ratio.
 CPU_ANCHOR_TPS = 2122.7
 # CPU anchor for the large workload (n=12, hsiz=0.04 -> ~200k tets):
-# 1,060.3 measured idle 2026-07-31 (round-3 tree); the round-4 tree
-# measured 878.5 under host contention — the idle round-3 figure is
-# kept as the honest anchor. The CPU halves its rate at this size
+# 200,512 tets in 175.7 s, measured idle 2026-08-01 on the round-4
+# tree (round 3: 1,060.3). The CPU halves its rate at this size
 # (working set leaves cache) while the TPU holds steady — the large
 # config is the representative point for the 10M-tet north star.
-CPU_ANCHOR_TPS_LARGE = 1060.3
+CPU_ANCHOR_TPS_LARGE = 1141.4
 # CPU anchor for the xl workload (n=14, hsiz=0.03, ~390k tets): the CPU
 # rate stays flat once out of cache (1,031 tets/s measured 2026-07-31
 # round 3; see PERF_NOTES.md)

@@ -184,6 +184,16 @@ HIST_COLS = (
 )
 
 
+def _hist_row(stats: "SweepStats", ne, npo):
+    """One int32 history row in HIST_COLS order — the single definition
+    shared by the fused while_loop and the unfused per-sweep branch."""
+    return jnp.stack([
+        stats.nsplit, stats.ncollapse, stats.nswap, stats.nmoved,
+        jnp.asarray(ne, jnp.int32), jnp.asarray(npo, jnp.int32),
+        stats.n_unique, stats.split_capped.astype(jnp.int32),
+    ])
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -247,10 +257,7 @@ def remesh_sweeps(
             & (nops <= converge_frac * jnp.maximum(ne, 1))
         )
         stop = converged | st.split_capped | overflow | near_cap
-        row = jnp.stack([
-            st.nsplit, st.ncollapse, st.nswap, st.nmoved,
-            ne, npo, st.n_unique, st.split_capped.astype(jnp.int32),
-        ])
+        row = _hist_row(st, ne, npo)
         hist = hist.at[k].set(row)
         return m, hist, k + 1, stop
 
@@ -539,12 +546,7 @@ def run_batched_sweep_loop(
                 mesh, ecap, noinsert=opts.noinsert, noswap=opts.noswap,
                 nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
             )
-            hist = jnp.stack([
-                stats.nsplit, stats.ncollapse, stats.nswap, stats.nmoved,
-                mesh.ntet.astype(jnp.int32),
-                mesh.npoin.astype(jnp.int32),
-                stats.n_unique, stats.split_capped.astype(jnp.int32),
-            ])[None, :]
+            hist = _hist_row(stats, mesh.ntet, mesh.npoin)[None, :]
             n = 1
         else:
             mesh, hist, n_done = remesh_sweeps(

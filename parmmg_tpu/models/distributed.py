@@ -150,11 +150,14 @@ def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions, hausd: float):
     from .adapt import UNFUSED_TCAP, _sweep_body
 
     # same fused/unfused dispatch as the single-shard engine: above
-    # UNFUSED_TCAP per-shard capacity, whole-program XLA scheduling
-    # costs hours (PERF_NOTES round 4) — vmapping the plain body keeps
-    # each constituent op its own (batched) compiled program, since the
-    # inner jits remain compile boundaries under vmap
-    body = _sweep_body if st.tet.shape[1] > UNFUSED_TCAP else remesh_sweep
+    # UNFUSED_TCAP TOTAL capacity, whole-program XLA scheduling costs
+    # hours (PERF_NOTES round 4). The vmapped program's shapes scale
+    # with nparts * per-shard tcap, so the guard compares the BATCHED
+    # size. Vmapping the plain body keeps each constituent op its own
+    # (batched) compiled program, since the inner jits remain compile
+    # boundaries under vmap.
+    total = st.tet.shape[0] * st.tet.shape[1]
+    body = _sweep_body if total > UNFUSED_TCAP else remesh_sweep
     fn = partial(
         body,
         ecap=ecap,

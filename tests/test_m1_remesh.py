@@ -235,19 +235,29 @@ def test_split_respects_required_triangles(cube_mesh_path):
 def test_unfused_sweep_path_matches(monkeypatch):
     """Above UNFUSED_TCAP the sweep runs per-op instead of as one fused
     program (whole-program XLA scheduling costs hours at large shapes on
-    TPU while per-op compiles cost seconds). The path must produce a
-    conforming unit mesh exactly like the fused one."""
+    TPU while per-op compiles cost seconds). Both dispatch paths run the
+    identical per-sweep math, so the final mesh and the per-sweep stats
+    must agree exactly."""
     import parmmg_tpu.models.adapt as A
     from parmmg_tpu.utils.gen import unit_cube_mesh
 
+    opts = A.AdaptOptions(hsiz=0.18, niter=1, max_sweeps=6, hgrad=None)
+    fused_out, fused_info = A.adapt(unit_cube_mesh(4), opts)
+
     monkeypatch.setattr(A, "UNFUSED_TCAP", 64)
-    mesh = unit_cube_mesh(4)
-    out, info = A.adapt(mesh, A.AdaptOptions(
-        hsiz=0.18, niter=1, max_sweeps=6, hgrad=None
-    ))
+    out, info = A.adapt(unit_cube_mesh(4), opts)
     rep = conformity.check_mesh(out)
     assert rep.ok, str(rep)
     assert int(out.ntet) > 500
     h = quality.quality_histogram(out)
     assert float(h.qavg) > 0.7
     assert len(info["history"]) >= 2  # one record per sweep
+
+    # path equivalence: same sweep count, same per-sweep stats, same
+    # final entity counts
+    keys = ("nsplit", "ncollapse", "nswap", "ne", "np")
+    f_hist = [tuple(r[k] for k in keys) for r in fused_info["history"]]
+    u_hist = [tuple(r[k] for k in keys) for r in info["history"]]
+    assert f_hist == u_hist
+    assert int(out.ntet) == int(fused_out.ntet)
+    assert int(out.npoin) == int(fused_out.npoin)
