@@ -101,19 +101,33 @@ def _sweep_body(
     nomove: bool = False,
     nosurf: bool = False,
     hausd: float = 0.01,
+    fused: bool = True,
+    phase_skip: bool = True,
 ):
-    """One sweep: split → collapse → swaps → smooth.
+    """One sweep: split → (collapse → swaps → smooth unless the sweep is
+    split-dominant).
 
     Compaction (the batched `MMG3D_pack`/`PMMG_packParMesh` analog) runs
     before operators that allocate, so live entities form array prefixes.
 
-    Called two ways: under the `remesh_sweep`/`remesh_sweeps` jit (ONE
-    fused device program — best runtime, but its XLA compile grows
-    super-linearly with the array shapes: >2h on the TPU tunnel at
-    ~850k-tet capacities), or DIRECTLY for large meshes, where each
-    constituent op runs as its own jitted program (measured: single ops
-    compile in seconds even at 5M rows — the blowup is whole-program
-    scheduling, not op codegen)."""
+    Phase-aware scheduling: while refinement is still bisecting
+    globally-long edges wholesale (split > ntet/10 this sweep and not
+    capacity-capped), the quality tail — collapse, swaps, smoothing,
+    ~70% of sweep cost — is skipped via `lax.cond`: each bisection round
+    halves edge lengths everywhere and the next sweep re-splits the same
+    regions, so interleaved quality passes buy nothing until lengths
+    approach the unit target. The serial kernel behaves the same way:
+    `MMG5_mmg3d1_delone`'s early passes are insertion-dominant, quality
+    effort ramps as `ns` falls (reference `src/libparmmg1.c:739`).
+
+    Called two ways: under the `remesh_sweep`/`remesh_sweeps` jit with
+    `fused=True` (ONE fused device program — best runtime, but its XLA
+    compile grows super-linearly with the array shapes: >2h on the TPU
+    tunnel at ~850k-tet capacities) — the phase skip is a `lax.cond`; or
+    DIRECTLY with `fused=False` for large meshes, where each constituent
+    op runs as its own jitted program and the skip is a host branch
+    (measured: single ops compile in seconds even at 5M rows — the
+    blowup is whole-program scheduling, not op codegen)."""
     mesh = compact(mesh)
     edges, emask, t2e, n_unique = adjacency.unique_edges(mesh, ecap)
     if not noinsert:
@@ -123,40 +137,94 @@ def _sweep_body(
         mesh = compact(mesh)
         edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
         n_unique = jnp.maximum(n_unique, nu)
+        # split-dominant growth detection: while refinement is still
+        # bisecting globally-long edges wholesale, collapse/swap/smooth
+        # (~70% of sweep cost) buy nothing — the next sweep re-splits
+        # the same regions. Quality ops resume once splitting tapers
+        # (or capacity capped the sweep, where coarsening may free
+        # room).
+        growth = (
+            (s_split.nsplit > jnp.maximum(64, mesh.ntet // 10))
+            & ~s_split.capped
+        )
     else:
         s_split = split.SplitStats(jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+        growth = jnp.bool_(False)
 
-    mesh, s_col = collapse.collapse_short_edges(
-        mesh, edges, emask, t2e, hausd=hausd, nosurf=nosurf
-    )
-    mesh = compact(mesh)
-    edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
-    n_unique = jnp.maximum(n_unique, nu)
-
-    if not noswap:
-        mesh, s_32 = swap.swap_32(mesh, edges, emask, t2e)
-        # swaps never delete vertices, so compact() keeps vertex ids and
-        # the post-collapse edge list stays valid: swap_23 uses it only
-        # for a conservative new-edge-exists check, and smoothing below
-        # tolerates approximate neighborhoods (its validity loop guards
-        # geometry) — two unique_edges re-sorts (~1/3 of sweep sort
-        # cost) skipped
-        mesh = adjacency.build_adjacency(compact(mesh))
-        mesh, s_23 = swap.swap_23(mesh, edges, emask)
+    def _quality_tail(mesh, edges, emask, t2e, n_unique):
+        mesh, s_col = collapse.collapse_short_edges(
+            mesh, edges, emask, t2e, hausd=hausd, nosurf=nosurf
+        )
         mesh = compact(mesh)
-        nswap = s_32.nswap32 + s_23.nswap23
-    else:
-        nswap = jnp.int32(0)
+        edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
+        n_unique = jnp.maximum(n_unique, nu)
 
-    if not nomove:
-        mesh, s_sm = smooth.smooth_vertices(mesh, edges, emask, nosurf=nosurf)
-        nmoved = s_sm.nmoved
+        if not noswap:
+            mesh, s_32 = swap.swap_32(mesh, edges, emask, t2e)
+            # swaps never delete vertices, so compact() keeps vertex ids
+            # and the post-collapse edge list stays valid: swap_23 uses
+            # it only for a conservative new-edge-exists check, and
+            # smoothing below tolerates approximate neighborhoods (its
+            # validity loop guards geometry) — two unique_edges re-sorts
+            # (~1/3 of sweep sort cost) skipped
+            mesh = adjacency.build_adjacency(compact(mesh))
+            mesh, s_23 = swap.swap_23(mesh, edges, emask)
+            mesh = compact(mesh)
+            nswap = s_32.nswap32 + s_23.nswap23
+        else:
+            nswap = jnp.int32(0)
+
+        if not nomove:
+            mesh, s_sm = smooth.smooth_vertices(
+                mesh, edges, emask, nosurf=nosurf
+            )
+            nmoved = s_sm.nmoved
+        else:
+            nmoved = jnp.int32(0)
+        # int32 regardless of jax_enable_x64: the skip branch of the
+        # phase cond emits int32 zeros and lax.cond requires identical
+        # branch output types
+        return (
+            mesh, jnp.asarray(s_col.ncollapse, jnp.int32),
+            jnp.asarray(nswap, jnp.int32), jnp.asarray(nmoved, jnp.int32),
+            n_unique,
+        )
+
+    if not phase_skip:
+        # distributed vmapped sweeps disable the skip on BOTH dispatch
+        # paths: a per-shard predicate is batched under vmap, where
+        # lax.cond lowers to select (both branches execute — no savings)
+        # while the unfused path cannot branch on it at all; running the
+        # tail unconditionally keeps the fused and unfused distributed
+        # paths result-equivalent across the UNFUSED_TCAP threshold
+        mesh, ncollapse, nswap, nmoved, n_unique = _quality_tail(
+            mesh, edges, emask, t2e, n_unique
+        )
+    elif fused:
+        mesh, ncollapse, nswap, nmoved, n_unique = jax.lax.cond(
+            growth,
+            lambda m, ed, em, te, nu: (
+                m, jnp.int32(0), jnp.int32(0), jnp.int32(0), nu
+            ),
+            _quality_tail,
+            mesh, edges, emask, t2e, n_unique,
+        )
     else:
-        nmoved = jnp.int32(0)
+        assert not isinstance(growth, jax.core.Tracer), (
+            "_sweep_body(fused=False, phase_skip=True) requires a "
+            "concrete growth predicate; under vmap/jit pass "
+            "phase_skip=False (tail runs unconditionally) or fused=True"
+        )
+        if bool(jax.device_get(growth)):
+            ncollapse = nswap = nmoved = jnp.int32(0)
+        else:
+            mesh, ncollapse, nswap, nmoved, n_unique = _quality_tail(
+                mesh, edges, emask, t2e, n_unique
+            )
 
     return mesh, SweepStats(
         nsplit=s_split.nsplit,
-        ncollapse=s_col.ncollapse,
+        ncollapse=ncollapse,
         nswap=nswap,
         nmoved=nmoved,
         n_unique=n_unique,
@@ -166,7 +234,10 @@ def _sweep_body(
 
 remesh_sweep = partial(
     jax.jit,
-    static_argnames=("ecap", "noinsert", "noswap", "nomove", "nosurf"),
+    static_argnames=(
+        "ecap", "noinsert", "noswap", "nomove", "nosurf", "fused",
+        "phase_skip",
+    ),
 )(_sweep_body)
 
 # above this tet capacity the sweep runs UNFUSED (per-op programs +
@@ -545,6 +616,7 @@ def run_batched_sweep_loop(
             mesh, stats = _sweep_body(
                 mesh, ecap, noinsert=opts.noinsert, noswap=opts.noswap,
                 nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
+                fused=False,
             )
             hist = _hist_row(stats, mesh.ntet, mesh.npoin)[None, :]
             n = 1

@@ -157,7 +157,8 @@ def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions, hausd: float):
     # (batched) compiled program, since the inner jits remain compile
     # boundaries under vmap.
     total = st.tet.shape[0] * st.tet.shape[1]
-    body = _sweep_body if total > UNFUSED_TCAP else remesh_sweep
+    unfused = total > UNFUSED_TCAP
+    body = _sweep_body if unfused else remesh_sweep
     fn = partial(
         body,
         ecap=ecap,
@@ -166,6 +167,14 @@ def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions, hausd: float):
         nomove=opts.nomove,
         nosurf=opts.nosurf,
         hausd=hausd,
+        fused=not unfused,
+        # per-shard growth predicates are batched under vmap: the skip
+        # would lower to select (both branches run) on the fused path
+        # and is inexpressible on the unfused one — disabled so both
+        # distributed paths stay result-equivalent (the single-shard
+        # engine keeps it; a global cross-shard growth decision would
+        # need the split phase and tail in separate vmapped calls)
+        phase_skip=False,
     )
     return jax.vmap(fn)(st)
 

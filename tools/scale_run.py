@@ -1,0 +1,54 @@
+"""Single-chip scale experiment: adapt a cube to a target hsiz and report
+throughput — the ladder toward the 10M-tet north star (BASELINE.json).
+
+Above UNFUSED_TCAP the sweep runs per-op (see UNFUSED_TCAP /
+run_batched_sweep_loop in models/adapt.py), so each
+XLA program stays small enough for the tunnel's compile helper; the
+persistent compile cache (.jax_cache/) makes reruns disk-hits.
+
+Usage: python tools/scale_run.py [n] [hsiz]
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 14
+    hsiz = float(sys.argv[2]) if len(sys.argv) > 2 else 0.03
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    bench._enable_compile_cache()
+    import jax
+
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.ops import quality
+
+    est = int(12.0 / hsiz**3)
+    print(f"n={n} hsiz={hsiz} est_out={est} platform="
+          f"{jax.devices()[0].platform}", flush=True)
+    mesh = bench._workload(n, hsiz)
+    print(f"input ne={int(mesh.ntet)} tcap={mesh.tcap} pcap={mesh.pcap}",
+          flush=True)
+    opts = AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=14, hgrad=None,
+                        verbose=2)
+    t0 = time.perf_counter()
+    out, info = adapt(mesh, opts)
+    wall = time.perf_counter() - t0
+    ne = int(out.ntet)
+    h = quality.quality_histogram(out)
+    rec = {
+        "metric": "tets_per_sec", "value": round(ne / wall, 1),
+        "unit": "tet/s", "ne": ne, "wall_s": round(wall, 2),
+        "platform": jax.devices()[0].platform,
+        "qmin": round(float(h.qmin), 5), "qavg": round(float(h.qavg), 5),
+    }
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

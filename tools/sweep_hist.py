@@ -1,0 +1,35 @@
+"""Print the per-sweep history of a bench workload (CPU or TPU) — which
+sweeps are split-dominant vs quality-dominant, to guide phase-aware
+scheduling of the sweep body."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    hsiz = float(sys.argv[2]) if len(sys.argv) > 2 else 0.05
+
+    bench._enable_compile_cache()
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+
+    mesh = bench._workload(n, hsiz)
+    opts = AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=12, hgrad=None)
+    t0 = time.perf_counter()
+    out, info = adapt(mesh, opts)
+    wall = time.perf_counter() - t0
+    print(f"wall={wall:.1f}s ne={int(out.ntet)}")
+    for r in info["history"]:
+        print(
+            f"it{r['iter']} sw{r['sweep']:2d}: split={r['nsplit']:6d} "
+            f"coll={r['ncollapse']:6d} swap={r['nswap']:6d} "
+            f"moved={r['nmoved']:6d} ne={r['ne']:7d} capped={r['capped']}"
+        )
+
+
+if __name__ == "__main__":
+    main()
