@@ -46,13 +46,20 @@ CPU_ANCHOR_TPS_LARGE = 1141.4
 CPU_ANCHOR_TPS_XL = 1031.0
 
 
+def est_out_tets(hsiz):
+    """Predicted output-tet count of a unit cube adapted to uniform
+    `hsiz` (~12 tets per hsiz^3 cell at Mmg-unit quality) — the single
+    sizing formula shared by the bench and the scaling tools."""
+    return int(12.0 / hsiz**3)
+
+
 def _workload(n, hsiz):
     """Mesh pre-sized so the whole adaptation stays in ONE capacity
     bucket: every kernel compiles exactly once (compile over the TPU
     tunnel costs minutes; execution costs seconds)."""
     from parmmg_tpu.utils.gen import unit_cube_mesh
 
-    est = int(12.0 / hsiz**3)
+    est = est_out_tets(hsiz)
     return unit_cube_mesh(
         n,
         tcap=int(est * 1.9),

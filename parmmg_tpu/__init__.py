@@ -26,5 +26,15 @@ def version_ge(major: int, minor: int) -> bool:
     return (VERSION_MAJOR, VERSION_MINOR) >= (major, minor)
 
 
+# multi-host runs (the mpirun -np analog): the coordination service
+# must come up before anything touches the XLA backend, and the heavy
+# imports below do — so the env-contract hook runs first
+import os as _os  # noqa: E402
+
+if _os.environ.get("PMMGTPU_COORDINATOR"):
+    from .parallel import multihost as _multihost  # noqa: E402
+
+    _multihost.init_from_env()
+
 from .core.mesh import Mesh  # noqa: E402,F401
 from .core import tags  # noqa: E402,F401

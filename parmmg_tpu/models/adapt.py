@@ -172,7 +172,9 @@ def _sweep_body(
             mesh = compact(mesh)
             nswap = s_32.nswap32 + s_23.nswap23
         else:
-            nswap = jnp.int32(0)
+            # varying zero (not a literal): under shard_map the cond
+            # branches must agree on varying-ness too
+            nswap = jnp.zeros_like(s_col.ncollapse)
 
         if not nomove:
             mesh, s_sm = smooth.smooth_vertices(
@@ -180,7 +182,7 @@ def _sweep_body(
             )
             nmoved = s_sm.nmoved
         else:
-            nmoved = jnp.int32(0)
+            nmoved = jnp.zeros_like(s_col.ncollapse)
         # int32 regardless of jax_enable_x64: the skip branch of the
         # phase cond emits int32 zeros and lax.cond requires identical
         # branch output types
@@ -190,22 +192,27 @@ def _sweep_body(
             n_unique,
         )
 
-    if not phase_skip:
+    if not phase_skip or noinsert:
         # distributed vmapped sweeps disable the skip on BOTH dispatch
         # paths: a per-shard predicate is batched under vmap, where
         # lax.cond lowers to select (both branches execute — no savings)
         # while the unfused path cannot branch on it at all; running the
         # tail unconditionally keeps the fused and unfused distributed
-        # paths result-equivalent across the UNFUSED_TCAP threshold
+        # paths result-equivalent across the UNFUSED_TCAP threshold.
+        # noinsert: growth is statically False (no splits) — no cond
         mesh, ncollapse, nswap, nmoved, n_unique = _quality_tail(
             mesh, edges, emask, t2e, n_unique
         )
     elif fused:
+        # skip-branch zeros derived from varying data (zeros_like of the
+        # split counter), not literals: under shard_map a literal
+        # jnp.int32(0) is unvarying over the shard axis while the tail
+        # branch outputs vary, and lax.cond rejects the branch-type
+        # mismatch
+        zero_c = (s_split.nsplit * 0).astype(jnp.int32)
         mesh, ncollapse, nswap, nmoved, n_unique = jax.lax.cond(
             growth,
-            lambda m, ed, em, te, nu: (
-                m, jnp.int32(0), jnp.int32(0), jnp.int32(0), nu
-            ),
+            lambda m, ed, em, te, nu: (m, zero_c, zero_c, zero_c, nu),
             _quality_tail,
             mesh, edges, emask, t2e, n_unique,
         )

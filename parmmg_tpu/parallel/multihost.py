@@ -1,0 +1,121 @@
+"""Multi-host (multi-process) runtime — the DCN axis of the scaling
+story.
+
+The reference scales across nodes with its MPI world (`mpirun -np N
+parmmg`; every entry point takes the communicator, e.g.
+`PMMG_Init_parMesh(PMMG_ARG_MPIComm, ...)` in `src/libparmmg.c`). The
+tpu-native equivalent is JAX's multi-controller runtime: each host
+process calls `jax.distributed.initialize`, after which `jax.devices()`
+returns the GLOBAL device list and every `shard_map` collective in
+`parallel/comm.py` / `parallel/migrate.py` transparently crosses the
+process boundary (ICI within a slice, DCN between slices — XLA picks
+the transport; no NCCL/MPI calls to port).
+
+Single-process runs are unaffected: `init_from_env()` is a no-op unless
+the coordination env vars are present, and `device_mesh()` already lays
+shards over whatever `jax.devices()` returns — local chips or a
+multi-host fleet.
+
+Env contract (mirrors `mpirun`'s rank/world interface):
+  PMMGTPU_COORDINATOR  host:port of process 0 (e.g. "10.0.0.1:9876")
+  PMMGTPU_NUM_PROCS    world size
+  PMMGTPU_PROC_ID      this process's rank, 0-based
+
+On TPU pods with the standard runtime metadata (GCE/Cloud TPU), plain
+`jax.distributed.initialize()` auto-discovers all three — set
+PMMGTPU_COORDINATOR=auto to use that path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+_INITIALIZED = False
+
+
+def init_from_env() -> bool:
+    """Initialize the multi-controller runtime from the env contract.
+
+    Returns True when running multi-process (after initialization),
+    False for plain single-process runs. Idempotent."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coord = os.environ.get("PMMGTPU_COORDINATOR")
+    if not coord:
+        return False
+    if coord == "auto":
+        jax.distributed.initialize()
+    else:
+        nprocs = os.environ.get("PMMGTPU_NUM_PROCS")
+        pid = os.environ.get("PMMGTPU_PROC_ID")
+        if nprocs is None or pid is None:
+            raise RuntimeError(
+                "multi-host env contract incomplete: "
+                f"PMMGTPU_COORDINATOR={coord!r} requires "
+                "PMMGTPU_NUM_PROCS (world size) and PMMGTPU_PROC_ID "
+                "(0-based rank) to be set as well"
+            )
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nprocs),
+            process_id=int(pid),
+        )
+    _INITIALIZED = True
+    return True
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def put_sharded_global(tree, dmesh):
+    """Place a host-resident stacked [D,...] pytree onto a device mesh
+    that may span processes.
+
+    Single-process `put_sharded` uses `jax.device_put`, which requires
+    an addressable sharding; across processes each controller owns only
+    its local shards, so every process passes the SAME full global
+    array (host phases are replicated-deterministic here — see
+    `models/distributed.py` module docstring) and the callback hands
+    each addressable device its global slice. NOT
+    `make_array_from_process_local_data`: that API interprets its
+    argument as this process's LOCAL rows, so passing the full array
+    silently double-counts shards."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .shard import AXIS
+
+    sh = NamedSharding(dmesh, P(AXIS))
+
+    def put(a):
+        a = np.asarray(a)
+        return jax.make_array_from_callback(a.shape, sh, lambda idx: a[idx])
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def gather_stacked(tree):
+    """Fetch a (possibly cross-process) stacked pytree to host numpy on
+    every process — the allgather that feeds the replicated host phases
+    (retag/analysis exchanges). Within one process this is a plain
+    device_get."""
+    if not is_multiprocess():
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    def fetch(a):
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            # replicates the global value on every process
+            return np.asarray(
+                multihost_utils.process_allgather(a, tiled=True)
+            )
+        # host numpy / fully-addressable leaves are already whole;
+        # process_allgather would CONCATENATE the per-process copies
+        # (doubling dim 0) instead of replicating
+        return np.asarray(jax.device_get(a))
+
+    return jax.tree_util.tree_map(fetch, tree)

@@ -1,0 +1,104 @@
+"""Worker for the 2-process multi-host test (test_m10_multihost.py).
+
+Each process owns 4 of the 8 CPU devices; the shard_map collectives
+(halo all_to_all, psum reductions) cross the process boundary over the
+coordination service — the same code path that rides DCN between TPU
+slices. Run only via the test, which sets the PMMGTPU_* env contract."""
+
+import sys
+
+
+def main():
+    # the package __init__ auto-initializes the multi-controller
+    # runtime from the PMMGTPU_* env (before any backend touch) — the
+    # same path `python -m parmmg_tpu` takes under a process launcher
+    from parmmg_tpu.parallel import multihost
+
+    assert multihost.init_from_env(), "PMMGTPU_* env not set"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from parmmg_tpu.ops import quality
+    from parmmg_tpu.parallel import comm as comm_mod
+    from parmmg_tpu.parallel.distribute import split_mesh
+    from parmmg_tpu.parallel.partition import sfc_partition
+    from parmmg_tpu.parallel.shard import (
+        AXIS, device_mesh, sharded_quality_histogram,
+    )
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    # identical host-side prep on every process (replicated determinism)
+    mesh = unit_cube_mesh(4)
+    np_global = int(mesh.npoin)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 8)))
+    st, comm = split_mesh(mesh, part, 8)
+
+    dmesh = device_mesh(8)
+    stg = multihost.put_sharded_global(st, dmesh)
+    cidx = multihost.put_sharded_global(comm.comm_idx, dmesh)
+    owner = multihost.put_sharded_global(comm.owner, dmesh)
+
+    # 1. global vertex count: sum of owned-vertex indicators, psum'd
+    #    across shards (and processes)
+    # 2. interface multiplicity: halo_sum of ones on every live vertex
+    #    must agree with the local copy count implied by comm_idx
+    def body(blk, cidx_blk, owner_blk):
+        m = jax.tree_util.tree_map(lambda a: a[0], blk)
+        ones = m.vmask.astype(jnp.float32)
+        mult = comm_mod.halo_sum(ones, cidx_blk[0], AXIS)
+        owned = jnp.sum(jnp.where(owner_blk[0] & m.vmask, 1.0, 0.0))
+        total = jax.lax.psum(owned, AXIS)
+        chks = jax.lax.psum(jnp.sum(mult * ones), AXIS)
+        return total, chks
+
+    total, chks = jax.jit(
+        jax.shard_map(
+            body, mesh=dmesh,
+            in_specs=(P(AXIS), P(AXIS), P(AXIS)),
+            out_specs=(P(), P()),
+        )
+    )(stg, cidx, owner)
+    total = float(jax.device_get(total))
+    chks = float(jax.device_get(chks))
+    assert total == float(np_global), (total, np_global)
+    # expected halo multiplicity checksum, from host connectivity: a
+    # vertex held by c shards reads back c on each of its c copies
+    # (non-interface vertices keep their own 1)
+    vg = np.asarray(st.vglob)
+    vm = np.asarray(st.vmask)
+    cnt = np.bincount(vg[vm].astype(np.int64))
+    expected = float(np.sum(np.where(cnt > 1, cnt * cnt, cnt)))
+    assert chks == expected, (chks, expected)
+
+    # gather_stacked: the cross-process allgather that feeds replicated
+    # host phases must reproduce the host-side stacked arrays exactly
+    back = multihost.gather_stacked(stg)
+    np.testing.assert_array_equal(
+        np.asarray(back.vglob), np.asarray(st.vglob)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(back.tet), np.asarray(st.tet)
+    )
+
+    h = sharded_quality_histogram(stg, dmesh)
+    ne = int(jax.device_get(h.ne))
+    qmin = float(jax.device_get(h.qmin))
+    qavg = float(jax.device_get(h.qavg))
+    assert ne == int(mesh.ntet), (ne, int(mesh.ntet))
+
+    print(
+        f"MULTIHOST_OK proc={jax.process_index()} total={total} "
+        f"chks={chks} ne={ne} qmin={qmin:.6f} qavg={qavg:.6f}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

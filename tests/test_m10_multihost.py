@@ -1,0 +1,77 @@
+"""M10: multi-host (multi-process) collectives — the DCN scaling axis.
+
+The reference runs one MPI rank per node and exchanges over the
+network (`mpirun -np N parmmg`); here two OS processes each own 4 of 8
+CPU devices and the shard_map collectives (halo all_to_all, psum)
+cross the process boundary through JAX's multi-controller runtime —
+the exact code path that rides DCN between TPU slices
+(`parallel/multihost.py`). This is a REAL multi-process run, not a
+simulation: the two workers coordinate over gRPC and each executes
+only its addressable half of the global program."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_two_process_collectives(tmp_path):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "multihost_worker.py")
+
+    # ephemeral coordinator port: a hardcoded one collides with
+    # lingering workers from aborted runs or parallel test sessions
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    def env_for(pid):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PYTHONPATH=root,
+            PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
+            PMMGTPU_NUM_PROCS="2",
+            PMMGTPU_PROC_ID=str(pid),
+        )
+        return env
+
+    procs = []
+    logs = []
+    for pid in (0, 1):
+        log = open(tmp_path / f"proc{pid}.log", "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env_for(pid),
+            stdout=log, stderr=subprocess.STDOUT, cwd=root,
+        ))
+    try:
+        for p in procs:
+            assert p.wait(timeout=900) == 0, (
+                (tmp_path / "proc0.log").read_text()
+                + (tmp_path / "proc1.log").read_text()
+            )
+    finally:
+        for log in logs:
+            log.close()
+        for p in procs:
+            p.kill()
+
+    lines = []
+    for pid in (0, 1):
+        text = (tmp_path / f"proc{pid}.log").read_text()
+        ok = [ln for ln in text.splitlines() if "MULTIHOST_OK" in ln]
+        assert ok, text
+        lines.append(ok[0])
+    # both controllers computed identical replicated reductions
+    strip = [
+        " ".join(t for t in ln.split() if not t.startswith("proc="))
+        for ln in lines
+    ]
+    assert strip[0] == strip[1], lines

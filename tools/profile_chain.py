@@ -8,8 +8,11 @@ device compute. Usage:
     python tools/profile_chain.py [n] [hsiz] [R]
 """
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -24,27 +27,19 @@ def main():
     from parmmg_tpu.core.mesh import compact
     from parmmg_tpu.models.adapt import AdaptOptions, adapt
     from parmmg_tpu.ops import analysis, collapse, smooth, split, swap
-    from parmmg_tpu.utils.gen import unit_cube_mesh
 
     print(f"platform: {jax.devices()[0].platform}", flush=True)
     if jax.devices()[0].platform == "tpu":
         # share bench.py's persistent compile cache (tunnel compiles
         # cost minutes; disk hits cost <1s). CPU-unsafe, TPU only.
-        import os as _os
-        import sys as _sys
-
-        _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
-            _os.path.abspath(__file__))))
         from bench import _enable_compile_cache
 
         _enable_compile_cache()
-    est = int(12.0 / hsiz**3)
-    mesh = unit_cube_mesh(
-        n,
-        tcap=int(est * 1.9),
-        pcap=max(int(est * 0.45), 4096),
-        fcap=max(int(est * 0.30), 4096),
-    )
+    import bench
+
+    # the bench's own workload recipe (shared sizing formula + capacity
+    # multipliers) so profiled shapes match benchmarked ones exactly
+    mesh = bench._workload(n, hsiz)
     t0 = time.perf_counter()
     mesh, _ = adapt(mesh, AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=8,
                                        hgrad=None))

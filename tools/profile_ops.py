@@ -8,8 +8,11 @@ Produces the PERF_NOTES.md table. Usage:
     python tools/profile_ops.py [n] [hsiz] [reps]
 """
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -34,16 +37,13 @@ def main():
     from parmmg_tpu.core.mesh import compact
     from parmmg_tpu.models.adapt import AdaptOptions, adapt
     from parmmg_tpu.ops import analysis, collapse, smooth, split, swap
-    from parmmg_tpu.utils.gen import unit_cube_mesh
 
     print(f"platform: {jax.devices()[0].platform}", flush=True)
-    est = int(12.0 / hsiz**3)
-    mesh = unit_cube_mesh(
-        n,
-        tcap=int(est * 1.9),
-        pcap=max(int(est * 0.45), 4096),
-        fcap=max(int(est * 0.30), 4096),
-    )
+    import bench
+
+    # the bench's own workload recipe (shared sizing formula + capacity
+    # multipliers) so profiled shapes match benchmarked ones exactly
+    mesh = bench._workload(n, hsiz)
     # reach steady state: one adaptation pass
     t0 = time.perf_counter()
     mesh, _ = adapt(mesh, AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=8,
