@@ -684,11 +684,23 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     # pathological meshes can exceed 1.6x — grown on overflow
     emult = [1.6]
 
+    def _phase(name):
+        # progress marker per setup phase: jit COMPILATION is host-
+        # synchronous, so on remote backends (where a single compile can
+        # take minutes) these lines are the only liveness signal before
+        # the first sweep prints — watchdogs key off them
+        if opts.verbose >= 2:
+            print(f"  ## phase: {name}", flush=True)
+
     mesh = ensure_capacity(mesh, opts)
+    _phase("analysis")
     mesh = analysis.analyze(mesh, ang=opts.angle, opnbdy=opts.opnbdy)
+    _phase("metric")
     mesh = prepare_metric(mesh, opts, int(mesh.tcap * emult[0]) + 64)
     hausd = local_hausd_table(mesh, opts, resolve_hausd(mesh, opts))
+    _phase("input histogram")
     h0 = quality.quality_histogram(mesh)
+    _phase("sweeps")
 
     # pre-size capacities for the predicted unit mesh so sweeps compile
     # once instead of once per growth bucket. Presizing is an

@@ -1,0 +1,29 @@
+"""Shared bits for the tools/ scripts: flag-aware argv parsing and the
+repo bootstrap (single definition so parsing bugs can't fork between
+tools)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def parse_argv(argv):
+    """Split argv into (positionals, {flag: value}). Every `--flag`
+    consumes the next token as its value, so flag values are never
+    mistaken for positionals (`--stall 900` must not become n=900)."""
+    pos, flags = [], {}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--"):
+            if i + 1 >= len(argv):
+                raise SystemExit(f"flag {a} needs a value")
+            flags[a[2:]] = argv[i + 1]
+            i += 2
+        else:
+            pos.append(a)
+            i += 1
+    return pos, flags

@@ -2,24 +2,34 @@
 throughput — the ladder toward the 10M-tet north star (BASELINE.json).
 
 Above UNFUSED_TCAP the sweep runs per-op (see UNFUSED_TCAP /
-run_batched_sweep_loop in models/adapt.py), so each
-XLA program stays small enough for the tunnel's compile helper; the
-persistent compile cache (.jax_cache/) makes reruns disk-hits.
+run_batched_sweep_loop in models/adapt.py), so each XLA program stays
+small enough for the tunnel's compile helper; the persistent compile
+cache (.jax_cache/) makes reruns disk-hits.
 
-Usage: python tools/scale_run.py [n] [hsiz]
+The tunnel's remote-compile RPC can silently die mid-request (observed:
+"response body closed before all bytes were read", and hangs with no
+client-side timeout — a 21 s compile once sat for 100+ min on a dead
+connection). The driver mode therefore runs the measurement in a worker
+subprocess under a STALL WATCHDOG: no stdout progress for --stall
+seconds → kill and relaunch. Retries are monotonic ONLY if --stall
+exceeds the longest single compile (a kill mid-compile caches nothing);
+the measured worst case is split_long_edges at ~1250 s for ~850k-tet
+capacities (PERF_NOTES.md), hence the default. Pre-warm with
+tools/warm_ops.py to make attempts cheap.
+
+Usage: python tools/scale_run.py [n] [hsiz] [--stall S] [--retries R]
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
 
-def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 14
-    hsiz = float(sys.argv[2]) if len(sys.argv) > 2 else 0.03
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+def worker(n, hsiz):
     import bench
 
     bench._enable_compile_cache()
@@ -52,6 +62,72 @@ def main():
         "qmin": round(float(h.qmin), 5), "qavg": round(float(h.qavg), 5),
     }
     print(json.dumps(rec), flush=True)
+
+
+def drive(n, hsiz, stall, retries):
+    """Run the worker under the stall watchdog. Returns the final JSON
+    record line, or None."""
+    for attempt in range(retries):
+        print(f"## attempt {attempt + 1}/{retries}", flush=True)
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             str(n), str(hsiz)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=REPO,
+        )
+        os.set_blocking(p.stdout.fileno(), False)
+        last_out = time.time()
+        buf = ""
+        rec = None
+
+        def consume(chunk):
+            nonlocal buf, rec
+            buf += chunk.decode("utf-8", errors="replace")
+            while "\n" in buf:
+                line, buf = buf.split("\n", 1)
+                print(line, flush=True)
+                if line.startswith("{"):
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        pass
+
+        while True:
+            chunk = p.stdout.read()  # None when no data (non-blocking)
+            if chunk:
+                last_out = time.time()
+                consume(chunk)
+            if p.poll() is not None:
+                # final drain: output written between the last read and
+                # exit (typically the JSON record itself) must not drop
+                os.set_blocking(p.stdout.fileno(), True)
+                consume(p.stdout.read() or b"")
+                break
+            if time.time() - last_out > stall:
+                print(f"## stall: no output for {stall}s, killing "
+                      "(compile cache keeps completed work)", flush=True)
+                p.kill()
+                p.wait()
+                break
+            time.sleep(5)
+        if rec is not None:
+            return rec
+    return None
+
+
+def main():
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--worker":
+        worker(int(argv[1]), float(argv[2]))
+        return
+    pos, flags = parse_argv(argv)
+    n = int(pos[0]) if pos else 14
+    hsiz = float(pos[1]) if len(pos) > 1 else 0.03
+    stall = int(flags.get("stall", 1500))
+    retries = int(flags.get("retries", 6))
+    rec = drive(n, hsiz, stall, retries)
+    if rec is None:
+        print("## all attempts stalled", flush=True)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

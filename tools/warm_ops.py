@@ -1,0 +1,112 @@
+"""Warm the persistent compile cache for the unfused sweep ops at a
+given workload shape, ONE op per subprocess.
+
+The tunnel's remote-compile RPC can hang (no client timeout); compiling
+each op in its own watchdogged subprocess means a hang loses one op's
+attempt, not the whole chain, and every completed compile lands in
+.jax_cache for the real run.
+
+Exits nonzero (with a summary) if any op never warmed — a scripted
+`warm_ops && scale_run` must not proceed into the cold-compile
+livelock on a half-warm cache.
+
+Usage: python tools/warm_ops.py [n] [hsiz] [--stall S]
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
+
+OPS = [
+    "compact", "unique_edges", "split", "collapse", "swap32",
+    "build_adjacency", "swap23", "smooth", "histogram",
+]
+
+
+def worker(n, hsiz, op):
+    import bench
+
+    bench._enable_compile_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from parmmg_tpu.core import adjacency
+    from parmmg_tpu.core.mesh import compact
+    from parmmg_tpu.models.adapt import AdaptOptions
+    from parmmg_tpu.ops import collapse, quality, smooth, split, swap
+
+    mesh = bench._workload(n, hsiz)
+    ecap = int(mesh.tcap * 1.6) + 64
+    mesh = compact(mesh)
+    if op == "compact":
+        jax.block_until_ready(mesh.tet)
+        return
+    edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
+    if op == "unique_edges":
+        jax.block_until_ready(edges)
+        return
+    if op == "split":
+        out, _ = split.split_long_edges(mesh, edges, emask, t2e)
+    elif op == "collapse":
+        out, _ = collapse.collapse_short_edges(mesh, edges, emask, t2e)
+    elif op == "swap32":
+        out, _ = swap.swap_32(mesh, edges, emask, t2e)
+    elif op == "build_adjacency":
+        out = adjacency.build_adjacency(mesh)
+    elif op == "swap23":
+        out = adjacency.build_adjacency(mesh)
+        out, _ = swap.swap_23(out, edges, emask)
+    elif op == "smooth":
+        out, _ = smooth.smooth_vertices(mesh, edges, emask)
+    elif op == "histogram":
+        out = quality.quality_histogram(mesh)
+    else:
+        raise SystemExit(f"unknown op {op}")
+    jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+
+
+def main():
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--worker":
+        worker(int(argv[1]), float(argv[2]), argv[3])
+        return
+    pos, flags = parse_argv(argv)
+    n = int(pos[0]) if pos else 14
+    hsiz = float(pos[1]) if len(pos) > 1 else 0.03
+    # above the measured worst single-op compile (~1250 s for split at
+    # ~850k-tet capacities): a timeout below it livelocks — a killed
+    # compile caches nothing
+    stall = int(flags.get("stall", 1800))
+    failed = []
+    for op in OPS:
+        ok = False
+        for attempt in (1, 2, 3):
+            t0 = time.time()
+            try:
+                rc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--worker", str(n), str(hsiz), op],
+                    timeout=stall, cwd=REPO,
+                ).returncode
+            except subprocess.TimeoutExpired:
+                print(f"{op}: attempt {attempt} TIMED OUT at {stall}s",
+                      flush=True)
+                continue
+            print(f"{op}: rc={rc} in {round(time.time() - t0, 1)}s",
+                  flush=True)
+            if rc == 0:
+                ok = True
+                break
+        if not ok:
+            failed.append(op)
+    if failed:
+        print(f"## NOT WARMED: {failed}", flush=True)
+        sys.exit(1)
+    print("## all ops warmed", flush=True)
+
+
+if __name__ == "__main__":
+    main()
