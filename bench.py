@@ -154,7 +154,8 @@ def main():
     its budget are on the record. The per-attempt caps assume a warm
     persistent compile cache (pre-warmed in-round; see
     _enable_compile_cache): a cache-hit TPU run finishes in ~1-3 min.
-    Worst-case time to FIRST line: 1200 + 480 = 1680 s.
+    Worst-case time to FIRST line: 900 + 1200 + 600 = 2700 s (every
+    attempt timing out); warm-cache time to first line ~250 s.
     """
     if "--worker" in sys.argv:
         cfg = json.loads(sys.argv[-1])
@@ -162,16 +163,26 @@ def main():
         return
 
     # 1. default workload on TPU, tight cap: the must-land line
-    rec = _attempt(dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 1200)
+    rec = _attempt(dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 900)
+    if rec is None or rec.get("platform") != "tpu":
+        # Cold compile cache: the fused-sweep program alone can exceed
+        # the cap. The per-op (unfused) path compiles in small pieces —
+        # each lands in the persistent cache, so even a timed-out
+        # attempt makes the next one cheaper. Slightly slower execution
+        # (per-sweep dispatch), far cheaper compile: the cold-cache
+        # TPU line of last resort.
+        rec2 = _attempt(dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 1200,
+                        {"PARMMG_UNFUSED_TCAP": "0"})
+        rec = rec2 if rec2 is not None else rec
     if rec is not None and rec.get("platform") == "tpu":
         print(json.dumps(rec), flush=True)
     else:
-        # tunnel unusable. If attempt 1 silently fell back to the CPU
+        # tunnel unusable. If an attempt silently fell back to the CPU
         # backend its measurement is still honest (labeled via
         # "platform") — keep it rather than re-running; re-run on CPU
-        # only when attempt 1 produced nothing at all.
+        # only when the TPU attempts produced nothing at all.
         cpu = rec if rec is not None else _attempt(
-            dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 480,
+            dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 600,
             {"JAX_PLATFORMS": "cpu"})
         print(json.dumps(cpu) if cpu is not None else json.dumps({
             "metric": "tets_per_sec", "value": 0.0, "unit": "tet/s",

@@ -12,6 +12,7 @@ else is one fused jitted sweep.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import List, NamedTuple, Optional
 
@@ -251,8 +252,9 @@ remesh_sweep = partial(
 # per-sweep host loop): whole-program XLA scheduling at such shapes
 # costs hours on the tunnel, while per-op compiles cost seconds and
 # the extra dispatch round trips (~115 ms each) are noise against the
-# multi-second sweeps of meshes this size
-UNFUSED_TCAP = 600_000
+# multi-second sweeps of meshes this size. Overridable so a cold-cache
+# bench can force the cheap-to-compile per-op path (PARMMG_UNFUSED_TCAP=0).
+UNFUSED_TCAP = int(os.environ.get("PARMMG_UNFUSED_TCAP", 600_000))
 
 
 # history columns of remesh_sweeps: one int32 row per executed sweep

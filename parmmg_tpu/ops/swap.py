@@ -50,14 +50,22 @@ def swap_32(
     emask: jax.Array,
     t2e: jax.Array,
 ):
-    """3-2 edge swap sweep. Mesh must be compacted; adjacency left stale."""
+    """3-2 edge swap sweep. Mesh must be compacted; adjacency left stale.
+
+    Like swap_23, the heavy work (candidate-tet quality/volume, tet
+    membership sort, winner selection, apply) runs on a COMPACTED
+    worst-shell-first candidate set: the full-table phase is only the
+    per-edge shell reductions (single-column scatters over the 6*TC
+    (tet, edge-slot) pairs), which also record the three shell tet ids
+    {min, sum-min-max, max of slot} so the compacted rows address their
+    arena directly instead of re-scanning the t2e table. Overflowing
+    candidates (only in violent early sweeps) are the best-quality
+    shells and are retried next sweep."""
     ecap = edges.shape[0]
     tcap = mesh.tcap
     tet, tmask = mesh.tet, mesh.tmask
-    a, b = edges[:, 0], edges[:, 1]
 
     live_e = (t2e >= 0) & tmask[:, None]
-    safe_t2e = jnp.where(live_e, t2e, 0)
     flat_e = jnp.where(live_e, t2e, ecap).reshape(-1)
 
     surf = common.surface_edge_mask(mesh, edges, emask)
@@ -65,9 +73,8 @@ def swap_32(
     # Ring vertices: for edge slot k of a tet, the two OFF-edge local
     # corners are known statically (complement of EDGE_VERTS[k]) — no
     # comparisons, and each per-edge reduction is one single-column
-    # scatter (six passes replace the fifteen of the per-corner loop;
-    # single-column because TPU lowers multi-column scatter-combines
-    # ~8x slower than the same data split per column).
+    # scatter (single-column because TPU lowers multi-column
+    # scatter-combines ~8x slower than the same data split per column).
     OFF = jnp.asarray(
         [[2, 3], [1, 3], [1, 2], [0, 3], [0, 2], [0, 1]], jnp.int32
     )
@@ -91,10 +98,19 @@ def swap_32(
     shell_min_q = jnp.full(ecap, jnp.inf, mesh.vert.dtype).at[flat_e].min(
         jnp.broadcast_to(q_old[:, None], (tcap, 6)).reshape(-1), mode="drop"
     )
+    # shell tet ids by slot rank: {min, sum-min-max, max} of the (==3)
+    # incident tet slots — same one-scatter trick as the ring vertices
+    slot6 = jnp.broadcast_to(
+        jnp.arange(tcap, dtype=jnp.int32)[:, None], (tcap, 6)
+    ).reshape(-1)
+    smin = jnp.full(ecap, tcap, jnp.int32).at[flat_e].min(slot6, mode="drop")
+    smax = jnp.full(ecap, -1, jnp.int32).at[flat_e].max(slot6, mode="drop")
+    ssum = jnp.zeros(ecap, jnp.int32).at[flat_e].add(slot6, mode="drop")
     v = ring_sum // 2 - u - w
 
     ok_ring = (u >= 0) & (v >= 0) & (w >= 0) & (u != v) & (v != w) & (u != w)
-    cand = (
+    a, b = edges[:, 0], edges[:, 1]
+    cand_pre = (
         emask
         & (inc == 3)
         & ~surf
@@ -105,26 +121,34 @@ def swap_32(
         & ((mesh.vtag[b] & tags.PARBDY) == 0)
     )
 
-    # new configuration
-    t1 = _oriented(jnp.stack([u, v, w, a], axis=1), mesh.vert)
-    t2_ = _oriented(jnp.stack([u, w, v, b], axis=1), mesh.vert)
+    # compact, worst shell first
+    K = min(ecap, max(256, ecap // 8))
+    sortkey = jnp.where(cand_pre, shell_min_q, jnp.inf)
+    pick = jnp.argsort(sortkey)[:K].astype(jnp.int32)
+    valid = cand_pre[pick]
+    ak, bk = a[pick], b[pick]
+    uk, vk, wk_ = u[pick], v[pick], w[pick]
+    s0 = jnp.clip(smin[pick], 0, tcap - 1)
+    s2 = jnp.clip(smax[pick], 0, tcap - 1)
+    s1 = jnp.clip(ssum[pick] - smin[pick] - smax[pick], 0, tcap - 1)
+    shell_q = shell_min_q[pick]
+
+    # new configuration (compacted rows only)
+    t1 = _oriented(jnp.stack([uk, vk, wk_, ak], axis=1), mesh.vert)
+    t2_ = _oriented(jnp.stack([uk, wk_, vk, bk], axis=1), mesh.vert)
     q1 = common.quality_of(mesh.vert, mesh.met, t1)
     q2 = common.quality_of(mesh.vert, mesh.met, t2_)
     v1 = common.vol_of(mesh.vert, t1)
     v2 = common.vol_of(mesh.vert, t2_)
     # volume conservation rejects non-convex shells whose new tets are
-    # individually positive but overlap outside the old shell (each tet
-    # has exactly one slot matching this edge, so the scatter counts each
-    # shell tet once)
-    shell_vol = jnp.zeros(ecap, vol_all.dtype).at[flat_e].add(
-        jnp.broadcast_to(vol_all[:, None], (tcap, 6)).reshape(-1), mode="drop"
-    )
+    # individually positive but overlap outside the old shell
+    shell_vol = vol_all[s0] + vol_all[s1] + vol_all[s2]
     new_min = jnp.minimum(q1, q2)
     pos_frac, cons_tol = common.vol_tols(mesh.dtype)
     vref = jnp.maximum(shell_vol, 1e-30)
     conserve = jnp.abs((v1 + v2) - shell_vol) <= cons_tol * vref
     gain_ok = (
-        (new_min > GAIN * shell_min_q)
+        (new_min > GAIN * shell_q)
         & (v1 > pos_frac * vref)
         & (v2 > pos_frac * vref)
         & conserve
@@ -133,60 +157,61 @@ def swap_32(
     tet_keys = jnp.where(tmask[:, None], jnp.sort(tet, axis=1), -1)
     exists = common.sorted_membership(
         tet_keys,
-        jnp.concatenate([jnp.sort(t1, axis=1), jnp.sort(t2_, axis=1)]),
+        jnp.concatenate([
+            jnp.sort(jnp.where(valid[:, None], t1, -1), axis=1),
+            jnp.sort(jnp.where(valid[:, None], t2_, -1), axis=1),
+        ]),
         bound=mesh.pcap,
     )
-    cand = cand & gain_ok & ~exists[:ecap] & ~exists[ecap:]
+    cand = valid & gain_ok & ~exists[:K] & ~exists[K:]
 
-    # --- arena = the 3 shell tets -----------------------------------------
+    # --- arena = the 3 shell tets (addressed directly) --------------------
     def scatter_arena(vals):
-        v6 = jnp.where(live_e, vals[safe_t2e], -jnp.inf)
-        return jnp.max(v6, axis=1)
+        out = jnp.full(tcap, -jnp.inf, vals.dtype)
+        out = out.at[s0].max(vals, mode="drop")
+        out = out.at[s1].max(vals, mode="drop")
+        out = out.at[s2].max(vals, mode="drop")
+        return out
 
     def gather_arena(av):
-        out = jnp.full(ecap, -jnp.inf, av.dtype)
-        return out.at[flat_e].max(
-            jnp.broadcast_to(av[:, None], (tcap, 6)).reshape(-1), mode="drop"
-        )
+        return jnp.maximum(jnp.maximum(av[s0], av[s1]), av[s2])
 
-    win = common.two_phase_winners(new_min - shell_min_q, cand,
+    win = common.two_phase_winners(new_min - shell_q, cand,
                                    scatter_arena, gather_arena)
 
-    # per-tet winner edge (<=1 by arena property)
-    w6 = jnp.where(live_e, win[safe_t2e], False)
-    has = jnp.any(w6, axis=1) & tmask
-    k = jnp.argmax(w6, axis=1)
-    e_t = jnp.where(has, safe_t2e[jnp.arange(tcap), k], -1)
-
-    # rank shell tets of each winner by slot id
-    slot = jnp.arange(tcap, dtype=jnp.int32)
-    smin = jnp.full(ecap, tcap, jnp.int32).at[
-        jnp.where(has, e_t, ecap)
-    ].min(slot, mode="drop")
-    smax = jnp.full(ecap, -1, jnp.int32).at[
-        jnp.where(has, e_t, ecap)
-    ].max(slot, mode="drop")
-    e_ts = jnp.maximum(e_t, 0)
-    rank0 = has & (slot == smin[e_ts])
-    rank2 = has & (slot == smax[e_ts])
-    rank1 = has & ~rank0 & ~rank2
-
-    tet_new = jnp.where(rank0[:, None], t1[e_ts], tet)
-    tet_new = jnp.where(rank1[:, None], t2_[e_ts], tet_new)
-    tmask_new = tmask & ~rank2
+    # apply: t1 overwrites the min-slot shell tet, t2 the middle one,
+    # the max-slot one dies. Arena exclusivity makes every target tet
+    # belong to exactly one winner, so the unique-indices promise holds.
+    tgt0 = common.unique_oob(win, s0, tcap)
+    tgt1 = common.unique_oob(win, s1, tcap)
+    tet_new = common.scatter_rows(tet, tgt0, t1, unique=True)
+    tet_new = common.scatter_rows(tet_new, tgt1, t2_, unique=True)
+    tgt2 = common.unique_oob(win, s2, tcap)
+    tmask_new = tmask.at[tgt2].set(False, mode="drop", unique_indices=True)
 
     # duplicate post-check (cross-swap interactions)
     dup = common.duplicate_tets(tet_new, tmask_new, bound=mesh.pcap)
-    bad_e = jnp.zeros(ecap, bool).at[
-        jnp.where(dup & has, e_t, ecap)
-    ].max(True, mode="drop")
-    win = win & ~bad_e
-    wk = win[e_ts] & has
-    tet_out = jnp.where((rank0 & wk)[:, None], t1[e_ts], tet)
-    tet_out = jnp.where((rank1 & wk)[:, None], t2_[e_ts], tet_out)
-    tmask_out = tmask & ~(rank2 & wk)
+    bad = (dup[s0] | dup[s1] | dup[s2]) & win
+    win2 = win & ~bad
 
-    nswap = jnp.sum(win.astype(jnp.int32))
+    def rebuild(_):
+        g0 = common.unique_oob(win2, s0, tcap)
+        g1 = common.unique_oob(win2, s1, tcap)
+        g2 = common.unique_oob(win2, s2, tcap)
+        t_o = common.scatter_rows(tet, g0, t1, unique=True)
+        t_o = common.scatter_rows(t_o, g1, t2_, unique=True)
+        tm_o = tmask.at[g2].set(False, mode="drop", unique_indices=True)
+        return t_o, tm_o
+
+    def keep(_):
+        return tet_new, tmask_new
+
+    if common._split_scatter_cols():
+        tet_out, tmask_out = jax.lax.cond(jnp.any(bad), rebuild, keep, None)
+    else:
+        tet_out, tmask_out = rebuild(None)
+
+    nswap = jnp.sum(win2.astype(jnp.int32))
     out = mesh.replace(tet=tet_out, tmask=tmask_out)
     return out, SwapStats(nswap32=nswap, nswap23=jnp.int32(0))
 
