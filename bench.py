@@ -173,7 +173,12 @@ def main():
         # TPU line of last resort.
         rec2 = _attempt(dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 1200,
                         {"PARMMG_UNFUSED_TCAP": "0"})
-        rec = rec2 if rec2 is not None else rec
+        if rec2 is not None and (
+            rec is None
+            or rec2.get("platform") == "tpu"
+            or rec2.get("value", 0.0) > rec.get("value", 0.0)
+        ):
+            rec = rec2
     if rec is not None and rec.get("platform") == "tpu":
         print(json.dumps(rec), flush=True)
     else:

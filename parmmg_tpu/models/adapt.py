@@ -72,6 +72,13 @@ class AdaptOptions:
     opnbdy: bool = False
     # convergence: stop sweeping when ops this sweep < frac * ntet
     converge_frac: float = 0.005
+    # post-convergence polish: up to this many quality-only sweeps
+    # (no insertion), keeping the best histogram — the convergence
+    # threshold can strand a few hundred improving ops (a 0.10-class
+    # sliver among ~94k tets) and single sweeps jitter the min
+    # non-monotonically, so each result is kept only when
+    # (qmin, -worst-bin, qavg) improves lexicographically
+    polish_sweeps: int = 2
     # capacity management
     grow_trigger: float = 0.85
     grow_factor: float = 1.6
@@ -674,6 +681,53 @@ def run_batched_sweep_loop(
     return mesh
 
 
+def _hist_key(h):
+    """Lexicographic goodness of a quality histogram: floor first, then
+    a thin worst bin, then the average."""
+    return (float(h.qmin), -int(h.counts[0]), float(h.qavg))
+
+
+def _polish(mesh: Mesh, opts: AdaptOptions, emult, hausd: float) -> Mesh:
+    """Post-convergence quality-only polish (single-shard path).
+
+    The convergence threshold (`converge_frac`) can stop the sweep loop
+    with a few hundred improving collapse/swap/smooth ops still
+    available — enough to strand one 0.10-class sliver in a ~94k-tet
+    mesh. Runs up to `polish_sweeps` insertion-free sweeps on the
+    per-op (unfused) dispatch path and keeps each result only if the
+    histogram improves — the floor never regresses. The reference's
+    serial kernel ends every wave with the same quality-only ops
+    (`MMG5_mmg3d1_delone` final passes, `src/libparmmg1.c:739`)."""
+    if opts.polish_sweeps <= 0 or (opts.noswap and opts.nomove):
+        return mesh
+    from ..ops import quality as quality_mod
+
+    def snap(m):
+        # the sweep ops donate their input buffers (compact & friends),
+        # so the kept-best state must be a real copy
+        return jax.tree_util.tree_map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, m
+        )
+
+    best_h = quality_mod.quality_histogram(mesh)
+    best = snap(mesh)
+    cur = mesh
+    ecap = int(mesh.tcap * emult[0]) + 64
+    for _ in range(opts.polish_sweeps):
+        cur, st = _sweep_body(
+            cur, ecap, noinsert=True, noswap=opts.noswap,
+            nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
+            fused=False, phase_skip=False,
+        )
+        h = quality_mod.quality_histogram(cur)
+        nops = int(st.ncollapse) + int(st.nswap) + int(st.nmoved)
+        if _hist_key(h) > _hist_key(best_h):
+            best, best_h = snap(cur), h
+        if nops == 0:
+            break
+    return best
+
+
 def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     """Adapt `mesh` to its metric. Returns (mesh, info dict).
 
@@ -749,6 +803,9 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
     for it in range(opts.niter):
         mesh = run_batched_sweep_loop(mesh, opts, emult, history, it, hausd)
 
+    # once, after the final iteration — polishing between iterations is
+    # wasted work (the next iteration's insertion sweeps disturb it)
+    mesh = _polish(mesh, opts, emult, hausd)
     mesh = compact(mesh)
     if old_snapshot is not None:
         from ..ops import interp
