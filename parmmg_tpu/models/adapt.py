@@ -693,9 +693,10 @@ def _polish(mesh: Mesh, opts: AdaptOptions, emult, hausd: float) -> Mesh:
     The convergence threshold (`converge_frac`) can stop the sweep loop
     with a few hundred improving collapse/swap/smooth ops still
     available — enough to strand one 0.10-class sliver in a ~94k-tet
-    mesh. Runs up to `polish_sweeps` insertion-free sweeps on the
-    per-op (unfused) dispatch path and keeps each result only if the
-    histogram improves — the floor never regresses. The reference's
+    mesh. Runs up to `polish_sweeps` insertion-free sweeps (dispatched
+    fused or per-op by the main loop's UNFUSED_TCAP rule) and keeps
+    each result only if the histogram improves — the floor never
+    regresses. The reference's
     serial kernel ends every wave with the same quality-only ops
     (`MMG5_mmg3d1_delone` final passes, `src/libparmmg1.c:739`)."""
     if opts.polish_sweeps <= 0 or (opts.noswap and opts.nomove):
@@ -713,11 +714,21 @@ def _polish(mesh: Mesh, opts: AdaptOptions, emult, hausd: float) -> Mesh:
     best = snap(mesh)
     cur = mesh
     ecap = int(mesh.tcap * emult[0]) + 64
+    # dispatch mirrors the main loop's fused/unfused rule; results are
+    # path-equivalent (see test_unfused_sweep_path_matches). Below
+    # UNFUSED_TCAP the fused single-sweep program costs ONE dispatch
+    # round trip (measured: the per-op path's ~25 round trips cost 62 s
+    # of a 112 s n=12 bench run in a slow tunnel window) and keeps the
+    # per-process compile count low on CPU (this jaxlib's CPU compiler
+    # can segfault after many large compiles — conftest note). Above
+    # the threshold the per-op path avoids the mega-compile, and the
+    # dispatch overhead is noise against multi-second sweeps.
+    unfused = mesh.tcap > UNFUSED_TCAP
     for _ in range(opts.polish_sweeps):
-        cur, st = _sweep_body(
+        cur, st = (_sweep_body if unfused else remesh_sweep)(
             cur, ecap, noinsert=True, noswap=opts.noswap,
             nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
-            fused=False, phase_skip=False,
+            fused=not unfused, phase_skip=False,
         )
         h = quality_mod.quality_histogram(cur)
         nops = int(st.ncollapse) + int(st.nswap) + int(st.nmoved)

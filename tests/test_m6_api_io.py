@@ -433,6 +433,12 @@ def test_hgradreq_required_sizes_win():
 def test_distributed_aniso_adapt():
     """Aniso tensor metric through the distributed driver (VERDICT: the
     reference CI torus-shock family runs multi-rank)."""
+    import jax
+
+    # this jaxlib's CPU compiler can segfault on the next BIG compile
+    # after many in one process (conftest note); this is the first
+    # vmapped-driver compile after 14 compile-heavy tests
+    jax.clear_caches()
     from parmmg_tpu.models.distributed import (
         DistOptions, adapt_distributed, merge_adapted,
     )
