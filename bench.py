@@ -45,6 +45,13 @@ CPU_ANCHOR_TPS_LARGE = 1141.4
 # round 3; see PERF_NOTES.md)
 CPU_ANCHOR_TPS_XL = 1031.0
 
+# Total wall-clock the bench allows itself. The round-4 driver run was
+# killed by the harness outer timeout (rc=124) AFTER its record lines
+# printed — the lines survived but the clean exit did not. Every attempt
+# below is now bounded by the remaining budget and the process exits 0
+# with whatever record landed. Overridable for local experiments.
+BUDGET_S = float(os.environ.get("PARMMG_BENCH_BUDGET_S", "1380"))
+
 
 def est_out_tets(hsiz):
     """Predicted output-tet count of a unit cube adapted to uniform
@@ -143,27 +150,33 @@ def _attempt(cfg, tmo, env_extra=None):
 
 
 def main():
-    """Print a parseable line EARLY, then improve on it.
+    """Print a parseable line EARLY, then improve on it — and exit 0
+    inside the harness budget.
 
     The round-3 record was lost because the bench led with a 3300 s
     large-workload attempt and the harness outer timeout fired before
-    any line was printed. Lesson applied: run the default workload
-    first under a tight cap and print its line IMMEDIATELY, then
-    opportunistically attempt the large config and print again — the
-    harness keeps the tail of stdout, so whichever lines land inside
-    its budget are on the record. The per-attempt caps assume a warm
-    persistent compile cache (pre-warmed in-round; see
-    _enable_compile_cache): a cache-hit TPU run finishes in ~1-3 min.
-    Worst-case time to FIRST line: 900 + 1200 + 600 = 2700 s (every
-    attempt timing out); warm-cache time to first line ~250 s.
+    any line was printed; round 4 printed its lines early (two TPU
+    records landed) but the opportunistic ladder then overran the outer
+    budget and the process died rc=124. Lessons applied: the default
+    workload runs first under a tight cap and prints IMMEDIATELY; every
+    subsequent attempt is admitted only if the REMAINING wall-clock
+    budget covers its expected warm-cache duration, and its subprocess
+    timeout is clipped to the remaining budget — so the bench always
+    exits 0 with its record printed, whatever the cache state.
     """
     if "--worker" in sys.argv:
         cfg = json.loads(sys.argv[-1])
         print(json.dumps(run(**cfg)), flush=True)
         return
 
+    t_start = time.monotonic()
+
+    def remaining(reserve=45.0):
+        return BUDGET_S - (time.monotonic() - t_start) - reserve
+
     # 1. default workload on TPU, tight cap: the must-land line
-    rec = _attempt(dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 900)
+    rec = _attempt(dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS),
+                   min(900, max(remaining(), 60)))
     if rec is None or rec.get("platform") != "tpu":
         # Cold compile cache: the fused-sweep program alone can exceed
         # the cap. The per-op (unfused) path compiles in small pieces —
@@ -171,14 +184,16 @@ def main():
         # attempt makes the next one cheaper. Slightly slower execution
         # (per-sweep dispatch), far cheaper compile: the cold-cache
         # TPU line of last resort.
-        rec2 = _attempt(dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 1200,
-                        {"PARMMG_UNFUSED_TCAP": "0"})
-        if rec2 is not None and (
-            rec is None
-            or rec2.get("platform") == "tpu"
-            or rec2.get("value", 0.0) > rec.get("value", 0.0)
-        ):
-            rec = rec2
+        tmo = remaining()
+        if tmo > 120:
+            rec2 = _attempt(dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS),
+                            min(1200, tmo), {"PARMMG_UNFUSED_TCAP": "0"})
+            if rec2 is not None and (
+                rec is None
+                or rec2.get("platform") == "tpu"
+                or rec2.get("value", 0.0) > rec.get("value", 0.0)
+            ):
+                rec = rec2
     if rec is not None and rec.get("platform") == "tpu":
         print(json.dumps(rec), flush=True)
     else:
@@ -186,26 +201,33 @@ def main():
         # backend its measurement is still honest (labeled via
         # "platform") — keep it rather than re-running; re-run on CPU
         # only when the TPU attempts produced nothing at all.
-        cpu = rec if rec is not None else _attempt(
-            dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS), 600,
-            {"JAX_PLATFORMS": "cpu"})
+        cpu = rec
+        if cpu is None and remaining() > 120:
+            cpu = _attempt(dict(n=10, hsiz=0.05, anchor=CPU_ANCHOR_TPS),
+                           min(600, remaining()), {"JAX_PLATFORMS": "cpu"})
         print(json.dumps(cpu) if cpu is not None else json.dumps({
             "metric": "tets_per_sec", "value": 0.0, "unit": "tet/s",
             "vs_baseline": 0.0, "error": "all attempts timed out",
         }), flush=True)
         return
 
-    # 2. opportunistic: the large workload, where the TPU advantage
-    # shows (2.39x same-day CPU at ~204k tets vs 1.37x at ~94k) and the
-    # closest in-reach point to the 10M-tet north star. Known-good n=12
-    # first; the n=14 experiment (which has killed the tunnel worker
-    # before — PERF_NOTES.md) only runs after a large line is already
-    # on the record. A line is printed only when it improves the
-    # record: parsed, on-TPU, larger workload than the default line.
-    for cfg, tmo in (
-        (dict(n=12, hsiz=0.04, anchor=CPU_ANCHOR_TPS_LARGE), 1500),
-        (dict(n=14, hsiz=0.03, anchor=CPU_ANCHOR_TPS_XL), 1500),
+    # 2. opportunistic ladder toward the 10M-tet north star: n=12
+    # (proven), n=14 (~440k), n=16 (~1.2M — the scale rung, cache
+    # pre-warmed in-round by tools/scale_pipeline.py). est = expected
+    # warm-cache wall for warmup+timed runs + interpreter/cache-load
+    # slack; a rung is attempted only if the remaining budget covers
+    # it, so a cold cache burns bounded time and the process still
+    # exits 0. A line is printed only when it improves the record:
+    # parsed, on-TPU, larger workload than the previous line.
+    for cfg, est in (
+        (dict(n=12, hsiz=0.04, anchor=CPU_ANCHOR_TPS_LARGE), 240),
+        (dict(n=14, hsiz=0.03, anchor=CPU_ANCHOR_TPS_XL), 500),
+        (dict(n=16, hsiz=0.0229, anchor=CPU_ANCHOR_TPS_XL,
+              max_sweeps=14), 1100),
     ):
+        tmo = remaining()
+        if tmo < est:
+            break
         big = _attempt(cfg, tmo)
         if big is not None and big.get("platform") == "tpu":
             print(json.dumps(big), flush=True)

@@ -88,7 +88,283 @@ class RawMesh:
     node_comms: List[Tuple[int, np.ndarray, np.ndarray]] | None = None
 
 
+# --------------------------------------------------------------------------
+# binary Medit (.meshb / .solb)
+#
+# GMF container (libMeshb): int32 cookie 1 (16777216 when byte-swapped),
+# int32 version, then keyword records [code, NulPos, payload] where NulPos
+# is the byte offset of the NEXT record — unknown sections are skipped by
+# seeking to it, exactly how the reference reader walks these files
+# (`PMMG_loadCommunicators`, src/inout_pmmg.c:259-299). Version 2 (float64
+# coords, int32 ints/positions) is what Mmg writes and what we write; the
+# reader also accepts version 1 (float32) and 3 (int64 positions).
+# Communicator sections use the reference's own binary codes 70-73
+# (src/inout_pmmg.c:137-142,270-278). NOTE the reference can only READ
+# binary communicators — its writer errors out ("Binary file format not
+# yet implemented for communicators", src/libparmmg_tools.c:884); here
+# both directions work, so the distributed checkpoint loop closes in
+# binary as well.
+# --------------------------------------------------------------------------
+
+_KWD_CODES = {
+    "Dimension": 3,
+    "Vertices": 4,
+    "Edges": 5,
+    "Triangles": 6,
+    "Quadrilaterals": 7,
+    "Tetrahedra": 8,
+    "Corners": 13,
+    "Ridges": 14,
+    "RequiredVertices": 15,
+    "RequiredEdges": 16,
+    "RequiredTriangles": 17,
+    "NormalAtVertices": 20,
+    "End": 54,
+    "Tangents": 59,
+    "Normals": 60,
+    "TangentAtVertices": 61,
+    "SolAtVertices": 62,
+    # ParMmg extension codes (reference src/inout_pmmg.c:137-142)
+    "ParallelTriangleCommunicators": 70,
+    "ParallelVertexCommunicators": 71,
+    "ParallelCommunicatorTriangles": 72,
+    "ParallelCommunicatorVertices": 73,
+}
+_KWD_NAMES = {v: k for k, v in _KWD_CODES.items()}
+
+
+def is_binary_file(path: str) -> bool:
+    """Sniff the GMF binary cookie (int32 1, either endianness) — the
+    role of the reference's extension dispatch in `MMG3D_openMesh`, but
+    content-based so misnamed files still load."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"mesh file not found: {path}")
+    with open(path, "rb") as f:
+        head = f.read(4)
+    if len(head) < 4:
+        return False
+    v = int(np.frombuffer(head, "<i4")[0])
+    return v in (1, 16777216)
+
+
+class _BinReader:
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            self.buf = f.read()
+        self.path = path
+        cookie = int(np.frombuffer(self.buf, "<i4", 1)[0])
+        if cookie == 1:
+            self.end = "<"
+        elif cookie == 16777216:
+            self.end = ">"
+        else:
+            raise ValueError(f"{path}: not a GMF binary file")
+        self.ver = int(np.frombuffer(self.buf, self.end + "i4", 1, 4)[0])
+        if self.ver not in (1, 2, 3):
+            raise ValueError(
+                f"{path}: unsupported GMF version {self.ver} "
+                "(1-3 readable, 2 written)"
+            )
+        self.real = self.end + ("f4" if self.ver == 1 else "f8")
+        self.int = self.end + "i4"
+        self.pos_t = self.end + ("i8" if self.ver >= 3 else "i4")
+        self.off = 8
+
+    def ints(self, n):
+        out = np.frombuffer(self.buf, self.int, n, self.off).astype(np.int64)
+        self.off += 4 * n
+        return out
+
+    def int1(self):
+        return int(self.ints(1)[0])
+
+    def pos(self):
+        v = int(np.frombuffer(self.buf, self.pos_t, 1, self.off)[0])
+        self.off += np.dtype(self.pos_t).itemsize
+        return v
+
+    def table(self, cnt, ncols_real=0, ncols_int=0):
+        """cnt rows of (reals..., ints...) -> float64 [cnt, ncols] array
+        (the ASCII sections parse to float64 too, so the shared assembly
+        code sees identical input)."""
+        rdt = np.dtype(self.real)
+        dt = np.dtype(
+            ([("r", rdt, (ncols_real,))] if ncols_real else [])
+            + ([("i", self.int, (ncols_int,))] if ncols_int else [])
+        )
+        arr = np.frombuffer(self.buf, dt, cnt, self.off)
+        self.off += dt.itemsize * cnt
+        parts = []
+        if ncols_real:
+            parts.append(arr["r"].astype(np.float64))
+        if ncols_int:
+            parts.append(arr["i"].astype(np.float64))
+        return np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
+
+def _read_sections_binary(path: str):
+    r = _BinReader(path)
+    data: Dict[str, np.ndarray] = {}
+    comm_heads: Dict[str, np.ndarray] = {}
+    comm_items: Dict[str, np.ndarray] = {}
+    dim = 3
+    n = len(r.buf)
+    while r.off + 4 <= n:
+        code = r.int1()
+        if code == 54:  # End
+            break
+        nxt = r.pos()
+        name = _KWD_NAMES.get(code)
+        if name is None or name in ("End",):
+            if nxt <= 0 or nxt <= r.off:
+                break  # malformed skip chain: stop like an EOF
+            r.off = nxt
+            continue
+        if name == "Dimension":
+            dim = r.int1()
+        elif name in _ENT_SECTIONS:
+            cols, has_ref = _ENT_SECTIONS[name]
+            if name == "Vertices":
+                cols = dim
+            cnt = r.int1()
+            if name in ("Vertices", "Normals", "Tangents"):
+                data[name] = r.table(
+                    cnt, ncols_real=cols, ncols_int=1 if has_ref else 0
+                )
+            else:
+                data[name] = r.table(
+                    cnt, ncols_int=cols + (1 if has_ref else 0)
+                )
+        elif name in (
+            "ParallelTriangleCommunicators",
+            "ParallelVertexCommunicators",
+        ):
+            cnt = r.int1()
+            comm_heads[name] = (
+                r.ints(cnt * 2).reshape(cnt, 2)
+            )
+        elif name in (
+            "ParallelCommunicatorTriangles",
+            "ParallelCommunicatorVertices",
+        ):
+            head_kw = (
+                "ParallelTriangleCommunicators"
+                if "Triangles" in name
+                else "ParallelVertexCommunicators"
+            )
+            if head_kw not in comm_heads:
+                raise ValueError(
+                    f"{path}: section {name} appears before its header "
+                    f"section {head_kw}"
+                )
+            ntot = int(comm_heads[head_kw][:, 1].sum())
+            comm_items[name] = r.ints(ntot * 3).reshape(ntot, 3)
+        elif name == "SolAtVertices":
+            # skip: sols live in their own files; tolerate embedding
+            r.off = nxt
+        else:
+            r.off = nxt
+        if nxt > 0:
+            r.off = nxt  # trust the skip chain over our own arithmetic
+    return data, comm_heads, comm_items, dim
+
+
+class _BinWriter:
+    """GMF version-2 writer (float64 reals, int32 ints/positions —
+    what Mmg's `MMG3D_saveMesh` emits for .meshb)."""
+
+    def __init__(self, path: str):
+        self.f = open(path, "wb")
+        self.f.write(np.array([1, 2], "<i4").tobytes())
+
+    def _i4(self, *vals):
+        self.f.write(np.array(vals, "<i4").tobytes())
+
+    def section(self, name: str, payload: bytes, head: Sequence[int]):
+        """[code, NulPos, head ints..., payload]."""
+        code = _KWD_CODES[name]
+        here = self.f.tell()
+        nxt = here + 8 + 4 * len(head) + len(payload)
+        if nxt > 2**31 - 1:
+            raise ValueError(
+                "mesh too large for GMF version 2 int32 positions "
+                f"(section {name} would end at byte {nxt}); write ASCII "
+                "or shard the mesh"
+            )
+        self._i4(code, nxt, *head)
+        self.f.write(payload)
+
+    def end(self):
+        self._i4(54, 0)
+        self.f.close()
+
+
+def _rows_bytes(arr_i: np.ndarray, refs: np.ndarray | None,
+                one_based: bool) -> bytes:
+    body = arr_i.astype(np.int32) + (1 if one_based else 0)
+    if refs is not None:
+        body = np.concatenate(
+            [body, refs.astype(np.int32)[:, None]], axis=1
+        )
+    return np.ascontiguousarray(body, "<i4").tobytes()
+
+
+def _save_mesh_binary(
+    path: str,
+    d: Dict[str, np.ndarray],
+    comm_sections,
+) -> None:
+    w = _BinWriter(path)
+    w.section("Dimension", b"", [3])
+    verts = np.zeros(
+        len(d["verts"]), np.dtype([("xyz", "<f8", (3,)), ("ref", "<i4")])
+    )
+    verts["xyz"] = d["verts"]
+    verts["ref"] = d["vrefs"]
+    w.section("Vertices", verts.tobytes(), [len(verts)])
+    for name, key, rkey in (
+        ("Tetrahedra", "tets", "trefs"),
+        ("Triangles", "trias", "trrefs"),
+        ("Edges", "edges", "edrefs"),
+    ):
+        if len(d[key]):
+            w.section(
+                name, _rows_bytes(d[key], d[rkey], True), [len(d[key])]
+            )
+    for name, ids in d["idsections"]:
+        if len(ids):
+            w.section(
+                name, _rows_bytes(ids[:, None], None, True), [len(ids)]
+            )
+    for kw_head, kw_items, remapped in comm_sections:
+        w.section(
+            kw_head,
+            np.ascontiguousarray(
+                [[c, len(loc)] for c, loc, _ in remapped], "<i4"
+            ).tobytes(),
+            [len(remapped)],
+        )
+        items = np.concatenate(
+            [
+                np.stack(
+                    [
+                        np.asarray(loc, np.int64) + 1,
+                        np.asarray(glob, np.int64),
+                        np.full(len(loc), icomm, np.int64),
+                    ],
+                    axis=1,
+                )
+                for icomm, (c, loc, glob) in enumerate(remapped)
+            ]
+        )
+        w.section(kw_items, np.ascontiguousarray(items, "<i4").tobytes(), [])
+    w.end()
+
+
 def read_mesh(path: str) -> RawMesh:
+    if is_binary_file(path):
+        data, comm_heads, comm_items, dim = _read_sections_binary(path)
+        return _assemble_raw(data, comm_heads, comm_items, dim, path)
     toks = _tokenize(path)
     n = len(toks)
     i = 0
@@ -146,6 +422,18 @@ def read_mesh(path: str) -> RawMesh:
             comm_items[kw] = arr  # columns: idx_loc, idx_glob, icomm
         else:
             raise ValueError(f"unhandled Medit keyword {kw!r} in {path}")
+    return _assemble_raw(data, comm_heads, comm_items, dim, path)
+
+
+def _assemble_raw(
+    data: Dict[str, np.ndarray],
+    comm_heads: Dict[str, np.ndarray],
+    comm_items: Dict[str, np.ndarray],
+    dim: int,
+    path: str,
+) -> RawMesh:
+    """Section dicts -> RawMesh: the shared back half of the ASCII and
+    binary readers (sections carry identical content in both forms)."""
 
     def ent(kw, cols):
         if kw not in data:
@@ -215,6 +503,26 @@ def read_mesh(path: str) -> RawMesh:
 
 def read_sol(path: str) -> Tuple[np.ndarray, List[int]]:
     """Read SolAtVertices: returns (values [n, sum(ncomp)], type codes)."""
+    if is_binary_file(path):
+        r = _BinReader(path)
+        n = len(r.buf)
+        while r.off + 4 <= n:
+            code = r.int1()
+            if code == 54:
+                break
+            nxt = r.pos()
+            if code == _KWD_CODES["Dimension"]:
+                r.int1()
+            elif code == _KWD_CODES["SolAtVertices"]:
+                nv = r.int1()
+                nsol = r.int1()
+                types = [int(t) for t in r.ints(nsol)]
+                width = sum(_SOL_NCOMP[t] for t in types)
+                vals = r.table(nv, ncols_real=width)
+                return vals, types
+            if nxt > 0:
+                r.off = nxt
+        raise ValueError(f"no SolAtVertices section in {path}")
     toks = _tokenize(path)
     i = 0
     n = len(toks)
@@ -305,56 +613,75 @@ def save_mesh(
     *,
     face_comms: Sequence[Tuple[int, np.ndarray, np.ndarray]] | None = None,
     node_comms: Sequence[Tuple[int, np.ndarray, np.ndarray]] | None = None,
+    binary: bool | None = None,
 ) -> None:
-    """Write a (centralized or per-shard) Medit ASCII file."""
+    """Write a (centralized or per-shard) Medit file. `binary=None`
+    dispatches on the extension like the reference (`.meshb` → binary,
+    `MMG3D_openMesh` extension rule)."""
+    if binary is None:
+        binary = os.path.splitext(path)[1] in (".meshb", ".solb")
     d = mesh.to_numpy()
+    vt = d["vtags"]
+    # 0-based id sections, derived once for both encodings
+    corners = np.nonzero(vt & tags.CORNER)[0]
+    req = np.nonzero(
+        ((vt & tags.REQUIRED) != 0) & ((vt & tags.CORNER) == 0)
+    )[0]
+    ridges = np.nonzero(d["edtags"] & tags.RIDGE)[0]
+    req_ed = np.nonzero(d["edtags"] & tags.REQUIRED)[0]
+    # pure synthetic interface trias are excluded: their REQUIRED is
+    # split-added and restored from the face-comm sections on load;
+    # PARBDYBDY (real-surface) interface trias stay listed here, which
+    # is what lets the loader tell the two kinds apart
+    req_tr = np.nonzero(
+        ((d["trtags"] & tags.REQUIRED) != 0)
+        & ~tags.pure_interface_tria(d["trtags"])
+    )[0]
+    d["idsections"] = [
+        ("Corners", corners),
+        ("RequiredVertices", req),
+        ("Ridges", ridges),
+        ("RequiredEdges", req_ed),
+        ("RequiredTriangles", req_tr),
+    ]
+    # communicator local ids are mesh slot ids; entity sections are
+    # written in compacted numbering, so remap through the same maps
+    tr_live = np.asarray(mesh.trmask)
+    v_live = np.asarray(mesh.vmask)
+    tr_new = np.cumsum(tr_live) - 1
+    v_new = np.cumsum(v_live) - 1
+    comm_sections = []
+    for kw_head, kw_items, comms, live, renum in (
+        ("ParallelTriangleCommunicators", "ParallelCommunicatorTriangles",
+         face_comms, tr_live, tr_new),
+        ("ParallelVertexCommunicators", "ParallelCommunicatorVertices",
+         node_comms, v_live, v_new),
+    ):
+        if not comms:
+            continue
+        remapped = []
+        for color, loc, glob in comms:
+            loc = np.asarray(loc)
+            if not live[loc].all():
+                raise ValueError(
+                    f"communicator (color {color}) references deleted "
+                    f"entities; cannot save"
+                )
+            remapped.append((color, renum[loc], np.asarray(glob)))
+        comm_sections.append((kw_head, kw_items, remapped))
+
+    if binary:
+        _save_mesh_binary(path, d, comm_sections)
+        return
     with open(path, "w") as f:
         f.write("MeshVersionFormatted 2\n\nDimension 3\n")
         _fmt_block(f, "Vertices", d["verts"], d["vrefs"], True)
         _fmt_block(f, "Tetrahedra", d["tets"], d["trefs"], True)
         _fmt_block(f, "Triangles", d["trias"], d["trrefs"], True)
         _fmt_block(f, "Edges", d["edges"], d["edrefs"], True)
-        vt = d["vtags"]
-        corners = np.nonzero(vt & tags.CORNER)[0] + 1
-        _fmt_block(f, "Corners", corners[:, None], None, False)
-        req = np.nonzero(((vt & tags.REQUIRED) != 0) & ((vt & tags.CORNER) == 0))[0] + 1
-        _fmt_block(f, "RequiredVertices", req[:, None], None, False)
-        ridges = np.nonzero(d["edtags"] & tags.RIDGE)[0] + 1
-        _fmt_block(f, "Ridges", ridges[:, None], None, False)
-        req_ed = np.nonzero(d["edtags"] & tags.REQUIRED)[0] + 1
-        _fmt_block(f, "RequiredEdges", req_ed[:, None], None, False)
-        # pure synthetic interface trias are excluded: their REQUIRED is
-        # split-added and restored from the face-comm sections on load;
-        # PARBDYBDY (real-surface) interface trias stay listed here, which
-        # is what lets the loader tell the two kinds apart
-        req_tr = np.nonzero(
-            ((d["trtags"] & tags.REQUIRED) != 0)
-            & ~tags.pure_interface_tria(d["trtags"])
-        )[0] + 1
-        _fmt_block(f, "RequiredTriangles", req_tr[:, None], None, False)
-        # communicator local ids are mesh slot ids; entity sections above
-        # are written in compacted numbering, so remap through the same maps
-        tr_live = np.asarray(mesh.trmask)
-        v_live = np.asarray(mesh.vmask)
-        tr_new = np.cumsum(tr_live) - 1
-        v_new = np.cumsum(v_live) - 1
-        for kw_head, kw_items, comms, live, renum in (
-            ("ParallelTriangleCommunicators", "ParallelCommunicatorTriangles",
-             face_comms, tr_live, tr_new),
-            ("ParallelVertexCommunicators", "ParallelCommunicatorVertices",
-             node_comms, v_live, v_new),
-        ):
-            if not comms:
-                continue
-            remapped = []
-            for color, loc, glob in comms:
-                loc = np.asarray(loc)
-                if not live[loc].all():
-                    raise ValueError(
-                        f"communicator (color {color}) references deleted "
-                        f"entities; cannot save"
-                    )
-                remapped.append((color, renum[loc], np.asarray(glob)))
+        for name, ids in d["idsections"]:
+            _fmt_block(f, name, ids[:, None] + 1, None, False)
+        for kw_head, kw_items, remapped in comm_sections:
             f.write(f"\n{kw_head}\n{len(remapped)}\n")
             for color, loc, glob in remapped:
                 f.write(f"{color} {len(loc)}\n")
@@ -366,9 +693,24 @@ def save_mesh(
 
 
 def save_sol(
-    path: str, values: np.ndarray, types: Sequence[int], dim: int = 3
+    path: str, values: np.ndarray, types: Sequence[int], dim: int = 3,
+    binary: bool | None = None,
 ) -> None:
     values = np.asarray(values)
+    if binary is None:
+        binary = os.path.splitext(path)[1] in (".meshb", ".solb")
+    if binary:
+        w = _BinWriter(path)
+        w.section("Dimension", b"", [dim])
+        payload = (
+            np.array(types, "<i4").tobytes()
+            + np.ascontiguousarray(values, "<f8").tobytes()
+        )
+        w.section(
+            "SolAtVertices", payload, [values.shape[0], len(types)]
+        )
+        w.end()
+        return
     with open(path, "w") as f:
         f.write(f"MeshVersionFormatted 2\n\nDimension {dim}\n\nSolAtVertices\n")
         f.write(f"{values.shape[0]}\n{len(types)} {' '.join(map(str, types))}\n")
@@ -468,8 +810,8 @@ def save_mesh_distributed(stacked: Mesh, comm, path: str,
         save_mesh(m, shard_filename(path, s), node_comms=node_comms,
                   face_comms=face_comms or None)
         if with_met:
-            base, _ = os.path.splitext(shard_filename(path, s))
-            save_met(m, base + ".sol")
+            base, ext = os.path.splitext(shard_filename(path, s))
+            save_met(m, base + (".solb" if ext == ".meshb" else ".sol"))
 
 
 def load_mesh_distributed(path: str, nparts: int, metpath: str | None = None,

@@ -278,7 +278,7 @@ def _hist_row(stats: "SweepStats", ne, npo):
         stats.nsplit, stats.ncollapse, stats.nswap, stats.nmoved,
         jnp.asarray(ne, jnp.int32), jnp.asarray(npo, jnp.int32),
         stats.n_unique, stats.split_capped.astype(jnp.int32),
-    ])
+    ]).astype(jnp.int32)  # counters can arrive int64 under x64
 
 
 @partial(

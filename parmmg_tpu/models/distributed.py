@@ -179,6 +179,83 @@ def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions, hausd: float):
     return jax.vmap(fn)(st)
 
 
+def _use_spmd_sweeps() -> bool:
+    """SPMD sweep dispatch: automatic under a multi-controller runtime
+    (the sweeps are the dominant cost — they must actually distribute
+    across processes), opt-in single-process via PMMGTPU_SPMD_SWEEPS=1
+    (used by the multihost equivalence test to produce the bit-identical
+    single-process reference run)."""
+    import os
+
+    if os.environ.get("PMMGTPU_SPMD_SWEEPS"):
+        return True
+    from ..parallel import multihost
+
+    return multihost.is_multiprocess()
+
+
+def _remesh_phase_global(
+    st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
+    it: int, hausd,
+) -> Mesh:
+    """Multi-process remesh phase: each sweep is ONE SPMD program over
+    the global device mesh — with 2 processes owning 4 devices each, the
+    per-shard sweeps execute on the devices of BOTH processes and any
+    cross-shard collective rides the coordination transport (the DCN
+    path), the role of each MPI rank running `MMG5_mmg3d1_delone` on its
+    own groups (`src/libparmmg1.c:662-800`). Host control flow
+    (capacity checks, convergence) is replicated-deterministic on every
+    process, per the `parallel.multihost` contract: the stacked mesh is
+    gathered back to host numpy after each sweep, so every other phase
+    of `_one_iteration` runs unchanged."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import multihost
+    from ..parallel.shard import AXIS, _squeeze, _unsqueeze, device_mesh
+
+    D = st.tet.shape[0]
+    dmesh = device_mesh(D)
+
+    def sweep_fn(s, ecap):
+        sg = multihost.put_sharded_global(s, dmesh)
+
+        def body(blk):
+            m = _squeeze(blk)
+            m, stats = remesh_sweep(
+                m, ecap, noinsert=opts.noinsert, noswap=opts.noswap,
+                nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
+                fused=True, phase_skip=False,
+            )
+            return _unsqueeze(m), jax.tree_util.tree_map(
+                lambda x: x[None], stats
+            )
+
+        out, stats = jax.jit(jax.shard_map(
+            body, mesh=dmesh, in_specs=(P(AXIS),),
+            out_specs=(P(AXIS), P(AXIS)),
+        ))(sg)
+        s2 = multihost.gather_stacked(out)
+        stats = multihost.gather_stacked(stats)
+        rec = dict(
+            nsplit=int(np.sum(stats.nsplit)),
+            ncollapse=int(np.sum(stats.ncollapse)),
+            nswap=int(np.sum(stats.nswap)),
+            nmoved=int(np.sum(stats.nmoved)),
+            ne=int(np.sum(s2.tmask)),
+            np=int(np.sum(s2.vmask)),
+            n_unique=int(np.max(stats.n_unique)),
+            capped=bool(np.any(stats.split_capped)),
+        )
+        return s2, rec
+
+    return run_sweep_loop(
+        st, opts, emult, history, it,
+        ensure_fn=lambda s: ensure_capacity_stacked(s, opts),
+        tcap_fn=lambda s: int(s.tet.shape[1]),
+        sweep_fn=sweep_fn,
+    )
+
+
 def remesh_phase(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
     it: int, hausd: float = 0.01,
@@ -187,6 +264,8 @@ def remesh_phase(
     the batched analog of the per-group `MMG5_mmg3d1_delone` calls in the
     reference loop body (`src/libparmmg1.c:662-800`). Control flow is the
     shared `run_sweep_loop` engine with cross-shard-aggregated stats."""
+    if _use_spmd_sweeps():
+        return _remesh_phase_global(st, opts, emult, history, it, hausd)
 
     def sweep_fn(s, ecap):
         s, stats = _vsweep(s, ecap, opts, hausd)
@@ -232,6 +311,11 @@ def interp_phase(st: Mesh, old: Mesh,
 # the driver
 # ---------------------------------------------------------------------------
 
+# redistribution modes, reference src/libparmmgtypes.h:173-186
+REDISTRIBUTION_GRAPH_BALANCING = 0
+REDISTRIBUTION_IFC_DISPLACEMENT = 1
+
+
 @dataclasses.dataclass
 class DistOptions(AdaptOptions):
     """Distributed controls on top of the adaptation options (the
@@ -252,6 +336,13 @@ class DistOptions(AdaptOptions):
     # the guard gets more slack before it cancels a displacement whose
     # front movement is the whole point of the iteration
     grps_ratio: float = 2.5
+    # between-iteration redistribution mode (reference
+    # PMMG_REDISTRIBUTION_graph_balancing=0 / _ifc_displacement=1,
+    # src/libparmmgtypes.h:173-186; default ifc_displacement like the
+    # reference's PMMG_REDISTRIBUTION_mode). Graph mode recomputes a
+    # fresh global weighted SFC cut each iteration (device-resident,
+    # partition.stacked_graph_colors) instead of advancing fronts.
+    repartitioning: int = REDISTRIBUTION_IFC_DISPLACEMENT
     check_comm: bool = False      # chkcomm assert each iteration (debug)
     # minimum elements per shard before distribution pays off — the group
     # sizing role of PMMG_howManyGroups / PMMG_GRPSPL_DISTR_TARGET
@@ -418,15 +509,26 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
     # PMMG_transfer_all_grps role) — the host only re-derives the
     # interface discipline from connectivity. The former global
     # merge+split survives solely as the GRPS_RATIO re-cut fallback.
-    if not opts.nobalancing and it < opts.niter - 1 and nparts > 1:
+    # Like the reference, the LAST iteration balances the OUTPUT mesh
+    # with the graph cut regardless of the user mode
+    # (src/libparmmg1.c:854-869: repartitioning is forced to
+    # graph_balancing for the final PMMG_loadBalancing call).
+    last = it == opts.niter - 1
+    if not opts.nobalancing and nparts > 1:
         from ..parallel import migrate as migrate_mod
 
         stacked = assign_global_ids(stacked)
         comm = rebuild_comm(stacked, icap)
         stacked = jax.vmap(adjacency.build_adjacency)(stacked)
-        color = migrate_mod.displace_colors(
-            stacked, comm, nparts, round_id=0, layers=opts.ifc_layers
+        graph_mode = (
+            last or opts.repartitioning == REDISTRIBUTION_GRAPH_BALANCING
         )
+        if graph_mode:
+            color = partition_mod.stacked_graph_colors(stacked, nparts)
+        else:
+            color = migrate_mod.displace_colors(
+                stacked, comm, nparts, round_id=0, layers=opts.ifc_layers
+            )
         cnts = np.asarray(jax.device_get(
             migrate_mod.migration_counts(stacked, color, nparts)
         ))

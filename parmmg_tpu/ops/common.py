@@ -203,6 +203,46 @@ def two_phase_winners(
     return is_top & (lo >= best_lo)
 
 
+def rank_winners(
+    prio: jax.Array,
+    cand: jax.Array,
+    scatter_arena,
+    gather_arena,
+):
+    """Independent-set selection in ONE arena propagation.
+
+    Same contract as `two_phase_winners`, but the (priority, hashed-id)
+    lexicographic comparison its two scatter+gather rounds implement is
+    precomputed as a UNIQUE integer rank (two cheap [N] sorts — sorts
+    are ~5x cheaper than an arena round on TPU, PERF_NOTES), so ONE
+    max-propagation decides: a candidate wins iff its rank is the max
+    in every arena cell it touches. The winner set is the same valid
+    independent set, except richer in one benign edge case: a
+    candidate that is priority-top in cell A but not in cell B no
+    longer leaks its hash into B's tie-break, so B's rightful top
+    cannot be spuriously suppressed (two_phase_winners is conservative
+    there). The rank is exactly representable in f32 for N < 2^24 —
+    the same argument as the collapse rank-MIS (round 4).
+    """
+    n = prio.shape[0]
+    if n > (1 << 24):  # rank exactness in f32 needs N <= 2^24
+        return two_phase_winners(prio, cand, scatter_arena, gather_arena)
+    h24 = (
+        jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(2654435761)
+    ) & jnp.uint32(0xFFFFFF)
+    p = jnp.where(cand, prio, -jnp.inf)
+    order = jnp.lexsort((h24, p))  # ascending (prio, hash)
+    rank = (
+        jnp.zeros(n, jnp.int32)
+        .at[order]
+        .set(jnp.arange(n, dtype=jnp.int32), mode="drop",
+             unique_indices=True)
+    )
+    r = jnp.where(cand, rank.astype(jnp.float32), -jnp.inf)
+    best = gather_arena(scatter_arena(r))
+    return cand & (r >= best) & jnp.isfinite(r)
+
+
 # uint32 sentinel for packed invalid rows (valid packed keys are
 # < (bound+1)^2 - 1 <= 0xFFFE0000 when bound <= PACK_BOUND, so the
 # sentinel never collides)

@@ -82,37 +82,43 @@ def smooth_vertices(
     feat_tag = jnp.where(feat >= 0, mesh.edtag[jnp.maximum(feat, 0)], 0)
     feat_e = (feat_tag & _FEAT_BITS) != 0
 
-    def centroid_over(sel):
-        w = (emask & sel).astype(dtype)
-        acc = jnp.zeros((pcap, 3), dtype)
-        acc = common.scatter_rows(acc, a, vert0[b] * w[:, None], op="add")
-        acc = common.scatter_rows(acc, b, vert0[a] * w[:, None], op="add")
-        cnt = jnp.zeros(pcap, dtype)
-        cnt = cnt.at[a].add(w, mode="drop")
-        cnt = cnt.at[b].add(w, mode="drop")
-        return acc / jnp.maximum(cnt, 1.0)[:, None], cnt
+    # ONE fused centroid pass: each vertex class wants the centroid over
+    # a different edge subset (interior: all edges, surface: surface
+    # edges, ridge: feature edges — the movintpt/movbdyregpt/movbdyridpt
+    # neighbor disciplines). The classes partition the vertices, so the
+    # edge weight can be chosen PER ENDPOINT and all three accumulations
+    # share one scatter round — 1/3 the scatter dispatches of the former
+    # three-pass version on the latency-bound TPU path (round 5).
+    def end_w(vid):
+        return (
+            emask
+            & (
+                free_i[vid]
+                | (surf_v[vid] & surf_e)
+                | (ridge_v[vid] & feat_e)
+            )
+        ).astype(dtype)
 
-    cent_all, cnt_all = centroid_over(jnp.ones_like(emask))
-    cent_surf, cnt_surf = centroid_over(surf_e)
-    cent_feat, cnt_feat = centroid_over(feat_e)
+    wa = end_w(a)
+    wb = end_w(b)
+    acc = jnp.zeros((pcap, 3), dtype)
+    acc = common.scatter_rows(acc, a, vert0[b] * wa[:, None], op="add")
+    acc = common.scatter_rows(acc, b, vert0[a] * wb[:, None], op="add")
+    cnt = jnp.zeros(pcap, dtype)
+    cnt = cnt.at[a].add(wa, mode="drop")
+    cnt = cnt.at[b].add(wb, mode="drop")
+    cent = acc / jnp.maximum(cnt, 1.0)[:, None]
 
-    # interior: plain centroid
-    d_int = cent_all - vert0
+    d = cent - vert0
     # surface: tangential part of the surface-neighbor displacement
     # (movbdyregpt role — normal component removed against the vertex
     # normal so the vertex slides on the surface)
     vn = vertex_normals(mesh)
-    d_s = cent_surf - vert0
-    d_surf = d_s - jnp.sum(d_s * vn, axis=1, keepdims=True) * vn
-    # feature line: centroid of the (typically two) feature neighbors —
-    # exact for straight ridges, second-order error on curved ones
-    d_feat = cent_feat - vert0
+    d_surf = d - jnp.sum(d * vn, axis=1, keepdims=True) * vn
 
-    disp = jnp.where(
-        free_i[:, None] & (cnt_all > 0)[:, None], d_int, 0.0
-    )
-    disp = jnp.where(surf_v[:, None] & (cnt_surf > 0)[:, None], d_surf, disp)
-    disp = jnp.where(ridge_v[:, None] & (cnt_feat > 0)[:, None], d_feat, disp)
+    has_cnt = (cnt > 0)[:, None]
+    disp = jnp.where((free_i | ridge_v)[:, None] & has_cnt, d, 0.0)
+    disp = jnp.where(surf_v[:, None] & has_cnt, d_surf, disp)
     target = vert0 + relax * disp
 
     q_old = common.quality_of(vert0, mesh.met, mesh.tet)
@@ -154,19 +160,36 @@ def smooth_vertices(
         freeze_v = freeze_v.at[idxf.reshape(-1)].set(True, mode="drop")
         return frozen | freeze_v
 
-    frozen = jax.lax.fori_loop(0, rounds, body, ~movable)
+    if common._split_scatter_cols():
+        # TPU: each freeze round costs fixed scatter/gather latency
+        # whether or not it freezes anything; once a round adds no
+        # vertex the fixed point is reached — exit early (the common
+        # case after round 1 on a converged mesh). Carries derive from
+        # mesh data, not literals, so they stay device-varying under
+        # shard_map (same discipline as the collapse selection loop).
+        def w_cond(c):
+            _, k, changed = c
+            return (k < rounds) & changed
+
+        def w_body(c):
+            frozen, k, _ = c
+            f2 = body(None, frozen)
+            return f2, k + 1, jnp.any(f2 & ~frozen)
+
+        frozen, _, _ = jax.lax.while_loop(
+            w_cond, w_body,
+            (~movable, jnp.sum(mesh.tmask) * 0,
+             jnp.any(mesh.tmask) | True),
+        )
+    else:
+        frozen = jax.lax.fori_loop(0, rounds, body, ~movable)
 
     pos = jnp.where(frozen[:, None], vert0, target)
     bad_t, bad_f = bad_entities(pos)
     still_bad = jnp.any(bad_t) | jnp.any(bad_f)
     pos = jnp.where(still_bad, vert0, pos)
 
-    has_nbrs = (
-        (free_i & (cnt_all > 0))
-        | (surf_v & (cnt_surf > 0))
-        | (ridge_v & (cnt_feat > 0))
-    )
-    moved = movable & ~frozen & ~still_bad & has_nbrs
+    moved = movable & ~frozen & ~still_bad & (cnt > 0)
     return mesh.replace(vert=pos), SmoothStats(
         nmoved=jnp.sum(moved.astype(jnp.int32)),
         nfrozen=jnp.sum((movable & frozen).astype(jnp.int32)),

@@ -110,7 +110,7 @@ def split_long_edges(
             jnp.broadcast_to(av[:, None], (tcap, 6)).reshape(-1), mode="drop"
         )
 
-    win = common.two_phase_winners(l, cand, scatter_arena, gather_arena)
+    win = common.rank_winners(l, cand, scatter_arena, gather_arena)
 
     # --- capacity capping --------------------------------------------------
     inc_t = jnp.zeros(ecap, jnp.int32).at[safe_t2e.reshape(-1)].add(

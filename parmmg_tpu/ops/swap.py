@@ -176,8 +176,8 @@ def swap_32(
     def gather_arena(av):
         return jnp.maximum(jnp.maximum(av[s0], av[s1]), av[s2])
 
-    win = common.two_phase_winners(new_min - shell_q, cand,
-                                   scatter_arena, gather_arena)
+    win = common.rank_winners(new_min - shell_q, cand,
+                              scatter_arena, gather_arena)
 
     # apply: t1 overwrites the min-slot shell tet, t2 the middle one,
     # the max-slot one dies. Arena exclusivity makes every target tet
@@ -189,9 +189,11 @@ def swap_32(
     tgt2 = common.unique_oob(win, s2, tcap)
     tmask_new = tmask.at[tgt2].set(False, mode="drop", unique_indices=True)
 
-    # duplicate post-check (cross-swap interactions)
+    # duplicate post-check (cross-swap interactions). The killed tet
+    # (s2) cannot flag: its tmask was cleared before duplicate_tets ran,
+    # so only the two overwritten slots carry signal.
     dup = common.duplicate_tets(tet_new, tmask_new, bound=mesh.pcap)
-    bad = (dup[s0] | dup[s1] | dup[s2]) & win
+    bad = (dup[s0] | dup[s1]) & win
     win2 = win & ~bad
 
     def rebuild(_):
@@ -328,8 +330,8 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
     def gather_arena(av):
         return jnp.maximum(av[t_id], av[t2c])
 
-    win = common.two_phase_winners(new_min - old_min, cand,
-                                   scatter_arena, gather_arena)
+    win = common.rank_winners(new_min - old_min, cand,
+                              scatter_arena, gather_arena)
 
     # capacity: one appended tet per winner
     wi = win.astype(jnp.int32)

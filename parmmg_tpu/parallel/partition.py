@@ -89,6 +89,54 @@ def sfc_partition(
     return jnp.where(mesh.tmask, part, -1)
 
 
+@partial(jax.jit, static_argnames=("nparts", "nbuckets"))
+def stacked_graph_colors(
+    stacked: Mesh,
+    nparts: int,
+    nbuckets: int = 4096,
+) -> jax.Array:
+    """[D, TC] target-shard color per tet from a GLOBAL weighted SFC cut
+    computed WITHOUT centralizing the mesh — the graph-balancing
+    redistribution mode (reference `PMMG_REDISTRIBUTION_graph_balancing`,
+    `src/libparmmgtypes.h:173-178`, dispatched at
+    `src/distributegrps_pmmg.c:2055`; metis computes a fresh k-way cut of
+    the group graph there, here the weighted Morton cut plays that role
+    as everywhere else in this framework).
+
+    Device-side reduction shape: per-shard bucket histograms of Morton
+    keys (a [D, B] scatter-add), summed over the shard axis, prefix-
+    summed, and cut into `nparts` equal weight ranges — every shard then
+    reads its tets' target part from the shared [B] bucket→part table.
+    Balance granularity is one bucket (~ntet/nbuckets tets); interfaces
+    stay compact because buckets are contiguous Morton ranges. The
+    result feeds the same fixed-slot `migrate` path as interface
+    displacement — the mesh never touches the host."""
+    D, TC = stacked.tet.shape[:2]
+    live = stacked.tmask
+    # global bbox over all shards (all keys must share one frame)
+    bc = jax.vmap(lambda m: jnp.mean(m.vert[m.tet], axis=1))(stacked)
+    lo = jnp.min(jnp.where(live[..., None], bc, jnp.inf), axis=(0, 1))
+    hi = jnp.max(jnp.where(live[..., None], bc, -jnp.inf), axis=(0, 1))
+    keys = jax.vmap(lambda b: sfc.morton_keys(b, lo, hi))(bc)  # [D,TC]
+    # morton_keys yields 3*10-bit keys in [0, 2^30)
+    bucket = jnp.clip(keys >> (30 - nbuckets.bit_length() + 1),
+                      0, nbuckets - 1)
+    w = jax.vmap(metric_weights)(stacked)
+    hist = jnp.zeros((nbuckets,), jnp.float32)
+    hist = hist.at[bucket.reshape(-1)].add(
+        jnp.where(live, w, 0.0).reshape(-1)
+    )
+    csum = jnp.cumsum(hist)
+    total = csum[-1]
+    mid = csum - 0.5 * hist
+    part_of_bucket = jnp.clip(
+        (mid * nparts / jnp.maximum(total, 1e-30)).astype(jnp.int32),
+        0, nparts - 1,
+    )
+    color = part_of_bucket[bucket]
+    return jnp.where(live, color, -1)
+
+
 def renumber_sfc(mesh: Mesh) -> Mesh:
     """Reorder valid tets along the Morton curve (cache-locality role of
     the reference's Scotch renumbering)."""

@@ -8,7 +8,64 @@ slices. Run only via the test, which sets the PMMGTPU_* env contract."""
 import sys
 
 
+def adapt_main():
+    """End-to-end `adapt_stacked_input` under the multi-controller
+    runtime (or single-process with PMMGTPU_SPMD_SWEEPS=1, which runs
+    the IDENTICAL SPMD sweep programs — the bit-for-bit reference run).
+    niter=2 exercises a full displacement+migration round between the
+    iterations; the merged output is digested so the test can compare
+    the 2-process and 1-process results exactly. The reference analog is
+    its CI matrix running the whole driver under `mpiexec -np {1,2,...}`
+    (cmake/testing/pmmg_tests.cmake:30-38)."""
+    import hashlib
+
+    from parmmg_tpu.parallel import multihost
+
+    multi = multihost.init_from_env()
+
+    import jax
+    import numpy as np
+
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_stacked_input, merge_adapted,
+    )
+    from parmmg_tpu.ops import quality
+    from parmmg_tpu.parallel.distribute import split_mesh
+    from parmmg_tpu.parallel.partition import sfc_partition
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    if multi:
+        assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    # identical replicated host prep on every process
+    mesh = unit_cube_mesh(4)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 8)))
+    st, comm = split_mesh(mesh, part, 8)
+
+    out, comm2, info = adapt_stacked_input(
+        st, comm,
+        DistOptions(hsiz=0.2, niter=2, max_sweeps=4, nparts=8,
+                    min_shard_elts=8),
+    )
+    merged = merge_adapted(out, comm2)
+    d = jax.device_get(merged)
+    h = hashlib.sha256()
+    for name in ("vert", "vmask", "tet", "tmask", "tria", "trmask",
+                 "tref", "trref", "vtag", "trtag"):
+        h.update(np.ascontiguousarray(np.asarray(getattr(d, name))).tobytes())
+    qh = quality.quality_histogram(merged)
+    print(
+        f"ADAPT_DIGEST {h.hexdigest()} ne={int(qh.ne)} "
+        f"qmin={float(qh.qmin):.9f} qavg={float(qh.qavg):.9f} "
+        f"status={int(info['status'])}",
+        flush=True,
+    )
+
+
 def main():
+    if "--adapt" in sys.argv:
+        return adapt_main()
     # the package __init__ auto-initializes the multi-controller
     # runtime from the PMMGTPU_* env (before any backend touch) — the
     # same path `python -m parmmg_tpu` takes under a process launcher

@@ -75,3 +75,82 @@ def test_two_process_collectives(tmp_path):
         for ln in lines
     ]
     assert strip[0] == strip[1], lines
+
+
+@pytest.mark.slow
+def test_two_process_adaptation_matches_single_process(tmp_path):
+    """The FULL driver under two controllers: `adapt_stacked_input`
+    (niter=2, including one interface-displacement + migration round)
+    runs with its sweep programs genuinely SPMD over the 8 devices of
+    both processes, and the merged output must be BIT-IDENTICAL
+    (sha256 over every entity array) to a single-process run of the
+    same SPMD programs. The reference analog: its entire CI matrix runs
+    the driver under `mpiexec -np {1,2,4,6,8}`
+    (cmake/testing/pmmg_tests.cmake:30-38)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "multihost_worker.py")
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    def base_env(ndev):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={ndev}",
+            PYTHONPATH=root,
+        )
+        return env
+
+    # single-process reference: same SPMD sweep programs, one controller
+    ref_env = base_env(8)
+    ref_env["PMMGTPU_SPMD_SWEEPS"] = "1"
+    ref = subprocess.run(
+        [sys.executable, worker, "--adapt"], env=ref_env, cwd=root,
+        capture_output=True, text=True, timeout=1200,
+    )
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    ref_line = [ln for ln in ref.stdout.splitlines()
+                if ln.startswith("ADAPT_DIGEST")]
+    assert ref_line, ref.stdout + ref.stderr
+
+    procs = []
+    logs = []
+    for pid in (0, 1):
+        env = base_env(4)
+        env.update(
+            PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
+            PMMGTPU_NUM_PROCS="2",
+            PMMGTPU_PROC_ID=str(pid),
+        )
+        log = open(tmp_path / f"adapt{pid}.log", "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, "--adapt"], env=env,
+            stdout=log, stderr=subprocess.STDOUT, cwd=root,
+        ))
+    try:
+        for p in procs:
+            assert p.wait(timeout=1200) == 0, (
+                (tmp_path / "adapt0.log").read_text()
+                + (tmp_path / "adapt1.log").read_text()
+            )
+    finally:
+        for log in logs:
+            log.close()
+        for p in procs:
+            p.kill()
+
+    for pid in (0, 1):
+        text = (tmp_path / f"adapt{pid}.log").read_text()
+        ok = [ln for ln in text.splitlines()
+              if ln.startswith("ADAPT_DIGEST")]
+        assert ok, text
+        assert ok[0] == ref_line[0], (
+            f"proc {pid} diverged:\n  2-proc: {ok[0]}\n"
+            f"  1-proc: {ref_line[0]}"
+        )

@@ -206,6 +206,74 @@ def test_interface_displacement_refines_frozen_bands():
     assert counts[False] < 0.5 * counts[True], counts
 
 
+def test_stacked_graph_colors_rebalances_weights():
+    """The device-resident global weighted SFC cut (graph-balancing
+    redistribution, reference PMMG_REDISTRIBUTION_graph_balancing,
+    src/libparmmgtypes.h:173-178): starting from a COUNT-balanced
+    partition under a localized-refinement metric, the recomputed colors
+    must rebalance the PREDICTED-element weights across shards without
+    centralizing the mesh."""
+    from parmmg_tpu.core import adjacency as adj
+    from parmmg_tpu.parallel import partition as pm
+    from parmmg_tpu.parallel.distribute import split_mesh
+
+    mesh = unit_cube_mesh(5)
+    hv = np.full(mesh.pcap, 0.2, np.float64)
+    vert = np.asarray(mesh.vert)
+    hv[np.linalg.norm(vert - 0.15, axis=1) < 0.3] = 0.02
+    mesh = mesh.replace(
+        met=jnp.asarray(hv[:, None], mesh.dtype), met_set=True
+    )
+    mesh = adj.build_adjacency(mesh)
+    # unweighted cut: tet COUNTS balanced, predicted weights skewed
+    part = np.asarray(jax.device_get(pm.sfc_partition(mesh, 4)))
+    stacked, _ = split_mesh(mesh, part, 4)
+
+    w = np.asarray(jax.device_get(jax.vmap(pm.metric_weights)(stacked)))
+    color = np.asarray(jax.device_get(
+        pm.stacked_graph_colors(stacked, 4)
+    ))
+    tm = np.asarray(jax.device_get(stacked.tmask))
+    assert (color[tm] >= 0).all() and (color[~tm] == -1).all()
+    before = np.array([w[tm][np.where(tm)[0] == s].sum()
+                       for s in range(4)])
+    after = np.array([w[tm][color[tm] == s].sum() for s in range(4)])
+    assert before.max() / before.min() > 2.0, (
+        f"fixture not skewed enough to discriminate: {before}"
+    )
+    assert after.max() / after.min() < 1.5, (
+        f"graph cut left weights imbalanced: {after}"
+    )
+    # something actually moves
+    own = np.where(tm, np.arange(4)[:, None], -1)
+    assert (color[tm] != own[tm]).any()
+
+
+def test_graph_balancing_mode_end_to_end():
+    """adapt_distributed under repartitioning=graph_balancing: green
+    loop, conformal merged output, conserved volume — the driver-level
+    counterpart of the unit cut test (reference mode dispatch
+    src/distributegrps_pmmg.c:2055)."""
+    from parmmg_tpu.models.distributed import (
+        REDISTRIBUTION_GRAPH_BALANCING,
+    )
+
+    mesh = unit_cube_mesh(5)
+    opts = DistOptions(
+        nparts=4, niter=2, hsiz=0.18, max_sweeps=5, min_shard_elts=16,
+        repartitioning=REDISTRIBUTION_GRAPH_BALANCING, check_comm=True,
+    )
+    st, comm, info = adapt_distributed(mesh, opts)
+    assert info["status"] == tags.ReturnStatus.SUCCESS
+    merged = merge_adapted(st, comm)
+    rep = check_mesh(merged)
+    assert rep.ok, str(rep)
+    assert _total_volume(merged) == pytest.approx(1.0, rel=1e-5)
+    # the final shard tet counts respect the balance discipline
+    ne = np.asarray(jax.device_get(jnp.sum(st.tmask, axis=1)))
+    assert ne.max() <= opts.grps_ratio * max(ne.mean(), 1.0), ne
+
+
 def test_fix_contiguity_reattaches_pinched_island():
     """A component the front pinched off gets reassigned to its majority
     neighbor color (the PMMG_fix_contiguity / PMMG_check_reachability
@@ -312,6 +380,93 @@ def test_device_migration_conserves_and_retags():
     rep = check_mesh(merged)
     assert rep.ok, str(rep)
     assert int(merged.ntet) == ne0
+
+
+def test_retag_device_matches_host(monkeypatch):
+    """The device-resident retag (`_retag_device_core`: gid-histogram
+    PARBDY, one global sort-merge for cross-shard faces, vmapped
+    synthetic-tria bookkeeping) must reproduce the host-numpy reference
+    path exactly: same vertex tags, same live-tria multiset with the
+    same tags/refs, same rebuilt comm tables. Only the free-slot
+    placement of NEW synthetic trias may differ (host inserts in
+    lexicographic row order, device in enumeration order) — hence the
+    multiset comparison."""
+    import jax
+
+    from parmmg_tpu.core import adjacency as adj
+    from parmmg_tpu.core.mesh import compact
+    from parmmg_tpu.models.adapt import AdaptOptions, prepare_metric
+    from parmmg_tpu.models.distributed import grow_stacked
+    from parmmg_tpu.ops import analysis
+    from parmmg_tpu.parallel import migrate as mig
+    from parmmg_tpu.parallel.distribute import (
+        assign_global_ids, rebuild_comm, split_mesh,
+    )
+    from parmmg_tpu.parallel.partition import sfc_partition
+
+    mesh = unit_cube_mesh(5)
+    mesh = adj.build_adjacency(mesh)
+    mesh = analysis.analyze(mesh)
+    mesh = prepare_metric(
+        mesh, AdaptOptions(hsiz=0.2, hgrad=None), int(mesh.tcap * 1.6) + 64
+    )
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 4)))
+    stacked, comm = split_mesh(mesh, part, 4)
+    stacked = assign_global_ids(stacked)
+    comm = rebuild_comm(stacked)
+    stacked = jax.vmap(adj.build_adjacency)(stacked)
+    color = mig.displace_colors(stacked, comm, 4, round_id=0, layers=2)
+    cnts = np.asarray(jax.device_get(
+        mig.migration_counts(stacked, color, 4)
+    ))
+    assert cnts.sum() > 0
+    stacked = grow_stacked(
+        stacked,
+        pcap=stacked.vert.shape[1] * 2,
+        tcap=stacked.tet.shape[1] * 2,
+        fcap=stacked.tria.shape[1] * 2,
+        ecap=stacked.edge.shape[1] * 2,
+    )
+    color = jnp.pad(
+        color, ((0, 0), (0, stacked.tet.shape[1] - color.shape[1])),
+        constant_values=-1,
+    )
+    st2 = mig.migrate(stacked, color, 4, int(cnts.max()) + 8)
+    st2 = jax.vmap(compact)(st2)
+
+    dev, comm_dev = mig.retag_interfaces(st2)
+    monkeypatch.setenv("PARMMG_HOST_RETAG", "1")
+    host, comm_host = mig.retag_interfaces(st2)
+
+    vm = np.asarray(dev.vmask)
+    np.testing.assert_array_equal(
+        np.asarray(dev.vtag)[vm], np.asarray(host.vtag)[vm]
+    )
+
+    def tria_multiset(st):
+        out = []
+        for s in range(4):
+            live = np.asarray(st.trmask[s])
+            rows = np.sort(
+                np.asarray(st.vglob[s])[np.asarray(st.tria[s])[live]],
+                axis=1,
+            )
+            rec = np.concatenate(
+                [rows,
+                 np.asarray(st.trtag[s])[live][:, None],
+                 np.asarray(st.trref[s])[live][:, None]], axis=1
+            )
+            out.append(rec[np.lexsort(rec.T[::-1])])
+        return out
+
+    for a, b in zip(tria_multiset(dev), tria_multiset(host)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        np.asarray(comm_dev.counts), np.asarray(comm_host.counts)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(comm_dev.comm_idx), np.asarray(comm_host.comm_idx)
+    )
 
 
 def test_distributed_unfused_sweep_path(monkeypatch):

@@ -150,6 +150,108 @@ def test_distributed_io_checkpoint_loop(tmp_path):
     )
 
 
+def test_meshb_roundtrip(tmp_path):
+    """Binary Medit (.meshb/.solb): byte-for-byte content parity with
+    the ASCII path — same sections, same tags, same metric (reference
+    reads/writes binary wherever ASCII is handled, the bin/iswp branches
+    of src/inout_pmmg.c:88-105,239-330). At 10M tets an ASCII mesh is a
+    ~2 GB parse, so binary is the scale path."""
+    from parmmg_tpu.core.mesh import Mesh
+    from parmmg_tpu.io import medit
+
+    raw = unit_cube(3)
+    nv = len(raw["verts"])
+    vtags = np.zeros(nv, np.int32)
+    vtags[[0, 3]] |= tags.CORNER | tags.REQUIRED
+    vtags[[5, 9]] |= tags.REQUIRED
+    edges = np.array([[0, 1], [1, 2]], np.int32)
+    edtags = np.array([tags.RIDGE, tags.REQUIRED | tags.RIDGE], np.int32)
+    mesh = Mesh.from_numpy(
+        raw["verts"], raw["tets"], trias=raw["trias"],
+        trrefs=raw["trrefs"], vtags=vtags,
+        edges=edges, edtags=edtags,
+        met=np.full((len(raw["verts"]), 1), 0.3),
+    )
+    pa = str(tmp_path / "cube.mesh")
+    pb = str(tmp_path / "cube.meshb")
+    medit.save_mesh(mesh, pa)
+    medit.save_mesh(mesh, pb)
+    medit.save_met(mesh, str(tmp_path / "cube.sol"))
+    medit.save_met(mesh, str(tmp_path / "cube.solb"))
+    assert not medit.is_binary_file(pa)
+    assert medit.is_binary_file(pb)
+    ra = medit.read_mesh(pa)
+    rb = medit.read_mesh(pb)
+    # binary is bit-exact against the saved arrays; ASCII rounds at %.15g
+    np.testing.assert_array_equal(rb.verts, mesh.to_numpy()["verts"])
+    np.testing.assert_allclose(ra.verts, rb.verts, rtol=1e-14)
+    np.testing.assert_array_equal(rb.tets, ra.tets)
+    np.testing.assert_array_equal(rb.trias, ra.trias)
+    np.testing.assert_array_equal(rb.trrefs, ra.trrefs)
+    np.testing.assert_array_equal(rb.corners, ra.corners)
+    np.testing.assert_array_equal(rb.req_verts, ra.req_verts)
+    # non-empty id sections actually exercise the binary encoding
+    # (review r5: the 0-based write bug passed a corner-less fixture)
+    assert len(rb.corners) == 2 and set(rb.corners) == {0, 3}
+    assert set(rb.req_verts) == {5, 9}
+    np.testing.assert_array_equal(rb.ridges, ra.ridges)
+    assert len(rb.ridges) == 2
+    np.testing.assert_array_equal(rb.req_edges, ra.req_edges)
+    assert len(rb.req_edges) == 1
+    sa, ta = medit.read_sol(str(tmp_path / "cube.sol"))
+    sb, tb = medit.read_sol(str(tmp_path / "cube.solb"))
+    assert ta == tb
+    np.testing.assert_allclose(sb, sa, rtol=1e-14)
+
+
+def test_distributed_checkpoint_binary(tmp_path):
+    """The distributed checkpoint loop closes in BINARY: save_.meshb
+    shards with communicator records (codes 70-73, the reference's own
+    binary communicator encoding, src/inout_pmmg.c:137-142 — whose
+    WRITER the reference never implemented, src/libparmmg_tools.c:884),
+    reload, chkcomm, and interface discipline intact."""
+    from parmmg_tpu.io import medit
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_distributed,
+    )
+    from parmmg_tpu.parallel import chkcomm
+    from parmmg_tpu.parallel.shard import device_mesh
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    mesh = unit_cube_mesh(4)
+    opts = DistOptions(hsiz=0.2, niter=1, max_sweeps=4, nparts=4,
+                       min_shard_elts=8)
+    stacked, comm, _ = adapt_distributed(mesh, opts)
+    path = str(tmp_path / "ckpt.meshb")
+    medit.save_mesh_distributed(stacked, comm, path, with_met=True)
+    for r in range(4):
+        shard = str(tmp_path / f"ckpt.{r}.meshb")
+        assert os.path.exists(shard)
+        assert medit.is_binary_file(shard)
+        assert os.path.exists(str(tmp_path / f"ckpt.{r}.solb"))
+
+    stacked2, comm2 = medit.load_mesh_distributed(
+        path, 4, metpath=str(tmp_path / "ckpt.solb")
+    )
+    chkcomm.assert_comm_ok(stacked2, comm2, device_mesh(4), tol=1e-6)
+    from parmmg_tpu.core import tags as tg
+
+    tt0 = np.asarray(stacked.trtag)
+    tt1 = np.asarray(stacked2.trtag)
+    syn0 = np.asarray(stacked.trmask) & tg.pure_interface_tria(tt0)
+    syn1 = np.asarray(stacked2.trmask) & tg.pure_interface_tria(tt1)
+    assert syn0.sum() > 0, "expected synthetic interface trias in ckpt"
+    assert syn1.sum(axis=1).tolist() == syn0.sum(axis=1).tolist()
+    # metric survived the .solb round trip (save writes live rows in
+    # slot order, the loader fills a fresh prefix — row-aligned)
+    m0 = np.asarray(stacked.met)
+    m1 = np.asarray(stacked2.met)
+    vm = np.asarray(stacked.vmask)
+    for s in range(4):
+        nlive = int(vm[s].sum())
+        assert np.allclose(m1[s, :nlive, 0], m0[s][vm[s]][:, 0])
+
+
 def test_vtu_roundtrip(tmp_path):
     from parmmg_tpu.io import vtk
     from parmmg_tpu.utils.gen import unit_cube_mesh

@@ -1,0 +1,78 @@
+"""Chained scale ladder: warm + run each rung, append records to
+SCALE_RUNS.jsonl. Designed to run unattended for hours in the
+background while other work proceeds: each rung is independent, a
+failed warm still runs the measurement (the watchdogged scale_run pays
+the remaining compiles itself), and every completed record is flushed
+to disk immediately.
+
+Rungs climb toward the 10M-tet north star (BASELINE.json): n=14/0.03
+(~440k tets — the regime that has never completed on the TPU) then
+n=16/0.0229 (>=1M tets — the round-5 headline).
+
+Usage: python tools/scale_pipeline.py [--only RUNG]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from _cli import REPO, parse_argv  # noqa: F401
+
+RUNGS = [
+    # (name, n, hsiz, warm_stall, run_stall, run_retries)
+    ("m", 14, 0.03, 2100, 2100, 4),
+    ("xl", 16, 0.0229, 5400, 5400, 3),
+]
+
+OUT = os.path.join(REPO, "SCALE_RUNS.jsonl")
+
+
+def run_rung(name, n, hsiz, warm_stall, run_stall, retries):
+    t0 = time.time()
+    print(f"#### rung {name}: warm n={n} hsiz={hsiz}", flush=True)
+    warm = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "warm_ops.py"),
+         str(n), str(hsiz), "--stall", str(warm_stall)], cwd=REPO)
+    print(f"#### rung {name}: warm rc={warm.returncode} "
+          f"({round(time.time() - t0)}s); measuring", flush=True)
+    t1 = time.time()
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scale_run.py"),
+         str(n), str(hsiz), "--stall", str(run_stall),
+         "--retries", str(retries)],
+        cwd=REPO, capture_output=True, text=True)
+    sys.stdout.write(p.stdout)
+    rec = None
+    for line in reversed(p.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    if rec is not None:
+        rec["rung"] = name
+        rec["warm_rc"] = warm.returncode
+        rec["warm_s"] = round(t1 - t0, 1)
+        rec["measure_s"] = round(time.time() - t1, 1)
+        with open(OUT, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"#### rung {name}: RECORDED {rec}", flush=True)
+    else:
+        print(f"#### rung {name}: NO RECORD", flush=True)
+    return rec
+
+
+def main():
+    _, flags = parse_argv(sys.argv[1:])
+    only = flags.get("only")
+    for rung in RUNGS:
+        if only and rung[0] != only:
+            continue
+        run_rung(*rung)
+
+
+if __name__ == "__main__":
+    main()

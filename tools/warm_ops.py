@@ -22,7 +22,7 @@ from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
 
 OPS = [
     "compact", "unique_edges", "split", "collapse", "swap32",
-    "build_adjacency", "swap23", "smooth", "histogram",
+    "build_adjacency", "swap23", "smooth", "histogram", "polish",
 ]
 
 
@@ -63,6 +63,18 @@ def worker(n, hsiz, op):
         out, _ = smooth.smooth_vertices(mesh, edges, emask)
     elif op == "histogram":
         out = quality.quality_histogram(mesh)
+    elif op == "polish":
+        # the post-convergence polish dispatches a sweep variant
+        # (noinsert=True, phase_skip=False) that no other path compiles;
+        # below UNFUSED_TCAP it is a distinct fused program (ADVICE r4)
+        from parmmg_tpu.models import adapt as adapt_mod
+
+        unfused = mesh.tcap > adapt_mod.UNFUSED_TCAP
+        out, _ = (adapt_mod._sweep_body if unfused
+                  else adapt_mod.remesh_sweep)(
+            mesh, ecap, noinsert=True, phase_skip=False,
+            fused=not unfused)
+        out = out.tet
     else:
         raise SystemExit(f"unknown op {op}")
     jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
