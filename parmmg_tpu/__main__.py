@@ -350,12 +350,8 @@ def main(argv=None) -> int:
                     vtk_io.save_vtu(mesh_out, medit.shard_filename(out, 0))
             else:
                 medit.save_mesh(mesh_out, medit.shard_filename(out, 0))
-                base0, ext0 = os.path.splitext(
-                    medit.shard_filename(out, 0)
-                )
                 medit.save_met(
-                    mesh_out,
-                    base0 + (".solb" if ext0 == ".meshb" else ".sol"),
+                    mesh_out, medit.met_filename(medit.shard_filename(out, 0))
                 )
         else:
             if mesh_out is None:
@@ -366,12 +362,7 @@ def main(argv=None) -> int:
                 vtk_io.save_vtu(mesh_out, out)
             else:
                 medit.save_mesh(mesh_out, out)
-                base, ext = os.path.splitext(out)
-                # metric encoding follows the mesh encoding, like the
-                # reference's metout naming (.meshb -> .solb)
-                medit.save_met(
-                    mesh_out, base + (".solb" if ext == ".meshb" else ".sol")
-                )
+                medit.save_met(mesh_out, medit.met_filename(out))
         # interpolated solution fields (`-field` round trip, reference
         # `src/parmmg.c:433`)
         if args.field and not vtk:

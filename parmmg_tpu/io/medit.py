@@ -217,7 +217,13 @@ def _read_sections_binary(path: str):
         name = _KWD_NAMES.get(code)
         if name is None or name in ("End",):
             if nxt <= 0 or nxt <= r.off:
-                break  # malformed skip chain: stop like an EOF
+                # a broken skip chain means a truncated/corrupt file —
+                # surface it rather than returning a partial mesh
+                raise ValueError(
+                    f"{path}: malformed GMF record chain at byte "
+                    f"{r.off} (keyword code {code}, next={nxt}) — "
+                    "truncated or corrupt binary mesh"
+                )
             r.off = nxt
             continue
         if name == "Dimension":
@@ -310,6 +316,21 @@ def _rows_bytes(arr_i: np.ndarray, refs: np.ndarray | None,
 
 
 def _save_mesh_binary(
+    path: str,
+    d: Dict[str, np.ndarray],
+    comm_sections,
+) -> None:
+    try:
+        _save_mesh_binary_inner(path, d, comm_sections)
+    except Exception:
+        # never leave a truncated .meshb behind: a later load would
+        # sniff the valid cookie and then fail mid-chain
+        if os.path.exists(path):
+            os.unlink(path)
+        raise
+
+
+def _save_mesh_binary_inner(
     path: str,
     d: Dict[str, np.ndarray],
     comm_sections,
@@ -748,6 +769,15 @@ def shard_filename(path: str, rank: int) -> str:
     return f"{base}.{rank}{ext}"
 
 
+def met_filename(path: str) -> str:
+    """Metric sol name next to a mesh path, with the encoding following
+    the mesh encoding (`.meshb` -> `.solb`, like the reference's metout
+    naming) — the one definition shared by the CLI and distributed
+    writers."""
+    base, ext = os.path.splitext(path)
+    return base + (".solb" if ext == ".meshb" else ".sol")
+
+
 def save_mesh_distributed(stacked: Mesh, comm, path: str,
                           with_met: bool = False) -> None:
     """Write per-shard `name.<rank>.mesh` files with the parallel
@@ -810,8 +840,7 @@ def save_mesh_distributed(stacked: Mesh, comm, path: str,
         save_mesh(m, shard_filename(path, s), node_comms=node_comms,
                   face_comms=face_comms or None)
         if with_met:
-            base, ext = os.path.splitext(shard_filename(path, s))
-            save_met(m, base + (".solb" if ext == ".meshb" else ".sol"))
+            save_met(m, met_filename(shard_filename(path, s)))
 
 
 def load_mesh_distributed(path: str, nparts: int, metpath: str | None = None,

@@ -213,7 +213,20 @@ def _remesh_phase_global(
     from ..parallel import multihost
     from ..parallel.shard import AXIS, _squeeze, _unsqueeze, device_mesh
 
+    from .adapt import UNFUSED_TCAP
+
     D = st.tet.shape[0]
+    if st.tet.shape[1] > UNFUSED_TCAP:
+        # Above the compile-budget threshold the fused whole-sweep
+        # program must not be built (whole-program XLA scheduling costs
+        # hours at these shapes — PERF_NOTES r4); the per-op unfused
+        # path cannot run inside one shard_map program, so fall back to
+        # the replicated vmapped engine: every process computes all
+        # shards (correct, deterministic, compile-bounded) — the
+        # distribution of sweep COMPUTE across processes is then lost,
+        # which is the documented trade until a per-op shard_map
+        # dispatch exists.
+        return _remesh_phase_local(st, opts, emult, history, it, hausd)
     dmesh = device_mesh(D)
 
     def sweep_fn(s, ecap):
@@ -266,7 +279,13 @@ def remesh_phase(
     shared `run_sweep_loop` engine with cross-shard-aggregated stats."""
     if _use_spmd_sweeps():
         return _remesh_phase_global(st, opts, emult, history, it, hausd)
+    return _remesh_phase_local(st, opts, emult, history, it, hausd)
 
+
+def _remesh_phase_local(
+    st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
+    it: int, hausd,
+) -> Mesh:
     def sweep_fn(s, ecap):
         s, stats = _vsweep(s, ecap, opts, hausd)
         rec = dict(

@@ -1,0 +1,58 @@
+"""Wall-clock phase breakdown of one bench-shaped adapt() on the
+current backend: timestamps every verbose phase marker and sweep line,
+plus the surrounding warmup/timed split — locates where non-sweep wall
+time goes (dispatch round trips, polish, analysis, interp).
+
+Usage: python tools/phase_times.py [n] [hsiz] [max_sweeps]
+"""
+
+import sys
+import time
+
+from _cli import REPO, parse_argv  # noqa: F401
+
+import builtins
+
+_t0 = time.perf_counter()
+_orig = builtins.print
+
+
+def _tprint(*a, **k):
+    _orig(f"[{time.perf_counter() - _t0:8.2f}s]", *a, **k)
+
+
+def main():
+    pos, _ = parse_argv(sys.argv[1:])
+    n = int(pos[0]) if pos else 10
+    hsiz = float(pos[1]) if len(pos) > 1 else 0.05
+    ms = int(pos[2]) if len(pos) > 2 else 12
+
+    import bench
+
+    bench._enable_compile_cache()
+    import jax
+
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+
+    _tprint(f"platform={jax.devices()[0].platform}")
+    opts = AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=ms, hgrad=None,
+                        verbose=2)
+    builtins.print = _tprint
+    try:
+        mesh = bench._workload(n, hsiz)
+        _tprint("== warmup adapt ==")
+        adapt(mesh, opts)
+        _tprint("== timed adapt ==")
+        t0 = time.perf_counter()
+        mesh = bench._workload(n, hsiz)
+        _tprint("   (workload rebuilt)")
+        out, info = adapt(mesh, opts)
+        wall = time.perf_counter() - t0
+        _tprint(f"== done: ne={int(out.ntet)} wall={wall:.2f}s "
+                f"tps={int(out.ntet) / wall:.1f}")
+    finally:
+        builtins.print = _orig
+
+
+if __name__ == "__main__":
+    main()

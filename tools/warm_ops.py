@@ -21,7 +21,7 @@ import time
 from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
 
 OPS = [
-    "compact", "unique_edges", "split", "collapse", "swap32",
+    "prep", "compact", "unique_edges", "split", "collapse", "swap32",
     "build_adjacency", "swap23", "smooth", "histogram", "polish",
 ]
 
@@ -40,6 +40,23 @@ def worker(n, hsiz, op):
 
     mesh = bench._workload(n, hsiz)
     ecap = int(mesh.tcap * 1.6) + 64
+    if op == "prep":
+        # adapt()'s pre-sweep phases (analysis / metric / histogram /
+        # target estimate) compile their own programs that the per-op
+        # list below never builds — at 844k-tet shapes they cost long
+        # enough to trip the scale_run stall watchdog when cold
+        from parmmg_tpu.models.adapt import (
+            estimate_target_ntet, prepare_metric, resolve_hausd,
+        )
+        from parmmg_tpu.ops import analysis
+
+        m = analysis.analyze(mesh)
+        m = prepare_metric(m, AdaptOptions(hsiz=hsiz, hgrad=None), ecap)
+        resolve_hausd(m, AdaptOptions(hgrad=None))
+        estimate_target_ntet(m)
+        out = quality.quality_histogram(m)
+        jax.block_until_ready(out.counts)
+        return
     mesh = compact(mesh)
     if op == "compact":
         jax.block_until_ready(mesh.tet)
