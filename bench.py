@@ -63,7 +63,12 @@ def est_out_tets(hsiz):
 def _workload(n, hsiz):
     """Mesh pre-sized so the whole adaptation stays in ONE capacity
     bucket: every kernel compiles exactly once (compile over the TPU
-    tunnel costs minutes; execution costs seconds)."""
+    tunnel costs minutes; execution costs seconds). The feature-edge
+    capacity is presized too: analysis detects the cube's 12 ridge
+    lines and splits grow them to ~(est/12)^(1/3) segments each — an
+    un-presized ecap reshapes the edge table mid-run and invalidates
+    every warmed kernel (the round-4/5 'unfused run never completes'
+    failure)."""
     from parmmg_tpu.utils.gen import unit_cube_mesh
 
     est = est_out_tets(hsiz)
@@ -72,21 +77,34 @@ def _workload(n, hsiz):
         tcap=int(est * 1.9),
         pcap=max(int(est * 0.45), 4096),
         fcap=max(int(est * 0.30), 4096),
+        ecap=max(int(24 * (est / 12.0) ** (1.0 / 3.0)) + 256, 1024),
     )
 
 
 def _enable_compile_cache():
-    """Persistent XLA compile cache, TPU only. Compilation over the shared
-    TPU tunnel costs 10-45 min cold; a disk cache hit costs <1 s. The env
-    var JAX_COMPILATION_CACHE_DIR is not honored by this jax build, so the
-    config flag is set programmatically. The CPU backend segfaults with
-    the cache enabled (tests/conftest.py), so it is gated on platform."""
+    """Persistent XLA compile cache. Compilation over the shared TPU
+    tunnel costs 10-45 min cold; a disk cache hit costs <1 s. The env
+    var JAX_COMPILATION_CACHE_DIR is not honored by this jax build, so
+    the config flag is set programmatically. The CPU backend shares the
+    test suite's cache dir (the round-2-era (de)serialization segfault
+    no longer reproduces — tests/conftest.py note), which makes the
+    same-day CPU anchor re-measurements cheap."""
+    # loader-spam silencing must land before the XLA plugin loads
+    # (jax.devices() below latches the C++ log level) — keyed off the
+    # requested platform since the backend is not known yet. TPU runs
+    # keep full error logging: tunnel diagnostics matter there.
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
     import jax
 
-    if jax.devices()[0].platform != "tpu":
-        return
     here = os.path.dirname(os.path.abspath(__file__))
-    jax.config.update("jax_compilation_cache_dir", os.path.join(here, ".jax_cache"))
+    if jax.devices()[0].platform == "tpu":
+        cache = os.path.join(here, ".jax_cache")
+    elif os.environ.get("PARMMG_NO_CPU_CACHE"):
+        return  # same escape hatch as tests/conftest.py
+    else:
+        cache = os.path.join(here, "tests", ".jax_cache_cpu")
+    jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 1)
 
@@ -223,8 +241,8 @@ def main():
     for cfg, est in (
         (dict(n=12, hsiz=0.04, anchor=CPU_ANCHOR_TPS_LARGE), 240),
         (dict(n=14, hsiz=0.03, anchor=CPU_ANCHOR_TPS_XL), 500),
-        (dict(n=16, hsiz=0.0225, anchor=CPU_ANCHOR_TPS_XL,
-              max_sweeps=14), 1100),
+        (dict(n=16, hsiz=0.02, anchor=CPU_ANCHOR_TPS_XL,
+              max_sweeps=14), 1300),
     ):
         tmo = remaining()
         if tmo < est:

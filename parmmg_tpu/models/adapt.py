@@ -593,10 +593,14 @@ def run_sweep_loop(
         rec.update(iter=it, sweep=sweep)
         history.append(rec)
         if opts.verbose >= 2:
+            # flush: these lines are the liveness signal stall watchdogs
+            # key off (tools/scale_run.py) — block-buffered pipes would
+            # starve the watchdog while sweeps progress
             print(
                 f"  it {it} sweep {sweep}: +{rec['nsplit']} split "
                 f"-{rec['ncollapse']} collapse {rec['nswap']} swap "
-                f"{rec['nmoved']} moved -> ne={rec['ne']}"
+                f"{rec['nmoved']} moved -> ne={rec['ne']}",
+                flush=True,
             )
         nops = rec["nsplit"] + rec["ncollapse"] + rec["nswap"]
         if (
@@ -659,7 +663,8 @@ def run_batched_sweep_loop(
                 print(
                     f"  it {it} sweep {rec['sweep']}: +{rec['nsplit']} "
                     f"split -{rec['ncollapse']} collapse {rec['nswap']} "
-                    f"swap {rec['nmoved']} moved -> ne={rec['ne']}"
+                    f"swap {rec['nmoved']} moved -> ne={rec['ne']}",
+                    flush=True,
                 )
         last = history[-1]
         overflow = last["n_unique"] > ecap

@@ -479,6 +479,11 @@ def test_distributed_unfused_sweep_path(monkeypatch):
     )
 
     monkeypatch.setattr(A, "UNFUSED_TCAP", 64)
+    # this test compiles many small per-op programs late in the module;
+    # after the ~60 compile-heavy tests before it, the next big compile
+    # can segfault the jaxlib CPU compiler (conftest note; same
+    # workaround as the m6 option sweep) — drop executable caches first
+    jax.clear_caches()
     mesh = unit_cube_mesh(3)
     stacked, comm, info = adapt_distributed(
         mesh, DistOptions(niter=1, max_sweeps=3, nparts=2, hsiz=0.25,

@@ -73,6 +73,11 @@ def drive(n, hsiz, stall, retries):
             [sys.executable, os.path.abspath(__file__), "--worker",
              str(n), str(hsiz)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=REPO,
+            # unbuffered worker stdio: the watchdog below keys off
+            # output cadence, and a block-buffered pipe would hide
+            # minutes of per-sweep progress (observed: healthy n=14
+            # runs killed at the stall limit with sweeps mid-flight)
+            env=dict(os.environ, PYTHONUNBUFFERED="1"),
         )
         os.set_blocking(p.stdout.fileno(), False)
         last_out = time.time()

@@ -40,21 +40,27 @@ def worker(n, hsiz, op):
 
     mesh = bench._workload(n, hsiz)
     ecap = int(mesh.tcap * 1.6) + 64
-    if op == "prep":
-        # adapt()'s pre-sweep phases (analysis / metric / histogram /
-        # target estimate) compile their own programs that the per-op
-        # list below never builds — at 844k-tet shapes they cost long
-        # enough to trip the scale_run stall watchdog when cold
-        from parmmg_tpu.models.adapt import (
-            estimate_target_ntet, prepare_metric, resolve_hausd,
-        )
-        from parmmg_tpu.ops import analysis
+    # the real run enters the sweeps AFTER analysis + metric prep, so
+    # every program below must be warmed at the ANALYZED shapes: with
+    # an un-presized workload, analyze() grows the feature-edge
+    # capacity and the warms would compile the wrong bucket
+    from parmmg_tpu.models.adapt import prepare_metric
+    from parmmg_tpu.ops import analysis
 
-        m = analysis.analyze(mesh)
-        m = prepare_metric(m, AdaptOptions(hsiz=hsiz, hgrad=None), ecap)
-        resolve_hausd(m, AdaptOptions(hgrad=None))
-        estimate_target_ntet(m)
-        out = quality.quality_histogram(m)
+    mesh = analysis.analyze(mesh)
+    mesh = prepare_metric(mesh, AdaptOptions(hsiz=hsiz, hgrad=None), ecap)
+    if op == "prep":
+        # the remaining pre-sweep phases (hausd resolve / target
+        # estimate / histogram) compile their own programs — at
+        # 844k-tet shapes they cost long enough to trip the scale_run
+        # stall watchdog when cold
+        from parmmg_tpu.models.adapt import (
+            estimate_target_ntet, resolve_hausd,
+        )
+
+        resolve_hausd(mesh, AdaptOptions(hgrad=None))
+        estimate_target_ntet(mesh)
+        out = quality.quality_histogram(mesh)
         jax.block_until_ready(out.counts)
         return
     mesh = compact(mesh)
@@ -109,8 +115,15 @@ def main():
     # ~850k-tet capacities): a timeout below it livelocks — a killed
     # compile caches nothing
     stall = int(flags.get("stall", 1800))
+    # --ops a,b,c: warm a subset (lets two warmers split the list and
+    # overlap server-side compiles — watch the compile-helper OOM risk)
+    ops = flags.get("ops")
+    ops = ops.split(",") if ops else OPS
+    unknown = set(ops) - set(OPS)
+    if unknown:  # fail in milliseconds, not after a cold-compile chain
+        raise SystemExit(f"unknown ops {sorted(unknown)}; valid: {OPS}")
     failed = []
-    for op in OPS:
+    for op in ops:
         ok = False
         for attempt in (1, 2, 3):
             t0 = time.time()
