@@ -28,22 +28,24 @@ import time
 # steady-state tets/sec of the default workload on the host CPU backend
 # (measured with a warm jit cache; see BASELINE.md "CPU anchor" row).
 # History: round-2 M5/M6 kernels 1367.3; round-3 passes 2128.2 /
-# 2003.5; re-measured 2026-08-01 with the round-4 kernels (rank-MIS
-# collapse, compacted swap23): 93,976 output tets in 44.3 s. Host
-# wall-clock drifts a few percent with machine load — anchors are
-# refreshed the same day as the TPU measurement so vs_baseline stays
-# an honest same-code same-day hardware ratio.
-CPU_ANCHOR_TPS = 2122.7
+# 2003.5; round-4 2122.7; re-measured 2026-08-03 with the round-5
+# kernels (one-round rank MIS, fused smoothing centroids): 91,100
+# output tets in 38.7 s. Host wall-clock drifts a few percent with
+# machine load — anchors are refreshed the same day as the TPU
+# measurement so vs_baseline stays an honest same-code same-day
+# hardware ratio.
+CPU_ANCHOR_TPS = 2351.3
 # CPU anchor for the large workload (n=12, hsiz=0.04 -> ~200k tets):
-# 200,512 tets in 175.7 s, measured idle 2026-08-01 on the round-4
-# tree (round 3: 1,060.3). The CPU halves its rate at this size
-# (working set leaves cache) while the TPU holds steady — the large
-# config is the representative point for the 10M-tet north star.
-CPU_ANCHOR_TPS_LARGE = 1141.4
-# CPU anchor for the xl workload (n=14, hsiz=0.03, ~390k tets): the CPU
-# rate stays flat once out of cache (1,031 tets/s measured 2026-07-31
-# round 3; see PERF_NOTES.md)
-CPU_ANCHOR_TPS_XL = 1031.0
+# 201,001 tets in 163.5 s, measured 2026-08-03 on the round-5 tree
+# (round 4: 1,141.4; round 3: 1,060.3). The CPU halves its rate at
+# this size (working set leaves cache) while the TPU holds steady —
+# the large configs are the representative points for the 10M-tet
+# north star.
+CPU_ANCHOR_TPS_LARGE = 1229.1
+# CPU anchor for the xl ladder (n=14, hsiz=0.03: 325,232 tets in
+# 353.9 s, measured 2026-08-03 round-5 tree; round 3 measured 1,031 at
+# the same class — the rate keeps sagging as the working set grows)
+CPU_ANCHOR_TPS_XL = 919.0
 
 # Total wall-clock the bench allows itself. The round-4 driver run was
 # killed by the harness outer timeout (rc=124) AFTER its record lines
@@ -60,7 +62,7 @@ def est_out_tets(hsiz):
     return int(12.0 / hsiz**3)
 
 
-def _workload(n, hsiz):
+def _workload(n, hsiz, tight=False):
     """Mesh pre-sized so the whole adaptation stays in ONE capacity
     bucket: every kernel compiles exactly once (compile over the TPU
     tunnel costs minutes; execution costs seconds). The feature-edge
@@ -68,16 +70,34 @@ def _workload(n, hsiz):
     lines and splits grow them to ~(est/12)^(1/3) segments each — an
     un-presized ecap reshapes the edge table mid-run and invalidates
     every warmed kernel (the round-4/5 'unfused run never completes'
-    failure)."""
+    failure).
+
+    `tight` trims the headroom for the million-tet-class rungs, where
+    XLA compile time scales with the array sizes and the generous
+    default sizing is the difference between a 90-minute and a
+    ~60-minute analysis compile: the measured PEAK element count is
+    1.05-1.18x est (growth tapers at the metric target), so 1.45x
+    covers it with margin; vertices peak near 0.19x est and surface
+    trias far below 0.12x est."""
     from parmmg_tpu.utils.gen import unit_cube_mesh
 
     est = est_out_tets(hsiz)
+    if tight:
+        caps = dict(
+            tcap=int(est * 1.45),
+            pcap=max(int(est * 0.28), 4096),
+            fcap=max(int(est * 0.12), 4096),
+        )
+    else:
+        caps = dict(
+            tcap=int(est * 1.9),
+            pcap=max(int(est * 0.45), 4096),
+            fcap=max(int(est * 0.30), 4096),
+        )
     return unit_cube_mesh(
         n,
-        tcap=int(est * 1.9),
-        pcap=max(int(est * 0.45), 4096),
-        fcap=max(int(est * 0.30), 4096),
         ecap=max(int(24 * (est / 12.0) ** (1.0 / 3.0)) + 256, 1024),
+        **caps,
     )
 
 
@@ -103,13 +123,17 @@ def _enable_compile_cache():
     elif os.environ.get("PARMMG_NO_CPU_CACHE"):
         return  # same escape hatch as tests/conftest.py
     else:
-        cache = os.path.join(here, "tests", ".jax_cache_cpu")
+        # NOT the test suite's committed tests/.jax_cache_cpu: bench
+        # shapes would dirty the tracked artifact with large blobs the
+        # suite never loads
+        cache = os.path.join(here, ".jax_cache_cpu")
     jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 1)
 
 
-def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS):
+def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
+        tight=False):
     import jax
 
     from parmmg_tpu.models.adapt import AdaptOptions, adapt
@@ -121,9 +145,9 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS):
 
     # warmup run: pays every jit compile; the timed run below hits the
     # in-process executable cache (same static shapes by construction)
-    adapt(_workload(n, hsiz), opts)
+    adapt(_workload(n, hsiz, tight), opts)
 
-    mesh = _workload(n, hsiz)
+    mesh = _workload(n, hsiz, tight)
     t0 = time.perf_counter()
     out, info = adapt(mesh, opts)
     wall = time.perf_counter() - t0
@@ -241,8 +265,11 @@ def main():
     for cfg, est in (
         (dict(n=12, hsiz=0.04, anchor=CPU_ANCHOR_TPS_LARGE), 240),
         (dict(n=14, hsiz=0.03, anchor=CPU_ANCHOR_TPS_XL), 500),
+        # warm-cache estimate; only reachable when the earlier rungs
+        # finish fast (or with a raised PARMMG_BENCH_BUDGET_S) — the
+        # canonical 1M-tet record lives in SCALE_RUNS.jsonl either way
         (dict(n=16, hsiz=0.02, anchor=CPU_ANCHOR_TPS_XL,
-              max_sweeps=14), 1300),
+              max_sweeps=20, tight=True), 900),
     ):
         tmo = remaining()
         if tmo < est:

@@ -29,7 +29,7 @@ import time
 from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
 
 
-def worker(n, hsiz):
+def worker(n, hsiz, tight=False):
     import bench
 
     bench._enable_compile_cache()
@@ -39,12 +39,18 @@ def worker(n, hsiz):
     from parmmg_tpu.ops import quality
 
     est = bench.est_out_tets(hsiz)
-    print(f"n={n} hsiz={hsiz} est_out={est} platform="
+    print(f"n={n} hsiz={hsiz} est_out={est} tight={tight} platform="
           f"{jax.devices()[0].platform}", flush=True)
-    mesh = bench._workload(n, hsiz)
+    mesh = bench._workload(n, hsiz, tight)
     print(f"input ne={int(mesh.ntet)} tcap={mesh.tcap} pcap={mesh.pcap}",
           flush=True)
-    opts = AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=14, hgrad=None,
+    # budget: refinement needs ~log2(est/input_ne) doubling sweeps (the
+    # MIS splits at most one edge per tet per sweep) BEFORE quality
+    # work starts; 60x-class refinements (n=16 -> hsiz 0.02) burn 6
+    # sweeps on growth alone, so 14 would exhaust mid-growth and leave
+    # an unconverged uniform bisection (observed: ne exactly 64x input,
+    # qmin == qavg)
+    opts = AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=20, hgrad=None,
                         verbose=2)
     t0 = time.perf_counter()
     out, info = adapt(mesh, opts)
@@ -64,14 +70,14 @@ def worker(n, hsiz):
     print(json.dumps(rec), flush=True)
 
 
-def drive(n, hsiz, stall, retries):
+def drive(n, hsiz, stall, retries, tight=False):
     """Run the worker under the stall watchdog. Returns the final JSON
     record line, or None."""
     for attempt in range(retries):
         print(f"## attempt {attempt + 1}/{retries}", flush=True)
         p = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
-             str(n), str(hsiz)],
+             str(n), str(hsiz)] + (["tight"] if tight else []),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=REPO,
             # unbuffered worker stdio: the watchdog below keys off
             # output cadence, and a block-buffered pipe would hide
@@ -122,14 +128,16 @@ def drive(n, hsiz, stall, retries):
 def main():
     argv = sys.argv[1:]
     if argv and argv[0] == "--worker":
-        worker(int(argv[1]), float(argv[2]))
+        worker(int(argv[1]), float(argv[2]),
+               tight=len(argv) > 3 and argv[3] == "tight")
         return
     pos, flags = parse_argv(argv)
     n = int(pos[0]) if pos else 14
     hsiz = float(pos[1]) if len(pos) > 1 else 0.03
     stall = int(flags.get("stall", 1500))
     retries = int(flags.get("retries", 6))
-    rec = drive(n, hsiz, stall, retries)
+    tight = flags.get("tight", "") not in ("", "0")
+    rec = drive(n, hsiz, stall, retries, tight=tight)
     if rec is None:
         print("## all attempts stalled", flush=True)
         sys.exit(1)

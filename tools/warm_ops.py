@@ -26,7 +26,7 @@ OPS = [
 ]
 
 
-def worker(n, hsiz, op):
+def worker(n, hsiz, op, tight=False):
     import bench
 
     bench._enable_compile_cache()
@@ -38,7 +38,7 @@ def worker(n, hsiz, op):
     from parmmg_tpu.models.adapt import AdaptOptions
     from parmmg_tpu.ops import collapse, quality, smooth, split, swap
 
-    mesh = bench._workload(n, hsiz)
+    mesh = bench._workload(n, hsiz, tight)
     ecap = int(mesh.tcap * 1.6) + 64
     # the real run enters the sweeps AFTER analysis + metric prep, so
     # every program below must be warmed at the ANALYZED shapes: with
@@ -106,11 +106,13 @@ def worker(n, hsiz, op):
 def main():
     argv = sys.argv[1:]
     if argv and argv[0] == "--worker":
-        worker(int(argv[1]), float(argv[2]), argv[3])
+        worker(int(argv[1]), float(argv[2]), argv[3],
+               tight=len(argv) > 4 and argv[4] == "tight")
         return
     pos, flags = parse_argv(argv)
     n = int(pos[0]) if pos else 14
     hsiz = float(pos[1]) if len(pos) > 1 else 0.03
+    tight = flags.get("tight", "") not in ("", "0")
     # above the measured worst single-op compile (~1250 s for split at
     # ~850k-tet capacities): a timeout below it livelocks — a killed
     # compile caches nothing
@@ -130,7 +132,8 @@ def main():
             try:
                 rc = subprocess.run(
                     [sys.executable, os.path.abspath(__file__),
-                     "--worker", str(n), str(hsiz), op],
+                     "--worker", str(n), str(hsiz), op]
+                    + (["tight"] if tight else []),
                     timeout=stall, cwd=REPO,
                 ).returncode
             except subprocess.TimeoutExpired:
