@@ -1,0 +1,11 @@
+#!/bin/bash
+# staged xl (n=16) warm+run: the prep chain is one giant analysis-shape
+# compile that can exceed the default 90-min stall on a loaded tunnel —
+# give it ONE long-capped attempt, then warm the rest, then measure.
+cd /root/repo
+python tools/warm_ops.py 16 0.02 --tight 1 --stall 10800 --ops prep
+echo "## stage prep rc=$?"
+python tools/warm_ops.py 16 0.02 --tight 1 --stall 5400 --ops compact,unique_edges,split,collapse,swap32,build_adjacency,swap23,smooth,histogram,polish
+echo "## stage rest rc=$?"
+python tools/scale_run.py 16 0.02 --tight 1 --stall 2700 --retries 4
+echo "## stage run rc=$?"
