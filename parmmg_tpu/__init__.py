@@ -36,5 +36,17 @@ if _os.environ.get("PMMGTPU_COORDINATOR"):
 
     _multihost.init_from_env()
 
+# jax version graft: this tree (and its tests) target the public
+# `jax.shard_map` API; on jax builds that still ship it as
+# `jax.experimental.shard_map` only, alias it so one source works on
+# both — without this every shard_map code path dies with
+# AttributeError on the older runtime
+import jax as _jax  # noqa: E402
+
+if not hasattr(_jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map  # noqa: E402
+
+    _jax.shard_map = _shard_map
+
 from .core.mesh import Mesh  # noqa: E402,F401
 from .core import tags  # noqa: E402,F401

@@ -12,10 +12,14 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .mesh import EDGE_VERTS, FACE_VERTS, Mesh
 
-_BIG = jnp.int32(2**30)
+# numpy, not jnp: an import-time jnp constant becomes a leaked tracer
+# if the module is first imported under an active trace (see the
+# SENT_U32 note in ops/common.py)
+_BIG = np.int32(2**30)
 
 
 def _sort3(a, b, c):
@@ -25,6 +29,7 @@ def _sort3(a, b, c):
     return lo, mid, hi
 
 
+# parmmg-lint: disable=PML005 -- rebuilds adja only; warm/profile harnesses and tests reuse the input mesh
 @jax.jit
 def build_adjacency(mesh: Mesh) -> Mesh:
     """Fill `mesh.adja`: adja[t,f] = 4*t2+f2 for the tet face glued to (t,f),
@@ -74,6 +79,7 @@ def build_adjacency(mesh: Mesh) -> Mesh:
     return mesh.replace(adja=adja_flat.reshape(tc, 4))
 
 
+# parmmg-lint: disable=PML005 -- pure query (edge table); every caller keeps using the mesh
 @partial(jax.jit, static_argnames=("ecap",))
 def unique_edges(mesh: Mesh, ecap: int):
     """Extract unique undirected edges of the valid tets.

@@ -1,0 +1,32 @@
+"""parmmg_tpu.lint — JAX-invariant static analyzer + runtime contracts.
+
+The reference ParMmg guards its pointer kernels with pervasive runtime
+assertions and communicator checks (`chkcomm_pmmg.c`); this package is
+the analogous guard rail for the TPU port, whose correctness hinges on
+*implicit* JAX invariants instead: fixed array shapes, `-1` sentinel
+padding, int32 connectivity, and no host syncs or retraces inside the
+jitted remesh-repartition loop.
+
+Two halves:
+
+- the AST static analyzer (`python -m parmmg_tpu.lint <paths>`), rule
+  catalog in `rules.py`, engine in `analyzer.py`.  Pure-stdlib: linting
+  never imports jax.
+- the runtime contract layer (`contracts.py`): cheap jit-compatible
+  mesh/communicator invariant checkers plus a retrace-counter harness.
+  Imported lazily so the CLI stays light.
+
+Suppression syntax (same line, the line above, or the `def`/decorator
+line to scope a whole function)::
+
+    x = np.asarray(t)  # parmmg-lint: disable=PML001  -- host fallback path
+
+File-level, in the first comment block::
+
+    # parmmg-lint: disable-file=PML009
+"""
+
+from .analyzer import Finding, Project, analyze_paths  # noqa: F401
+from .rules import RULES, run_lint  # noqa: F401
+
+__all__ = ["Finding", "Project", "analyze_paths", "RULES", "run_lint"]

@@ -1,0 +1,767 @@
+"""AST project model for the JAX-invariant linter.
+
+Builds, with the stdlib only (no jax import — the linter must run in a
+bare interpreter and never touch the accelerator tunnel):
+
+- a module table for every ``.py`` file under the linted paths, with
+  import-alias resolution (absolute and package-relative);
+- the set of *jit entry points*: functions decorated ``@jax.jit`` /
+  ``@partial(jax.jit, ...)``, module-level ``name = partial(jax.jit,
+  ...)(fn)`` wrappings, and functions passed to an inline ``jax.jit(...)``
+  call (unwrapping ``shard_map``/``vmap``/``partial`` shells);
+- *jit reachability*: the call-graph closure of the entry-point bodies
+  across project modules (nested defs of a reachable function count as
+  reachable — they are the ``lax.cond``/``while_loop`` branch bodies);
+- a *traced-value taint* approximation per reachable function: which
+  names may hold tracers.  Seeds are the non-static parameters of the
+  jit declarations; taint flows through assignments, ``jnp``/``lax``
+  calls and project-function calls, and interprocedurally through call
+  arguments to a fixpoint.  Attributes that are static under tracing
+  (``.shape``, the Mesh capacity properties, ...) stop the flow.
+
+The model is a conservative approximation: rules that need precision
+read the taint sets, rules that key on syntax alone (dtype widening,
+inline-jit) scan every function.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+# attribute reads that are static under tracing even on a traced base:
+# array metadata, and the Mesh/ShardComm capacity- and flag-properties
+# (parmmg_tpu.core.mesh / parallel.distribute), which read .shape only
+STATIC_ATTRS = frozenset({
+    "shape", "ndim", "dtype", "size", "itemsize", "sharding",
+    "pcap", "tcap", "fcap", "ecap", "icap", "nshard",
+    "aniso", "met_set", "field_ncomp",
+})
+
+# host-safe builtins: results are never tracers (and taint does not
+# pass through them)
+UNTAINTED_CALLS = frozenset({
+    "len", "isinstance", "hasattr", "type", "id", "repr",
+    "str", "print", "max", "min",
+})
+
+# metadata/introspection calls whose results are host values even when
+# fed traced arguments (dtype queries, backend identity, ...)
+HOST_META_CALLS = frozenset({
+    "jax.numpy.finfo", "jax.numpy.iinfo", "jax.numpy.issubdtype",
+    "jax.numpy.dtype", "jax.numpy.result_type", "jax.numpy.promote_types",
+    "jax.numpy.ndim", "jax.numpy.shape",
+    "jax.default_backend", "jax.devices", "jax.local_devices",
+    "jax.device_count", "jax.local_device_count", "jax.process_index",
+    "jax.process_count", "jax.eval_shape",
+    "jax.dtypes.canonicalize_dtype", "jax.dtypes.issubdtype",
+    "numpy.finfo", "numpy.iinfo", "numpy.dtype", "numpy.issubdtype",
+    "numpy.result_type", "numpy.promote_types",
+})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*parmmg-lint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s+--.*)?$"
+)
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*parmmg-lint:\s*disable-file=([A-Za-z0-9_,\s]+?)(?:\s+--.*)?$"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    func: str = ""
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        fn = f" [{self.func}]" if self.func else ""
+        return f"{loc}: {self.rule}{fn}: {self.message}"
+
+
+@dataclasses.dataclass
+class JitDecl:
+    """One jit compilation declaration (decorator, module-level partial
+    wrap, or inline jax.jit(...) call) attached to a project function."""
+
+    static_names: Set[str]
+    donates: bool
+    line: int
+    inline: bool = False  # constructed inside a function body
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: "ModuleInfo"
+    qualname: str
+    node: ast.FunctionDef
+    parent: Optional["FuncInfo"] = None
+    jit_decls: List[JitDecl] = dataclasses.field(default_factory=list)
+    reachable: bool = False
+    tainted_params: Set[str] = dataclasses.field(default_factory=set)
+    # whether the function may return traced values (computed in the
+    # interprocedural fixpoint; monotone False -> True)
+    returns_tainted: bool = False
+    # resolved project callees: (callee FuncInfo, call node)
+    calls: List[Tuple["FuncInfo", ast.Call]] = dataclasses.field(
+        default_factory=list
+    )
+
+    @property
+    def key(self) -> str:
+        return f"{self.module.name}.{self.qualname}"
+
+    @property
+    def params(self) -> List[str]:
+        a = self.node.args
+        return [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+
+    def static_names(self) -> Set[str]:
+        out: Set[str] = set()
+        for d in self.jit_decls:
+            out |= d.static_names
+        return out
+
+    def span(self) -> Tuple[int, int]:
+        first = min(
+            [self.node.lineno]
+            + [d.lineno for d in self.node.decorator_list]
+        )
+        return first, self.node.end_lineno or self.node.lineno
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str
+    path: str
+    tree: ast.Module
+    lines: List[str]
+    # alias -> dotted module path ("jnp" -> "jax.numpy"); includes
+    # project submodule aliases ("split" -> "parmmg_tpu.ops.split")
+    mod_aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # symbol -> (module path, attr) for `from m import f`
+    sym_imports: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict
+    )
+    funcs: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    suppress_lines: Dict[int, Set[str]] = dataclasses.field(
+        default_factory=dict
+    )
+    suppress_file: Set[str] = dataclasses.field(default_factory=set)
+
+
+class Project:
+    """All analyzed modules plus the resolved call graph and taint."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+
+    # -- construction ------------------------------------------------------
+
+    def add_module(self, mi: ModuleInfo) -> None:
+        mi.project = self  # back-ref for taint-time call resolution
+        self.modules[mi.name] = mi
+        for fi in mi.funcs.values():
+            self.funcs[fi.key] = fi
+
+    def finalize(self) -> None:
+        self._resolve_calls()
+        self._mark_reachable()
+        self._propagate_taint()
+
+    # -- name resolution ---------------------------------------------------
+
+    def resolve_callable(
+        self, mi: ModuleInfo, scope: Optional[FuncInfo], node: ast.AST
+    ) -> Optional[FuncInfo]:
+        """Resolve a call-target expression to a project function."""
+        if isinstance(node, ast.Name):
+            # nested defs in the enclosing function chain
+            cur = scope
+            while cur is not None:
+                cand = mi.funcs.get(f"{cur.qualname}.{node.id}")
+                if cand is not None:
+                    return cand
+                cur = cur.parent
+            if node.id in mi.funcs:
+                return mi.funcs[node.id]
+            if node.id in mi.sym_imports:
+                mod, attr = mi.sym_imports[node.id]
+                target = self.modules.get(mod)
+                if target is not None:
+                    return target.funcs.get(attr)
+            return None
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ):
+            mod = mi.mod_aliases.get(node.value.id)
+            if mod is not None and mod in self.modules:
+                return self.modules[mod].funcs.get(node.attr)
+        return None
+
+    def external_name(
+        self, mi: ModuleInfo, node: ast.AST
+    ) -> Optional[str]:
+        """Dotted external name of an expression, e.g. ``jnp.where`` ->
+        ``jax.numpy.where``; None when it isn't a plain module attr."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = mi.mod_aliases.get(cur.id)
+        if root is None:
+            sym = mi.sym_imports.get(cur.id)
+            if sym is not None:
+                root = f"{sym[0]}.{sym[1]}"
+            else:
+                return None
+        return ".".join([root] + list(reversed(parts)))
+
+    # -- call graph & reachability ----------------------------------------
+
+    def _iter_call_targets(self, call: ast.Call):
+        """Call-target expressions of a Call, following an IfExp func
+        (the ``(_sweep_body if unfused else remesh_sweep)(...)`` idiom)."""
+        fn = call.func
+        if isinstance(fn, ast.IfExp):
+            yield fn.body
+            yield fn.orelse
+        else:
+            yield fn
+
+    def _resolve_calls(self) -> None:
+        for fi in self.funcs.values():
+            mi = fi.module
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                for tgt in self._iter_call_targets(node):
+                    callee = self.resolve_callable(mi, fi, tgt)
+                    if callee is not None and callee is not fi:
+                        fi.calls.append((callee, node))
+
+    def _mark_reachable(self) -> None:
+        work = [f for f in self.funcs.values() if f.jit_decls]
+        seen: Set[str] = set()
+        while work:
+            fi = work.pop()
+            if fi.key in seen:
+                continue
+            seen.add(fi.key)
+            fi.reachable = True
+            # nested defs are the lax branch/loop bodies — reachable
+            for sub in fi.module.funcs.values():
+                if sub.parent is fi and sub.key not in seen:
+                    work.append(sub)
+            for callee, _ in fi.calls:
+                if callee.key not in seen:
+                    work.append(callee)
+
+    # -- taint -------------------------------------------------------------
+
+    def _seed_taint(self) -> None:
+        for fi in self.funcs.values():
+            if not fi.jit_decls:
+                continue
+            static = fi.static_names()
+            for p in fi.params:
+                if p not in static:
+                    fi.tainted_params.add(p)
+
+    def _propagate_taint(self) -> None:
+        self._seed_taint()
+        # fixpoint: local taint per function, then push through call
+        # args and return values
+        for _ in range(20):  # project call-graph depth is far below this
+            changed = False
+            for fi in self.funcs.values():
+                if not fi.reachable:
+                    continue
+                taint = local_taint(fi)
+                if not fi.returns_tainted and _returns_tainted(fi, taint):
+                    fi.returns_tainted = True
+                    changed = True
+                for callee, call in fi.calls:
+                    if not callee.reachable:
+                        continue
+                    static = callee.static_names()
+                    for pname, expr in map_call_args(callee, call):
+                        if pname in static:
+                            continue
+                        if pname not in callee.tainted_params and (
+                            expr is not None
+                            and is_tainted(fi, expr, taint)
+                        ):
+                            callee.tainted_params.add(pname)
+                            changed = True
+            if not changed:
+                break
+
+
+def _returns_tainted(fi: FuncInfo, taint: Set[str]) -> bool:
+    own_nested = {
+        sub.node for sub in fi.module.funcs.values() if sub.parent is fi
+    }
+
+    def walk(node) -> bool:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef) and child in own_nested:
+                continue
+            if isinstance(child, ast.Return) and child.value is not None:
+                if is_tainted(fi, child.value, taint):
+                    return True
+            if walk(child):
+                return True
+        return False
+
+    return walk(fi.node)
+
+
+def map_call_args(callee: FuncInfo, call: ast.Call):
+    """Yield (param_name, arg_expr) pairs for a call of a project
+    function (best effort: *args/**kwargs are skipped)."""
+    params = callee.params
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(params):
+            yield params[i], arg
+    for kw in call.keywords:
+        if kw.arg is not None and kw.arg in params:
+            yield kw.arg, kw.value
+
+
+def is_tainted(fi: FuncInfo, node: ast.AST, taint: Set[str]) -> bool:
+    """Whether an expression may hold a traced value, given the set of
+    tainted local names."""
+    if isinstance(node, ast.Name):
+        return node.id in taint
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return is_tainted(fi, node.value, taint)
+    if isinstance(node, ast.Call):
+        return call_result_tainted(fi, node, taint)
+    if isinstance(node, ast.Constant):
+        return False
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return any(is_tainted(fi, e, taint) for e in node.elts)
+    if isinstance(node, ast.Starred):
+        return is_tainted(fi, node.value, taint)
+    if isinstance(node, ast.Subscript):
+        return is_tainted(fi, node.value, taint)
+    if isinstance(node, ast.BinOp):
+        return is_tainted(fi, node.left, taint) or is_tainted(
+            fi, node.right, taint
+        )
+    if isinstance(node, ast.UnaryOp):
+        return is_tainted(fi, node.operand, taint)
+    if isinstance(node, ast.BoolOp):
+        return any(is_tainted(fi, v, taint) for v in node.values)
+    if isinstance(node, ast.Compare):
+        # identity checks (`x is None`) never call bool() on a tracer
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+        return is_tainted(fi, node.left, taint) or any(
+            is_tainted(fi, c, taint) for c in node.comparators
+        )
+    if isinstance(node, ast.IfExp):
+        return is_tainted(fi, node.body, taint) or is_tainted(
+            fi, node.orelse, taint
+        )
+    if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        return is_tainted(fi, node.elt, taint)
+    if isinstance(node, ast.Lambda):
+        return False
+    return False
+
+
+def call_result_tainted(
+    fi: FuncInfo, call: ast.Call, taint: Set[str]
+) -> bool:
+    mi = fi.module
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id in ("range", "enumerate", "zip", "getattr", "tuple",
+                     "list", "sorted", "reversed"):
+            return any(is_tainted(fi, a, taint) for a in call.args)
+        if fn.id in UNTAINTED_CALLS:
+            return False
+        if fn.id in ("int", "float", "bool"):
+            # conversion forces a sync: the *result* is a host value
+            return False
+    dotted = _dotted_root(mi, fn)
+    if dotted in HOST_META_CALLS:
+        return False
+    # method call on a tainted object (e.g. mesh.replace(...)) -> tainted
+    if isinstance(fn, ast.Attribute) and is_tainted(fi, fn.value, taint):
+        return True
+    # project functions: use the computed return taint
+    project = getattr(mi, "project", None)
+    if project is not None:
+        callee = project.resolve_callable(mi, fi, fn)
+        if callee is not None:
+            return callee.returns_tainted
+    # jnp./lax./jax. calls build traced values inside a jit region
+    # regardless of their args (jnp.zeros(...) is a tracer under trace)
+    if dotted is not None:
+        root = dotted.split(".", 1)[0]
+        if root == "jax":
+            return True
+        if root in ("numpy",):
+            # numpy on traced args syncs; the result is host data
+            return False
+    # unresolved calls (callables held in variables, methods on host
+    # objects): conservative — assume traced
+    return True
+
+
+def _dotted_root(mi: ModuleInfo, node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    base = mi.mod_aliases.get(cur.id)
+    if base is None:
+        sym = mi.sym_imports.get(cur.id)
+        if sym is None:
+            return None
+        base = f"{sym[0]}.{sym[1]}"
+    return ".".join([base] + list(reversed(parts)))
+
+
+def local_taint(fi: FuncInfo) -> Set[str]:
+    """Fixpoint set of tainted local names in a reachable function."""
+    taint: Set[str] = set(fi.tainted_params)
+
+    own_nested = {
+        sub.node for sub in fi.module.funcs.values() if sub.parent is fi
+    }
+
+    def visit_stmts(stmts):
+        changed = False
+        for st in stmts:
+            changed |= visit(st)
+        return changed
+
+    def add(name: str) -> bool:
+        if name not in taint:
+            taint.add(name)
+            return True
+        return False
+
+    def bind_target(tgt, tainted: bool) -> bool:
+        if not tainted:
+            return False
+        changed = False
+        if isinstance(tgt, ast.Name):
+            changed |= add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                changed |= bind_target(e, True)
+        elif isinstance(tgt, ast.Starred):
+            changed |= bind_target(tgt.value, True)
+        return changed
+
+    def visit(node) -> bool:
+        changed = False
+        if isinstance(node, ast.FunctionDef) and node in own_nested:
+            return False  # nested defs analyzed separately
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = node.value
+            if value is not None:
+                t = is_tainted(fi, value, taint)
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                if isinstance(node, ast.AugAssign):
+                    t = t or is_tainted(fi, node.target, taint)
+                for tgt in targets:
+                    changed |= bind_target(tgt, t)
+            return changed
+        if isinstance(node, ast.For):
+            changed |= bind_target(
+                node.target, is_tainted(fi, node.iter, taint)
+            )
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    changed |= bind_target(
+                        item.optional_vars,
+                        is_tainted(fi, item.context_expr, taint),
+                    )
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef) and child in own_nested:
+                continue
+            changed |= visit(child)
+        return changed
+
+    for _ in range(10):
+        if not visit_stmts(fi.node.body):
+            break
+    return taint
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+
+def _module_name(path: str, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), root)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = [p for p in rel.split(os.sep) if p not in (".",)]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _resolve_relative(mi_name: str, level: int, module: str) -> str:
+    """Resolve `from ...module import x` against a module's dotted name."""
+    base = mi_name.split(".")
+    # a module's package is its name minus the leaf (modules here are
+    # files, not packages, except __init__ which already dropped leaf)
+    base = base[: len(base) - level] if level <= len(base) else []
+    if module:
+        base = base + module.split(".")
+    return ".".join(base)
+
+
+def _collect_imports(mi: ModuleInfo) -> None:
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                if al.asname:
+                    mi.mod_aliases[al.asname] = al.name
+                else:
+                    root = al.name.split(".")[0]
+                    mi.mod_aliases[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level:
+                mod = _resolve_relative(mi.name, node.level, mod)
+            for al in node.names:
+                name = al.asname or al.name
+                mi.sym_imports[name] = (mod, al.name)
+                # `from pkg import submodule` — record as module alias too
+                mi.mod_aliases.setdefault(name, f"{mod}.{al.name}")
+
+
+def _collect_suppressions(mi: ModuleInfo) -> None:
+    for i, line in enumerate(mi.lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            mi.suppress_lines.setdefault(i, set()).update(rules)
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            mi.suppress_file.update(
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            )
+
+
+def _jit_decl_from_call(call: ast.Call, mi: ModuleInfo) -> Optional[dict]:
+    """If `call` is jax.jit(...) or partial(jax.jit, ...), return its
+    static/donate config, else None."""
+
+    def is_jit_ref(node) -> bool:
+        if isinstance(node, ast.Name):
+            sym = mi.sym_imports.get(node.id)
+            return node.id == "jit" and sym is not None and sym[0] == "jax"
+        dotted = _dotted_root(mi, node)
+        return dotted == "jax.jit"
+
+    cfg = None
+    if is_jit_ref(call.func):
+        cfg = dict(static=set(), donates=False, kws=call.keywords)
+    elif (
+        isinstance(call.func, ast.Name)
+        and call.func.id == "partial"
+        and call.args
+        and is_jit_ref(call.args[0])
+    ):
+        cfg = dict(static=set(), donates=False, kws=call.keywords)
+    if cfg is None:
+        return None
+    for kw in cfg.pop("kws"):
+        if kw.arg in ("static_argnames", "static_argnums"):
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    cfg["static"].add(c.value)
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            cfg["donates"] = True
+    return cfg
+
+
+def _unwrap_to_func(node: ast.AST) -> Optional[ast.AST]:
+    """Peel transform shells (shard_map/vmap/partial/closures) off a
+    jit argument down to a function reference expression."""
+    seen = 0
+    while isinstance(node, ast.Call) and seen < 6:
+        if not node.args:
+            return None
+        node = node.args[0]
+        seen += 1
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return node
+    return None
+
+
+def _collect_funcs(mi: ModuleInfo) -> None:
+    def walk_body(body, prefix: str, parent: Optional[FuncInfo]):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{node.name}"
+                fi = FuncInfo(mi, qual, node, parent=parent)
+                mi.funcs[qual] = fi
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        cfg = _jit_decl_from_call(dec, mi)
+                        if cfg:
+                            fi.jit_decls.append(JitDecl(
+                                cfg["static"], cfg["donates"], dec.lineno
+                            ))
+                    elif _dotted_root(mi, dec) == "jax.jit" or (
+                        isinstance(dec, ast.Name)
+                        and dec.id == "jit"
+                        and mi.sym_imports.get("jit", ("",))[0] == "jax"
+                    ):
+                        fi.jit_decls.append(
+                            JitDecl(set(), False, dec.lineno)
+                        )
+                walk_body(node.body, f"{qual}.", fi)
+            elif isinstance(node, ast.ClassDef):
+                walk_body(node.body, f"{prefix}{node.name}.", parent)
+            elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For,
+                                   ast.While)):
+                for field in ("body", "orelse", "finalbody"):
+                    sub_body = getattr(node, field, None)
+                    if sub_body:
+                        walk_body(sub_body, prefix, parent)
+                for h in getattr(node, "handlers", []) or []:
+                    walk_body(h.body, prefix, parent)
+
+    walk_body(mi.tree.body, "", None)
+
+
+def _attach_wrapped_jits(mi: ModuleInfo, project: Project) -> None:
+    """Module-level `name = partial(jax.jit, ...)(fn)` wrappings and
+    inline `jax.jit(shard_map(body, ...))` calls inside functions: mark
+    the wrapped project function as a jit entry."""
+    # module-level assignments
+    for node in mi.tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        cfg = None
+        if isinstance(call.func, ast.Call):
+            cfg = _jit_decl_from_call(call.func, mi)  # partial(...)(fn)
+        if cfg is None:
+            cfg = _jit_decl_from_call(call, mi)  # jax.jit(fn, ...)
+            wrapped = call.args[0] if cfg and call.args else None
+        else:
+            wrapped = call.args[0] if call.args else None
+        if cfg is None or wrapped is None:
+            continue
+        ref = _unwrap_to_func(wrapped) or wrapped
+        fi = project.resolve_callable(mi, None, ref)
+        if fi is not None:
+            fi.jit_decls.append(
+                JitDecl(cfg["static"], cfg["donates"], node.lineno)
+            )
+            # alias: calls to the wrapper name hit the wrapped function
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    mi.funcs.setdefault(tgt.id, fi)
+    # inline jax.jit(...) inside function bodies
+    for fi in list(mi.funcs.values()):
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cfg = _jit_decl_from_call(node, mi)
+            if cfg is None or not node.args:
+                continue
+            ref = _unwrap_to_func(node.args[0])
+            if ref is None:
+                continue
+            wrapped = project.resolve_callable(mi, fi, ref)
+            if wrapped is not None:
+                wrapped.jit_decls.append(JitDecl(
+                    cfg["static"], cfg["donates"], node.lineno,
+                    inline=True,
+                ))
+
+
+def parse_module(path: str, root: str) -> Optional[ModuleInfo]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=path)
+    except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+        mi = ModuleInfo(
+            _module_name(path, root), path, ast.Module(body=[],
+                                                       type_ignores=[]),
+            [],
+        )
+        mi.parse_error = str(exc)  # type: ignore[attr-defined]
+        return mi
+    mi = ModuleInfo(_module_name(path, root), path, tree,
+                    src.splitlines())
+    _collect_imports(mi)
+    _collect_suppressions(mi)
+    _collect_funcs(mi)
+    return mi
+
+
+def iter_python_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if not d.startswith(".") and d != "__pycache__"
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def analyze_paths(paths: List[str], root: Optional[str] = None) -> Project:
+    """Parse every .py under `paths` and build the resolved project."""
+    root = os.path.abspath(root or os.getcwd())
+    project = Project()
+    for path in iter_python_files(paths):
+        mi = parse_module(path, root)
+        if mi is not None:
+            project.add_module(mi)
+    for mi in project.modules.values():
+        _attach_wrapped_jits(mi, project)
+    # re-register aliased funcs added by _attach_wrapped_jits
+    for mi in project.modules.values():
+        for fi in mi.funcs.values():
+            project.funcs.setdefault(fi.key, fi)
+    project.finalize()
+    return project

@@ -1,0 +1,89 @@
+"""Command line for the JAX-invariant linter.
+
+    python -m parmmg_tpu.lint <paths...> [--json] [--select PML001,...]
+                              [--list-rules] [--root DIR]
+
+Exit codes: 0 clean, 1 findings, 2 usage error.  Pure stdlib — linting
+never initializes jax or touches an accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+from .analyzer import analyze_paths
+from .rules import RULES, run_lint
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = False
+    select = None
+    root = None
+    paths: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--json":
+            as_json = True
+        elif a == "--list-rules":
+            for rid, desc in sorted(RULES.items()):
+                print(f"{rid}  {desc}")
+            return 0
+        elif a == "--select":
+            i += 1
+            if i >= len(argv):
+                print("--select needs a value", file=sys.stderr)
+                return 2
+            select = [r.strip() for r in argv[i].split(",") if r.strip()]
+        elif a == "--root":
+            i += 1
+            if i >= len(argv):
+                print("--root needs a value", file=sys.stderr)
+                return 2
+            root = argv[i]
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        elif a.startswith("-"):
+            print(f"unknown flag {a}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+        i += 1
+    if not paths:
+        print(__doc__, file=sys.stderr)
+        return 2
+
+    project = analyze_paths(paths, root=root)
+    findings = run_lint(paths, root=root, select=select, project=project)
+    if as_json:
+        print(json.dumps(
+            dict(
+                findings=[f.as_dict() for f in findings],
+                count=len(findings),
+                rules=RULES,
+            ),
+            indent=2,
+        ))
+    else:
+        for f in findings:
+            print(f.format())
+        n_jit = sum(1 for fi in project.funcs.values() if fi.jit_decls)
+        n_reach = sum(
+            1 for fi in project.funcs.values() if fi.reachable
+        )
+        print(
+            f"parmmg-lint: {len(findings)} finding(s) in "
+            f"{len(project.modules)} module(s) "
+            f"({n_jit} jit entry points, {n_reach} jit-reachable "
+            "functions)",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

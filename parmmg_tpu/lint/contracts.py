@@ -1,0 +1,337 @@
+"""Runtime contracts for the flat-mesh invariants + a retrace counter.
+
+The static analyzer (`parmmg_tpu.lint`) checks what the *source* cannot
+do; this module checks what the *data* must satisfy — the runtime half
+of the reference's assertion discipline (`assert()` around `chkcomm`,
+`src/libparmmg.c:326-329`), restated for the flat SoA mesh:
+
+- connectivity in range and pointing at live vertices;
+- `adja` involution: ``adja[t, f] = 4*u + g  =>  adja[u, g] = 4*t + f``
+  (the invariant `MMG3D_hashTetra` guarantees by construction);
+- sentinel domains: ``adja``/``vglob`` are ``>= -1`` everywhere;
+- owner-rank consistency of the node communicator (exactly one owning
+  shard per shared global vertex — mirroring `parallel/chkcomm.py`'s
+  geometric checks with a pure-topological one).
+
+All report functions are CHEAP and JIT-COMPATIBLE: pure `jnp`, fixed
+shapes, no host syncs — they can run inside a jitted phase and cost a
+few reductions.  The `assert_*` wrappers sync once at the end and raise
+:class:`MeshContractError` with the full report.
+
+The second half is the retrace counter: a context manager that counts
+jit cache misses (via jax's compile logging) per named phase, with
+optional budgets — the guard against the warm-cache/compile-budget
+failures documented in ADVICE.md.
+"""
+
+from __future__ import annotations
+
+import logging
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class MeshContractError(AssertionError):
+    """A runtime mesh/communicator invariant does not hold."""
+
+    def __init__(self, message: str, report: dict):
+        super().__init__(f"{message}: {report}")
+        self.report = report
+
+
+class RetraceBudgetExceeded(RuntimeError):
+    """A phase recompiled more programs than its budget allows."""
+
+
+# ---------------------------------------------------------------------------
+# mesh invariants (jit-compatible)
+# ---------------------------------------------------------------------------
+
+
+def _conn_bad(conn, mask, vmask, pcap):
+    """Count of valid entities referencing out-of-range or dead
+    vertices."""
+    in_range = (conn >= 0) & (conn < pcap)
+    live = vmask[jnp.clip(conn, 0, pcap - 1)]
+    ok = jnp.all(in_range & live, axis=1)
+    return jnp.sum((mask & ~ok).astype(jnp.int32))
+
+
+def mesh_invariant_report(mesh) -> Dict[str, jax.Array]:
+    """Flat-mesh invariant counters, all-zero iff the mesh is coherent.
+
+    Pure jnp on fixed shapes — safe to call under jit / shard_map (wrap
+    per shard) and cheap enough for per-phase assertions.
+    """
+    pc, tc = mesh.pcap, mesh.tcap
+    rep = dict(
+        tet_conn_bad=_conn_bad(mesh.tet, mesh.tmask, mesh.vmask, pc),
+        tria_conn_bad=_conn_bad(mesh.tria, mesh.trmask, mesh.vmask, pc),
+        edge_conn_bad=_conn_bad(mesh.edge, mesh.edmask, mesh.vmask, pc),
+    )
+    # sentinel domains: -1 is the only legal negative
+    rep["adja_sentinel_bad"] = jnp.sum(
+        ((mesh.adja < -1) | (mesh.adja >= 4 * tc)).astype(jnp.int32)
+    )
+    rep["vglob_sentinel_bad"] = jnp.sum(
+        (mesh.vglob < -1).astype(jnp.int32)
+    )
+    # adjacency: valid faces must point at live tets, and the gluing
+    # must be an involution
+    adja = mesh.adja
+    has = (adja >= 0) & mesh.tmask[:, None]
+    nb = jnp.clip(adja >> 2, 0, tc - 1)
+    nf = adja & 3
+    nb_live = mesh.tmask[nb]
+    rep["adja_dead_ref"] = jnp.sum((has & ~nb_live).astype(jnp.int32))
+    back = adja[nb, nf]
+    want = 4 * jnp.arange(tc, dtype=jnp.int32)[:, None] + jnp.arange(
+        4, dtype=jnp.int32
+    )[None, :]
+    rep["adja_sym_bad"] = jnp.sum(
+        (has & nb_live & (back != want)).astype(jnp.int32)
+    )
+    return rep
+
+
+def mesh_static_report(mesh) -> Dict[str, bool]:
+    """Host-side (trace-time) dtype/shape contract: int32 connectivity,
+    bool masks. Violations here are construction bugs, not data bugs."""
+    i32 = jnp.int32
+    return dict(
+        tet_int32=mesh.tet.dtype == i32,
+        tria_int32=mesh.tria.dtype == i32,
+        edge_int32=mesh.edge.dtype == i32,
+        adja_int32=mesh.adja.dtype == i32,
+        vglob_int32=mesh.vglob.dtype == i32,
+        masks_bool=(
+            mesh.vmask.dtype == jnp.bool_
+            and mesh.tmask.dtype == jnp.bool_
+            and mesh.trmask.dtype == jnp.bool_
+            and mesh.edmask.dtype == jnp.bool_
+        ),
+    )
+
+
+def assert_mesh_ok(mesh, check_adjacency: bool = True) -> dict:
+    """Host wrapper: one device sync, raises MeshContractError with the
+    full report on any violation. Returns the (host-int) report."""
+    static = mesh_static_report(mesh)
+    if not all(static.values()):
+        raise MeshContractError("mesh dtype contract violated", static)
+    rep = {k: int(v) for k, v in
+           jax.device_get(mesh_invariant_report(mesh)).items()}
+    skip = ("adja_sym_bad", "adja_dead_ref") if not check_adjacency else ()
+    if any(v for k, v in rep.items() if k not in skip):
+        raise MeshContractError("mesh invariants violated", rep)
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# communicator invariants (jit-compatible)
+# ---------------------------------------------------------------------------
+
+
+def comm_invariant_report(comm) -> Dict[str, jax.Array]:
+    """Topological node-communicator invariants, mirroring the checks
+    of `parallel/chkcomm.py` without the geometric halo exchange:
+
+    - comm_idx slots in [-1, PC) and pointing at globally-numbered
+      vertices;
+    - per-pair counts table consistent with the index table;
+    - OWNER-RANK CONSISTENCY: every shared global vertex has exactly
+      one owning shard among its copies (the reference's
+      `PMMG_count_nodes_par` dedup contract).
+    """
+    D, PC = comm.l2g.shape
+    ci = comm.comm_idx
+    rep = dict(
+        idx_range_bad=jnp.sum(((ci < -1) | (ci >= PC)).astype(jnp.int32))
+    )
+    valid = ci >= 0
+    safe = jnp.clip(ci, 0, PC - 1)
+    gid_at = jax.vmap(lambda l, i: l[i])(comm.l2g, safe)  # [D, D, I]
+    rep["idx_dead_ref"] = jnp.sum(
+        (valid & (gid_at < 0)).astype(jnp.int32)
+    )
+    rep["counts_bad"] = jnp.sum(
+        (comm.counts != jnp.sum(valid.astype(jnp.int32), axis=-1))
+        .astype(jnp.int32)
+    )
+    # owner-rank consistency over the global id space
+    gcap = D * PC
+    live = comm.l2g >= 0
+    gid = jnp.clip(comm.l2g, 0, gcap - 1).reshape(-1)
+    rep["gid_range_bad"] = jnp.sum(
+        (live & (comm.l2g >= gcap)).astype(jnp.int32)
+    )
+    own = jnp.zeros(gcap, jnp.int32).at[gid].add(
+        (comm.owner & live).reshape(-1).astype(jnp.int32), mode="drop"
+    )
+    cpy = jnp.zeros(gcap, jnp.int32).at[gid].add(
+        live.reshape(-1).astype(jnp.int32), mode="drop"
+    )
+    rep["owner_bad"] = jnp.sum(((cpy > 0) & (own != 1)).astype(jnp.int32))
+    return rep
+
+
+def assert_comm_ok(comm) -> dict:
+    """Host wrapper for `comm_invariant_report` (topological half; the
+    geometric half stays in `parallel.chkcomm.assert_comm_ok`)."""
+    rep = {k: int(v) for k, v in
+           jax.device_get(comm_invariant_report(comm)).items()}
+    if any(rep.values()):
+        raise MeshContractError("communicator invariants violated", rep)
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# retrace counter
+# ---------------------------------------------------------------------------
+
+
+# jax compiles op-by-op dispatch outside jit as tiny jits named after
+# the primitive; they fire once per process and are not retraces of a
+# user program — excluded from the counts by default
+_DISPATCH_NOISE = frozenset({
+    "convert_element_type", "broadcast_in_dim", "copy", "iota",
+    "reshape", "squeeze", "transpose", "concatenate", "slice",
+})
+
+# the logger that emits "Compiling <name> ..." exactly once per jit
+# cache miss (jax._src/interpreters/pxla.py), and its siblings that
+# turn noisy under jax_log_compiles
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+_NOISY_LOGGERS = (
+    "jax._src.dispatch", "jax._src.compiler", "jax._src.compilation_cache",
+)
+
+
+class _CompileLogHandler(logging.Handler):
+    def __init__(self, counter: "RetraceCounter"):
+        super().__init__(level=logging.WARNING)
+        self.counter = counter
+
+    def emit(self, record: logging.LogRecord) -> None:
+        msg = str(record.msg)
+        if not msg.startswith("Compiling "):
+            return
+        name = str(record.args[0]) if record.args else "<unknown>"
+        if name in _DISPATCH_NOISE:
+            return
+        self.counter._record(name)
+
+
+class RetraceCounter:
+    """Counts jit cache misses (XLA compilations) per named phase.
+
+    Uses jax's compile logging (`jax_log_compiles`): every trace that
+    reaches compilation logs one "Compiling <name> ..." record — exactly
+    the event a warm cache must not produce.  Phases are entered either
+    via the `phase(name, budget=)` context manager or sequentially via
+    `enter_phase(name)` (the shape of `models.adapt`'s phase hook).
+
+    >>> counter = RetraceCounter()
+    >>> with counter, counter.phase("sweeps", budget=2):
+    ...     run_sweeps()          # raises RetraceBudgetExceeded if >2
+    >>> counter.counts
+    {'sweeps': 1}
+    """
+
+    def __init__(self) -> None:
+        self.counts: Dict[str, int] = {}
+        self.names: Dict[str, list] = {}
+        self._phase = "<outside>"
+        self._handler: Optional[_CompileLogHandler] = None
+        self._prev_flag = None
+
+    def _record(self, name: str) -> None:
+        self.counts[self._phase] = self.counts.get(self._phase, 0) + 1
+        self.names.setdefault(self._phase, []).append(name)
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def enter_phase(self, name: str) -> None:
+        self._phase = name
+
+    def __enter__(self) -> "RetraceCounter":
+        self._prev_flag = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+        self._handler = _CompileLogHandler(self)
+        src = logging.getLogger(_PXLA_LOGGER)
+        src.addHandler(self._handler)
+        # capture at the source and stop propagation: the counter, not
+        # the console, consumes the "Compiling" records — and quiet the
+        # sibling loggers jax_log_compiles turns on
+        self._prev_prop = src.propagate
+        src.propagate = False
+        self._prev_levels = []
+        for name in _NOISY_LOGGERS:
+            lg = logging.getLogger(name)
+            self._prev_levels.append((lg, lg.level))
+            lg.setLevel(logging.ERROR)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        src = logging.getLogger(_PXLA_LOGGER)
+        src.removeHandler(self._handler)
+        src.propagate = self._prev_prop
+        for lg, level in self._prev_levels:
+            lg.setLevel(level)
+        self._handler = None
+        jax.config.update("jax_log_compiles", self._prev_flag)
+
+    @contextmanager
+    def phase(self, name: str, budget: Optional[int] = None):
+        prev = self._phase
+        self._phase = name
+        start = self.counts.get(name, 0)
+        try:
+            yield self
+        finally:
+            self._phase = prev
+            n = self.counts.get(name, 0) - start
+            if budget is not None and n > budget:
+                raise RetraceBudgetExceeded(
+                    f"phase '{name}' recompiled {n} programs "
+                    f"(budget {budget}): {self.names.get(name, [])[-n:]}"
+                )
+
+    def check(self, budgets: Dict[str, int]) -> None:
+        """Post-hoc budget check over accumulated per-phase counts."""
+        for name, budget in budgets.items():
+            n = self.counts.get(name, 0)
+            if n > budget:
+                raise RetraceBudgetExceeded(
+                    f"phase '{name}' recompiled {n} programs "
+                    f"(budget {budget}): {self.names.get(name, [])}"
+                )
+
+
+def run_adapt_with_budget(
+    mesh,
+    opts=None,
+    budgets: Optional[Dict[str, int]] = None,
+    counter: Optional[RetraceCounter] = None,
+):
+    """Run `models.adapt.adapt` under the retrace counter and enforce
+    per-phase compile budgets (phase names are adapt's own markers:
+    "analysis", "metric", "input histogram", "sweeps", "finalize").
+
+    Returns (mesh, info) with info["recompiles"] = per-phase counts;
+    raises RetraceBudgetExceeded when a budgeted phase overdraws.
+    """
+    from ..models.adapt import adapt
+
+    counter = counter or RetraceCounter()
+    with counter:
+        counter.enter_phase("setup")
+        out, info = adapt(mesh, opts, phase_hook=counter.enter_phase)
+    counter.check(budgets or {})
+    info["recompiles"] = dict(counter.counts)
+    return out, info

@@ -1,0 +1,459 @@
+"""Rule catalog of the JAX-invariant linter.
+
+Every rule has a stable ID (``PML0xx``), fires as a :class:`Finding`,
+and can be silenced with ``# parmmg-lint: disable=PML0xx`` on the
+offending line, the line above, or the function's ``def``/decorator
+line (which scopes the suppression to the whole function), or
+``# parmmg-lint: disable-file=PML0xx`` anywhere in the file.
+
+Catalog (see README "Static analysis" for the prose version):
+
+PML001 host-sync-call      explicit device→host syncs (``.item()``,
+                           ``.tolist()``, ``jax.device_get``, ``np.*``
+                           on traced data) inside jit-reachable code.
+PML002 traced-bool         implicit ``bool()``/``int()``/``float()`` of
+                           a traced value: ``if``/``assert``/``and``/
+                           ``or``/``not`` or conversion calls on
+                           tracers inside jit-reachable code.
+PML003 traced-loop         Python ``for``/``while`` over traced values
+                           (mesh entities) where ``lax`` control flow
+                           is required.
+PML004 inline-jit          ``jax.jit``/``partial(jax.jit,...)`` applied
+                           inside a function body: a fresh cache per
+                           call, i.e. unbounded retracing.
+PML005 missing-donate      jitted function whose leading parameter is a
+                           (large) Mesh pytree without
+                           ``donate_argnums`` — doubles peak device
+                           memory on the remesh hot path.
+PML006 dtype-widening      ``jnp.float64``/``jnp.int64`` (or string
+                           dtype spellings) in device code: int32
+                           connectivity / declared-dtype geometry is
+                           the contract.
+PML007 dynamic-shape       boolean-mask indexing or calls that produce
+                           data-dependent shapes (``jnp.nonzero``,
+                           1-arg ``jnp.where``, ``jnp.unique`` without
+                           ``size=``) inside jit-reachable code.
+PML008 print-under-trace   ``print`` in jit-reachable code runs at
+                           trace time only — use ``jax.debug.print``.
+PML009 arange-no-dtype     ``jnp.arange`` without ``dtype=``: under
+                           ``jax_enable_x64`` (the test harness) the
+                           index array silently widens to int64.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .analyzer import (
+    Finding, FuncInfo, ModuleInfo, Project, analyze_paths, is_tainted,
+    local_taint, _dotted_root,
+)
+
+RULES: Dict[str, str] = {
+    "PML001": "host-sync call inside jit-reachable code",
+    "PML002": "implicit bool/int/float of a traced value",
+    "PML003": "Python loop over traced values (use lax control flow)",
+    "PML004": "jax.jit constructed inside a function body (retraces "
+              "every call)",
+    "PML005": "jitted Mesh-pytree function without donate_argnums",
+    "PML006": "64-bit dtype widening in device code",
+    "PML007": "data-dependent output shape inside jit-reachable code",
+    "PML008": "print under trace (use jax.debug.print)",
+    "PML009": "jnp.arange without explicit dtype (int64 under x64)",
+}
+
+# names whose first parameter is the big mesh pytree (PML005)
+MESH_PARAM_NAMES = frozenset({"mesh", "stacked", "m", "blk"})
+MESH_ANNOTATIONS = frozenset({"Mesh"})
+
+HOST_SYNC_METHODS = frozenset({"item", "tolist", "__array__"})
+DYNAMIC_SHAPE_FNS = frozenset({
+    "nonzero", "flatnonzero", "argwhere", "unique", "compress",
+    "extract", "union1d", "intersect1d", "setdiff1d",
+})
+
+
+def _is_numpy(mi: ModuleInfo, node: ast.AST) -> bool:
+    dotted = _dotted_root(mi, node)
+    return dotted is not None and dotted.split(".")[0] == "numpy"
+
+
+def _is_jnp(mi: ModuleInfo, node: ast.AST) -> Optional[str]:
+    """Return the function name when `node` is a jax.numpy attribute."""
+    dotted = _dotted_root(mi, node)
+    if dotted and dotted.startswith("jax.numpy."):
+        return dotted[len("jax.numpy."):]
+    return None
+
+
+class _FuncChecker(ast.NodeVisitor):
+    """Per-function rule pass. Reachability-gated rules consult
+    `self.reachable`; syntax rules run everywhere."""
+
+    def __init__(self, fi: FuncInfo, findings: List[Finding]):
+        self.fi = fi
+        self.mi = fi.module
+        self.findings = findings
+        self.reachable = fi.reachable
+        self.taint = local_taint(fi) if fi.reachable else set()
+        self.own_nested = {
+            sub.node
+            for sub in fi.module.funcs.values()
+            if sub.parent is fi
+        }
+        # a memoized factory (@lru_cache/@cache) builds its jit wrapper
+        # once per key — the sanctioned fix for PML004, not a violation
+        self.memoized = False
+        cur: Optional[FuncInfo] = fi
+        while cur is not None:
+            if any(_is_memoize_decorator(d) for d in
+                   cur.node.decorator_list):
+                self.memoized = True
+                break
+            cur = cur.parent
+
+    # -- helpers -----------------------------------------------------------
+
+    def emit(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(
+            rule, self.mi.path, node.lineno, node.col_offset, msg,
+            func=self.fi.key,
+        ))
+
+    def tainted(self, node: ast.AST) -> bool:
+        return is_tainted(self.fi, node, self.taint)
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef) and (
+                child in self.own_nested
+            ):
+                continue  # nested defs get their own checker
+            self.visit(child)
+
+    # -- statement rules ---------------------------------------------------
+
+    def visit_If(self, node: ast.If) -> None:
+        if self.reachable and self.tainted(node.test):
+            self.emit(
+                "PML002", node.test,
+                "`if` on a traced value forces a host sync (or a "
+                "TracerBoolConversionError under jit) — use jax.lax.cond "
+                "or jnp.where",
+            )
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        if self.reachable and self.tainted(node.test):
+            self.emit(
+                "PML002", node.test,
+                "conditional expression on a traced value — use "
+                "jnp.where or jax.lax.cond",
+            )
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if self.reachable and self.tainted(node.test):
+            self.emit(
+                "PML002", node.test,
+                "assert on a traced value — use "
+                "parmmg_tpu.lint.contracts (jit-compatible checkers) or "
+                "jax.debug.check",
+            )
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if self.reachable and self.tainted(node.test):
+            self.emit(
+                "PML003", node.test,
+                "Python `while` on a traced condition — use "
+                "jax.lax.while_loop",
+            )
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if self.reachable and self.tainted(node.iter):
+            self.emit(
+                "PML003", node.iter,
+                "Python `for` over traced values (mesh entities) — "
+                "batch the body or use jax.lax.fori_loop/scan",
+            )
+        self.generic_visit(node)
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> None:
+        if self.reachable and any(self.tainted(v) for v in node.values):
+            self.emit(
+                "PML002", node,
+                "`and`/`or` on traced values short-circuits through "
+                "bool() — use & / | (jnp.logical_and/or)",
+            )
+        self.generic_visit(node)
+
+    def visit_UnaryOp(self, node: ast.UnaryOp) -> None:
+        if (
+            self.reachable
+            and isinstance(node.op, ast.Not)
+            and self.tainted(node.operand)
+        ):
+            self.emit(
+                "PML002", node,
+                "`not` on a traced value calls bool() — use ~ "
+                "(jnp.logical_not)",
+            )
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if self.reachable:
+            idx = node.slice
+            mask_like = (
+                isinstance(idx, (ast.Compare, ast.BoolOp))
+                or (
+                    isinstance(idx, (ast.Name, ast.Attribute))
+                    and _leaf_name(idx).endswith("mask")
+                )
+            )
+            if mask_like and self.tainted(idx) and self.tainted(node.value):
+                self.emit(
+                    "PML007", node,
+                    "boolean-mask indexing produces a data-dependent "
+                    "shape under jit — use jnp.where(mask, ...) or "
+                    "masked scatter/gather",
+                )
+        self.generic_visit(node)
+
+    # -- call rules --------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        mi = self.mi
+
+        # PML004: inline jit (anywhere inside a function body). A
+        # decorator of a MODULE-LEVEL function evaluates once at import
+        # and is not "inline"; a decorator of a nested def re-evaluates
+        # per enclosing call and is.
+        from .analyzer import _jit_decl_from_call
+
+        is_toplevel_decorator = (
+            self.fi.parent is None
+            and any(node is d for d in self.fi.node.decorator_list)
+        )
+        if not is_toplevel_decorator and not self.memoized and (
+            _jit_decl_from_call(node, mi) is not None
+        ):
+            self.emit(
+                "PML004", node,
+                "jax.jit constructed inside a function body creates a "
+                "fresh compile cache every call (unbounded retracing) — "
+                "hoist to module scope or memoize the wrapper",
+            )
+
+        if self.reachable:
+            # PML001: explicit host syncs
+            if isinstance(fn, ast.Attribute):
+                if fn.attr in HOST_SYNC_METHODS and self.tainted(fn.value):
+                    self.emit(
+                        "PML001", node,
+                        f".{fn.attr}() on a traced value blocks on the "
+                        "device and fails under jit",
+                    )
+                dotted = _dotted_root(mi, fn)
+                if dotted in ("jax.device_get",):
+                    self.emit(
+                        "PML001", node,
+                        "jax.device_get inside jit-reachable code is a "
+                        "host sync (and fails on tracers)",
+                    )
+                if _is_numpy(mi, fn) and any(
+                    self.tainted(a) for a in node.args
+                ):
+                    self.emit(
+                        "PML001", node,
+                        "numpy call on traced data pulls the array to "
+                        "the host — use jax.numpy",
+                    )
+                # PML007: dynamic-shape producers
+                jname = _is_jnp(mi, fn)
+                if jname in DYNAMIC_SHAPE_FNS and not any(
+                    kw.arg == "size" for kw in node.keywords
+                ):
+                    self.emit(
+                        "PML007", node,
+                        f"jnp.{jname} without size= has a data-dependent "
+                        "output shape and cannot be jitted",
+                    )
+                if jname == "where" and len(node.args) == 1:
+                    self.emit(
+                        "PML007", node,
+                        "1-argument jnp.where has a data-dependent "
+                        "output shape — pass size= via jnp.nonzero or "
+                        "use the 3-argument form",
+                    )
+                # PML009: arange without dtype
+                if jname == "arange" and not any(
+                    kw.arg == "dtype" for kw in node.keywords
+                ):
+                    self.emit(
+                        "PML009", node,
+                        "jnp.arange without dtype= silently widens to "
+                        "int64 under jax_enable_x64 — pin dtype=jnp.int32",
+                    )
+            elif isinstance(fn, ast.Name):
+                if fn.id in ("bool", "int", "float") and node.args and (
+                    self.tainted(node.args[0])
+                ):
+                    self.emit(
+                        "PML002", node,
+                        f"{fn.id}() on a traced value forces a host sync "
+                        "(fails under jit) — keep it on device or hoist "
+                        "out of the jit region",
+                    )
+                if fn.id == "print":
+                    self.emit(
+                        "PML008", node,
+                        "print in jit-reachable code runs at trace time "
+                        "only — use jax.debug.print",
+                    )
+
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # PML006: 64-bit dtypes in device code (syntax rule, any func)
+        dotted = _dotted_root(self.mi, node)
+        if dotted in ("jax.numpy.float64", "jax.numpy.int64"):
+            self.emit(
+                "PML006", node,
+                f"{node.attr} widens device arrays — connectivity is "
+                "int32 and geometry follows mesh.dtype",
+            )
+        self.generic_visit(node)
+
+
+def _is_memoize_decorator(dec: ast.AST) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    return _leaf_name(target) in ("lru_cache", "cache", "memoize")
+
+
+def _leaf_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _check_module_level(mi: ModuleInfo, findings: List[Finding]) -> None:
+    """Syntax rules that also apply outside function bodies."""
+    func_spans = [f.span() for f in mi.funcs.values()]
+
+    def in_func(line: int) -> bool:
+        return any(a <= line <= b for a, b in func_spans)
+
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Attribute) and not in_func(node.lineno):
+            dotted = _dotted_root(mi, node)
+            if dotted in ("jax.numpy.float64", "jax.numpy.int64"):
+                findings.append(Finding(
+                    "PML006", mi.path, node.lineno, node.col_offset,
+                    f"{node.attr} widens device arrays — connectivity "
+                    "is int32 and geometry follows mesh.dtype",
+                ))
+
+
+def _check_donation(fi: FuncInfo, findings: List[Finding]) -> None:
+    """PML005: jit declarations over Mesh-pytree functions must donate
+    (or carry an explicit suppression explaining why they cannot)."""
+    if not fi.jit_decls:
+        return
+    args = fi.node.args
+    pos = args.posonlyargs + args.args
+    if not pos:
+        return
+    first = pos[0]
+    ann = ""
+    if isinstance(first.annotation, ast.Name):
+        ann = first.annotation.id
+    elif isinstance(first.annotation, ast.Constant):
+        ann = str(first.annotation.value)
+    is_mesh = ann in MESH_ANNOTATIONS or (
+        not ann and first.arg in MESH_PARAM_NAMES
+    ) or first.arg in MESH_PARAM_NAMES
+    if not is_mesh:
+        return
+    for decl in fi.jit_decls:
+        if decl.inline:
+            continue  # the inline-jit finding (PML004) already covers it
+        if not decl.donates:
+            findings.append(Finding(
+                "PML005", fi.module.path, decl.line, 0,
+                f"jitted `{fi.node.name}` takes the mesh pytree but "
+                "declares no donate_argnums — the sweep-scale arrays "
+                "are copied instead of reused (2x peak device memory)",
+                func=fi.key,
+            ))
+
+
+def _suppressed(mi: ModuleInfo, f: Finding) -> bool:
+    if f.rule in mi.suppress_file or "all" in mi.suppress_file:
+        return True
+
+    def hit(line: int) -> bool:
+        rules = mi.suppress_lines.get(line)
+        return rules is not None and (f.rule in rules or "all" in rules)
+
+    if hit(f.line) or hit(f.line - 1):
+        return True
+    # def-line (or decorator-line) scoping: suppressions on the header
+    # of the enclosing function apply to its whole body
+    for fi in mi.funcs.values():
+        a, b = fi.span()
+        if a <= f.line <= b:
+            header_end = fi.node.body[0].lineno if fi.node.body else b
+            # a - 1: a standalone comment line above the decorator
+            for ln in range(a - 1, header_end + 1):
+                if hit(ln):
+                    return True
+    return False
+
+
+def run_lint(
+    paths: List[str],
+    root: Optional[str] = None,
+    select: Optional[List[str]] = None,
+    project: Optional[Project] = None,
+) -> List[Finding]:
+    """Lint `paths`; return unsuppressed findings sorted by location."""
+    project = project or analyze_paths(paths, root=root)
+    findings: List[Finding] = []
+    for mi in project.modules.values():
+        err = getattr(mi, "parse_error", None)
+        if err:
+            findings.append(Finding(
+                "PML000", mi.path, 1, 0, f"could not parse: {err}"
+            ))
+            continue
+        _check_module_level(mi, findings)
+        seen_nodes = set()
+        for fi in mi.funcs.values():
+            if id(fi.node) in seen_nodes:
+                continue  # alias entries (wrapper-name -> wrapped fn)
+            seen_nodes.add(id(fi.node))
+            _FuncChecker(fi, findings).visit(fi.node)
+            _check_donation(fi, findings)
+    out = []
+    for f in findings:
+        if select and f.rule not in select:
+            continue
+        mi = project.modules.get(_module_of(project, f))
+        if mi is not None and _suppressed(mi, f):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def _module_of(project: Project, f: Finding) -> str:
+    for name, mi in project.modules.items():
+        if mi.path == f.path:
+            return name
+    return ""

@@ -230,7 +230,10 @@ def _sweep_body(
             "concrete growth predicate; under vmap/jit pass "
             "phase_skip=False (tail runs unconditionally) or fused=True"
         )
-        if bool(jax.device_get(growth)):
+        # host-only branch: the assert above guarantees `growth` is
+        # concrete here (fused=False runs un-traced), so the sync is
+        # intentional — this IS the host-side phase skip
+        if bool(jax.device_get(growth)):  # parmmg-lint: disable=PML001,PML002
             ncollapse = nswap = nmoved = jnp.int32(0)
         else:
             mesh, ncollapse, nswap, nmoved, n_unique = _quality_tail(
@@ -247,6 +250,11 @@ def _sweep_body(
     )
 
 
+# no donate_argnums: the host-side callers that reach this wrapper
+# directly (_polish best-snapshot A/B, the fused/unfused
+# path-equivalence test, warm_ops) all REUSE the input mesh after the
+# call; the hot loop donates at the remesh_sweeps level instead
+# parmmg-lint: disable=PML005
 remesh_sweep = partial(
     jax.jit,
     static_argnames=(
@@ -744,13 +752,22 @@ def _polish(mesh: Mesh, opts: AdaptOptions, emult, hausd: float) -> Mesh:
     return best
 
 
-def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
+def adapt(
+    mesh: Mesh,
+    opts: AdaptOptions | None = None,
+    phase_hook=None,
+):
     """Adapt `mesh` to its metric. Returns (mesh, info dict).
 
     Host loop over `opts.niter` outer iterations of up to `max_sweeps`
     operator sweeps each, with capacity growth between sweeps — the
     single-shard skeleton that `PMMG_parmmglib1` wraps with migration and
-    interpolation in the distributed driver."""
+    interpolation in the distributed driver.
+
+    `phase_hook(name)`, when given, is called at each phase boundary
+    (analysis / metric / input histogram / sweeps / finalize) — the
+    attachment point for `lint.contracts.RetraceCounter` per-phase
+    compile accounting and for external progress monitors."""
     opts = opts or AdaptOptions()
     # unique-edge capacity multiplier: ~1.19 edges/tet asymptotically, but
     # pathological meshes can exceed 1.6x — grown on overflow
@@ -761,6 +778,8 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
         # synchronous, so on remote backends (where a single compile can
         # take minutes) these lines are the only liveness signal before
         # the first sweep prints — watchdogs key off them
+        if phase_hook is not None:
+            phase_hook(name)
         if opts.verbose >= 2:
             print(f"  ## phase: {name}", flush=True)
 
@@ -821,6 +840,7 @@ def adapt(mesh: Mesh, opts: AdaptOptions | None = None):
 
     # once, after the final iteration — polishing between iterations is
     # wasted work (the next iteration's insertion sweeps disturb it)
+    _phase("finalize")
     mesh = _polish(mesh, opts, emult, hausd)
     mesh = compact(mesh)
     if old_snapshot is not None:

@@ -24,7 +24,7 @@ Re-design notes (vs the reference's per-rank group loop):
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+from functools import lru_cache, partial
 from typing import List, Optional
 
 import jax
@@ -194,6 +194,34 @@ def _use_spmd_sweeps() -> bool:
     return multihost.is_multiprocess()
 
 
+@lru_cache(maxsize=32)
+def _spmd_sweep_fn(dmesh, ecap, noinsert, noswap, nomove, nosurf):
+    """One fused SPMD sweep program per (device mesh, capacity, flag)
+    key. Memoized: building jit(shard_map(...)) inside `sweep_fn` made
+    every sweep retrace from scratch (parmmg-lint PML004). `hausd` stays
+    an OPERAND (replicated spec), not part of the key — it may be a
+    traced per-reference table from `local_hausd_table`."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.shard import AXIS, _squeeze, _unsqueeze
+
+    def body(blk, hausd):
+        m = _squeeze(blk)
+        m, stats = remesh_sweep(
+            m, ecap, noinsert=noinsert, noswap=noswap,
+            nomove=nomove, nosurf=nosurf, hausd=hausd,
+            fused=True, phase_skip=False,
+        )
+        return _unsqueeze(m), jax.tree_util.tree_map(
+            lambda x: x[None], stats
+        )
+
+    return jax.jit(jax.shard_map(
+        body, mesh=dmesh, in_specs=(P(AXIS), P()),
+        out_specs=(P(AXIS), P(AXIS)),
+    ))
+
+
 def _remesh_phase_global(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
     it: int, hausd,
@@ -208,10 +236,8 @@ def _remesh_phase_global(
     process, per the `parallel.multihost` contract: the stacked mesh is
     gathered back to host numpy after each sweep, so every other phase
     of `_one_iteration` runs unchanged."""
-    from jax.sharding import PartitionSpec as P
-
     from ..parallel import multihost
-    from ..parallel.shard import AXIS, _squeeze, _unsqueeze, device_mesh
+    from ..parallel.shard import device_mesh
 
     from .adapt import UNFUSED_TCAP
 
@@ -231,22 +257,10 @@ def _remesh_phase_global(
 
     def sweep_fn(s, ecap):
         sg = multihost.put_sharded_global(s, dmesh)
-
-        def body(blk):
-            m = _squeeze(blk)
-            m, stats = remesh_sweep(
-                m, ecap, noinsert=opts.noinsert, noswap=opts.noswap,
-                nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
-                fused=True, phase_skip=False,
-            )
-            return _unsqueeze(m), jax.tree_util.tree_map(
-                lambda x: x[None], stats
-            )
-
-        out, stats = jax.jit(jax.shard_map(
-            body, mesh=dmesh, in_specs=(P(AXIS),),
-            out_specs=(P(AXIS), P(AXIS)),
-        ))(sg)
+        out, stats = _spmd_sweep_fn(
+            dmesh, ecap, opts.noinsert, opts.noswap, opts.nomove,
+            opts.nosurf,
+        )(sg, hausd)
         s2 = multihost.gather_stacked(out)
         stats = multihost.gather_stacked(stats)
         rec = dict(

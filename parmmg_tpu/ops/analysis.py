@@ -71,6 +71,7 @@ def _sorted3(v):
     return jnp.stack([lo, jnp.sum(v, axis=-1) - lo - hi, hi], axis=-1)
 
 
+# parmmg-lint: disable=PML005 -- pure query; the analysis pipeline keeps the mesh
 @jax.jit
 def _missing_face_info(mesh: Mesh):
     """Open tet faces (adja<0) with no matching tria: returns
@@ -187,6 +188,7 @@ def surf_tria_mask(mesh: Mesh) -> jax.Array:
     return mesh.trmask & ((mesh.trtag & tags.NOSURF) == 0)
 
 
+# parmmg-lint: disable=PML005 -- pure query (normals); callers reuse the mesh
 @jax.jit
 def tria_normals(mesh: Mesh):
     """Oriented unit normals and areas of boundary trias.
@@ -239,6 +241,7 @@ def tria_normals(mesh: Mesh):
     return unit, 0.5 * nrm, ok
 
 
+# parmmg-lint: disable=PML005 -- pure query (normals); split/smooth reuse the mesh in the same sweep
 @jax.jit
 def vertex_normals(mesh: Mesh) -> jax.Array:
     """[PC,3] area-weighted unit vertex normals over surface trias
@@ -261,6 +264,7 @@ def vertex_normals(mesh: Mesh) -> jax.Array:
 # feature detection (setdhd + singul semantics)
 # ---------------------------------------------------------------------------
 
+# parmmg-lint: disable=PML005 -- pure query (feature-edge detection); analyze() keeps the mesh
 @partial(jax.jit, static_argnames=("cos_ang",))
 def _detect_feature_edges(mesh: Mesh, cos_ang: float):
     """Classify every unique surface edge by one sort of tria-edge keys.
@@ -373,6 +377,7 @@ def _detect_feature_edges(mesh: Mesh, cos_ang: float):
     return first, prs, etag
 
 
+# parmmg-lint: disable=PML005 -- pure query (dedup info); caller merges into the SAME mesh
 @jax.jit
 def _merge_info(mesh: Mesh, first, prs, etag):
     """Which detected feature edges are new vs already stored; returns
@@ -416,6 +421,7 @@ def _apply_features(mesh: Mesh, first, prs, etag, new_sel, match) -> Mesh:
     return _tag_feature_vertices(mesh)
 
 
+# parmmg-lint: disable=PML005 -- cold analysis path (once per adapt); host call sites reuse the mesh
 @jax.jit
 def _tag_feature_vertices(mesh: Mesh) -> Mesh:
     """Endpoints of feature edges inherit the feature bits (the xpoint

@@ -245,8 +245,13 @@ def rank_winners(
 
 # uint32 sentinel for packed invalid rows (valid packed keys are
 # < (bound+1)^2 - 1 <= 0xFFFE0000 when bound <= PACK_BOUND, so the
-# sentinel never collides)
-SENT_U32 = jnp.uint32(0xFFFFFFFF)
+# sentinel never collides). A NUMPY scalar, deliberately: a jnp
+# constant built at import time leaks as a tracer when this module is
+# first imported from inside a jit trace (the lazy `from ..ops import
+# common` in core.mesh.compact) — the m0 UnexpectedTracerError
+import numpy as _np
+
+SENT_U32 = _np.uint32(0xFFFFFFFF)
 # largest entity-id bound for which two int32 keys pack into one uint32
 PACK_BOUND = 65534
 

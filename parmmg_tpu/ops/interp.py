@@ -27,6 +27,7 @@ from ..core.mesh import Mesh
 from . import locate
 
 
+# parmmg-lint: disable=PML005 -- the background mesh is queried repeatedly across calls
 @jax.jit
 def interp_at(
     old: Mesh, tet_idx: jax.Array, bary: jax.Array
@@ -75,6 +76,7 @@ def interp_fields_only(new: Mesh, old: Mesh, max_steps: int = 64) -> Mesh:
     )
 
 
+# parmmg-lint: disable=PML005 -- the background mesh is queried repeatedly across calls
 @jax.jit
 def interp_at_tria(old: Mesh, tria_idx: jax.Array, bary: jax.Array):
     """Interpolate old-mesh vertex data at points located on boundary
@@ -180,6 +182,7 @@ def interp_metrics_and_fields(
     return _apply_interp(new, old, res, surface, cos_wedge), res
 
 
+# parmmg-lint: disable=PML005 -- old/new meshes are both reused by the caller after interpolation
 @partial(jax.jit, static_argnames=("max_steps", "surface", "cos_wedge"))
 def _interp_all_shards(new: Mesh, old: Mesh, max_steps: int, surface: bool,
                        cos_wedge: float):

@@ -88,6 +88,7 @@ def morton_seeds(mesh: Mesh, pts: jax.Array) -> jax.Array:
     return order[pos]
 
 
+# parmmg-lint: disable=PML005 -- locate queries the same mesh repeatedly; donation would invalidate it
 @partial(jax.jit, static_argnames=("max_steps",))
 def walk_locate(
     mesh: Mesh,
@@ -141,6 +142,7 @@ def walk_locate(
     return LocateResult(cur, clamp_bary(lam), done, steps)
 
 
+# parmmg-lint: disable=PML005 -- locate queries the same mesh repeatedly; donation would invalidate it
 @partial(jax.jit, static_argnames=("tchunk",))
 def exhaustive_locate(mesh: Mesh, pts: jax.Array, tchunk: int = 1024):
     """Best tet per query over ALL valid tets (max of min barycoord),
@@ -213,6 +215,7 @@ class BdyLocateResult(NamedTuple):
 _COS_WEDGE = 0.70710678
 
 
+# parmmg-lint: disable=PML005 -- locate queries the same mesh repeatedly; donation would invalidate it
 @partial(jax.jit, static_argnames=("window",))
 def bdy_locate(
     mesh: Mesh, surf_mask: jax.Array, pts: jax.Array, window: int = 32,
@@ -272,7 +275,7 @@ def bdy_locate(
         score = jnp.where(wrong_side & jnp.isfinite(dist),
                           dist + pen, dist)
     k = jnp.argmin(score, axis=-1)
-    qi = jnp.arange(pts.shape[0])
+    qi = jnp.arange(pts.shape[0], dtype=jnp.int32)
     return BdyLocateResult(cand[qi, k], lam[qi, k], dist[qi, k])
 
 

@@ -140,11 +140,11 @@ def split_long_edges(
     w6 = jnp.where(live_e, win[safe_t2e], False)  # [TC,6]
     has = jnp.any(w6, axis=1) & mesh.tmask
     k = jnp.argmax(w6, axis=1)                    # local edge slot
-    e_of_t = safe_t2e[jnp.arange(tcap), k]
+    e_of_t = safe_t2e[jnp.arange(tcap, dtype=jnp.int32), k]
     ev_j = jnp.asarray(EDGE_VERTS)
     li = ev_j[k, 0]
     lj = ev_j[k, 1]
-    rows = jnp.arange(tcap)
+    rows = jnp.arange(tcap, dtype=jnp.int32)
 
     # --- new vertex position ----------------------------------------------
     pa, pb = mesh.vert[a], mesh.vert[b]
@@ -243,9 +243,9 @@ def split_long_edges(
     _TRI_PAIRS = jnp.array([[0, 1], [1, 2], [0, 2]], jnp.int32)
     fu = _TRI_PAIRS[fk, 0]
     fv = _TRI_PAIRS[fk, 1]
-    fe = jnp.maximum(eid3[jnp.arange(fcap), fk], 0)
+    fe = jnp.maximum(eid3[jnp.arange(fcap, dtype=jnp.int32), fk], 0)
     fnv = vnew[fe]
-    frows = jnp.arange(fcap)
+    frows = jnp.arange(fcap, dtype=jnp.int32)
     triA = mesh.tria.at[frows, fv].set(
         jnp.where(fhas, fnv, mesh.tria[frows, fv])
     )

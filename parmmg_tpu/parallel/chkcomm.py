@@ -10,6 +10,8 @@ phase boundaries, exactly like the reference wraps these checks in
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -20,14 +22,11 @@ from .distribute import ShardComm
 from .shard import AXIS, _squeeze
 
 
-def check_node_comm(
-    stacked: Mesh, comm: ShardComm, dmesh
-) -> dict:
-    """Geometric + topological node-communicator invariants.
-
-    Returns dict(max_coord_err, count_mismatch, valid_mismatch) as host
-    scalars; all zero/small means the tables are coherent.
-    """
+@lru_cache(maxsize=8)
+def _node_comm_checker(dmesh):
+    """Jitted node-communicator checker for one device mesh. Memoized:
+    rebuilding jit(shard_map(...)) per call would retrace every call
+    (parmmg-lint PML004)."""
 
     def body(blk: Mesh, comm_idx_blk, l2g_blk):
         mesh = _squeeze(blk)
@@ -62,7 +61,7 @@ def check_node_comm(
         valid_mismatch = jax.lax.psum(bad_slot, AXIS)
         return max_err, gid_mismatch, count_mismatch, valid_mismatch
 
-    f = jax.jit(
+    return jax.jit(
         jax.shard_map(
             body,
             mesh=dmesh,
@@ -70,6 +69,17 @@ def check_node_comm(
             out_specs=(P(), P(), P(), P()),
         )
     )
+
+
+def check_node_comm(
+    stacked: Mesh, comm: ShardComm, dmesh
+) -> dict:
+    """Geometric + topological node-communicator invariants.
+
+    Returns dict(max_coord_err, count_mismatch, valid_mismatch) as host
+    scalars; all zero/small means the tables are coherent.
+    """
+    f = _node_comm_checker(dmesh)
     max_err, gid_mm, cnt_mm, val_mm = f(stacked, comm.comm_idx, comm.l2g)
     return dict(
         max_coord_err=float(max_err),
@@ -79,19 +89,10 @@ def check_node_comm(
     )
 
 
-def check_face_edge_comm(stacked: Mesh, comm: ShardComm, dmesh) -> dict:
-    """Geometric face/edge-communicator invariants — the
-    `PMMG_check_extFaceComm` (barycenter agreement,
-    reference `src/chkcomm_pmmg.c:1027`) and `PMMG_check_extEdgeComm`
-    (midpoint agreement, `:605`) roles.
-
-    Interface trias (PARBDY|NOSURF) and interface feature edges are
-    replicated per shard and matched *by sorted global-vertex-id key*
-    across the all-gathered set: every pure-interface tria must appear on
-    exactly two shards, and every copy of a matched tria/edge must have
-    the same barycenter/midpoint. Returns dict(face_count_bad,
-    max_face_bc_err, max_edge_mid_err, edge_tag_mismatch).
-    """
+@lru_cache(maxsize=8)
+def _face_edge_checker(dmesh):
+    """Jitted face/edge-communicator checker for one device mesh,
+    memoized like `_node_comm_checker` (parmmg-lint PML004)."""
     from ..core import tags
     from ..ops import common
 
@@ -167,7 +168,7 @@ def check_face_edge_comm(stacked: Mesh, comm: ShardComm, dmesh) -> dict:
             jax.lax.pmax(tag_mm, AXIS),
         )
 
-    f = jax.jit(
+    return jax.jit(
         jax.shard_map(
             body,
             mesh=dmesh,
@@ -175,7 +176,24 @@ def check_face_edge_comm(stacked: Mesh, comm: ShardComm, dmesh) -> dict:
             out_specs=(P(), P(), P(), P()),
         )
     )
-    face_err, face_bad, edge_err, tag_mm = f(stacked, comm.l2g)
+
+
+def check_face_edge_comm(stacked: Mesh, comm: ShardComm, dmesh) -> dict:
+    """Geometric face/edge-communicator invariants — the
+    `PMMG_check_extFaceComm` (barycenter agreement,
+    reference `src/chkcomm_pmmg.c:1027`) and `PMMG_check_extEdgeComm`
+    (midpoint agreement, `:605`) roles.
+
+    Interface trias (PARBDY|NOSURF) and interface feature edges are
+    replicated per shard and matched *by sorted global-vertex-id key*
+    across the all-gathered set: every pure-interface tria must appear on
+    exactly two shards, and every copy of a matched tria/edge must have
+    the same barycenter/midpoint. Returns dict(face_count_bad,
+    max_face_bc_err, max_edge_mid_err, edge_tag_mismatch).
+    """
+    face_err, face_bad, edge_err, tag_mm = _face_edge_checker(dmesh)(
+        stacked, comm.l2g
+    )
     return dict(
         max_face_bc_err=float(face_err),
         face_count_bad=int(face_bad),

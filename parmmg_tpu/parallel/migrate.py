@@ -58,7 +58,7 @@ def stacked_halo_max(vals: jax.Array, comm: ShardComm) -> jax.Array:
     d = ci.shape[0]
     # recv[s, r, k] = vals[r, ci[r, s, k]]
     src_rows = jnp.broadcast_to(
-        jnp.arange(d)[None, :, None], safe.shape
+        jnp.arange(d, dtype=jnp.int32)[None, :, None], safe.shape
     )
     recv = vals[src_rows, jnp.swapaxes(safe, 0, 1)]
     neutral = (
@@ -95,6 +95,7 @@ def _color_prio(nparts: int, round_id: int) -> jax.Array:
     return jnp.asarray(pr, jnp.int32)
 
 
+# parmmg-lint: disable=PML005 -- returns colors only; the caller keeps the stacked mesh
 @partial(jax.jit, static_argnames=("nparts", "round_id", "layers",
                                    "min_elts"))
 def displace_colors(
@@ -351,6 +352,7 @@ def migration_counts(stacked: Mesh, color: jax.Array, nparts: int):
     )(cnt, safe, out)
 
 
+# parmmg-lint: disable=PML005 -- caller still reads `stacked` when integrating the received buffers
 @partial(jax.jit, static_argnames=("slot_cap", "tria_cap", "edge_cap"))
 def _pack(stacked: Mesh, color: jax.Array, slot_cap: int,
           tria_cap: int, edge_cap: int):
@@ -489,6 +491,7 @@ def _exchange(buf: jax.Array) -> jax.Array:
     return jnp.swapaxes(buf, 0, 1)
 
 
+# parmmg-lint: disable=PML005 -- deliberate (see NB below): capacity-miss fallback reuses the arrays
 @jax.jit
 def _integrate(stacked: Mesh, out_t, rti, rtf, rfi, rei, tria_keep,
                edge_keep):
@@ -699,6 +702,7 @@ def migrate(stacked: Mesh, color: jax.Array, nparts: int,
 _IFC_TAG = tags.PARBDY | tags.REQUIRED | tags.NOSURF | tags.BDY
 
 
+# parmmg-lint: disable=PML005 -- the host merges results back into the SAME stacked mesh
 @partial(jax.jit, static_argnames=("fcapq",))
 def _retag_device_core(stacked: Mesh, fcapq: int):
     """Device-resident interface retagging (the PMMG_updateTag role,

@@ -55,6 +55,7 @@ def metric_weights(mesh: Mesh) -> jax.Array:
     return jnp.where(mesh.tmask, w, 0.0)
 
 
+# parmmg-lint: disable=PML005 -- returns partition labels; mesh reused by split/migration
 @partial(jax.jit, static_argnames=("nparts",))
 def sfc_partition(
     mesh: Mesh,
@@ -89,6 +90,7 @@ def sfc_partition(
     return jnp.where(mesh.tmask, part, -1)
 
 
+# parmmg-lint: disable=PML005 -- returns partition labels; mesh reused by split/migration
 @partial(jax.jit, static_argnames=("nparts", "nbuckets"))
 def stacked_graph_colors(
     stacked: Mesh,

@@ -10,7 +10,7 @@ spans hosts and XLA routes the all_to_all over ICI/DCN.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable
 
 import jax
@@ -57,7 +57,9 @@ def shard_fn(fn: Callable, dmesh: DeviceMesh, out_stacked: bool = True):
         return _unsqueeze(out) if out_stacked else out
 
     spec = P(AXIS)
-    return jax.jit(
+    # factory by contract: the CALLER owns the returned wrapper's
+    # lifetime and is responsible for caching it across calls
+    return jax.jit(  # parmmg-lint: disable=PML004
         jax.shard_map(
             body,
             mesh=dmesh,
@@ -67,10 +69,11 @@ def shard_fn(fn: Callable, dmesh: DeviceMesh, out_stacked: bool = True):
     )
 
 
-def sharded_quality_histogram(stacked: Mesh, dmesh: DeviceMesh):
-    """Distributed quality histogram: per-shard histogram + cross-shard
-    reduction (reference `PMMG_qualhisto`, `src/quality_pmmg.c:156` — the
-    custom MPI_Op becomes `reduce_histograms`' pmin/psum)."""
+@lru_cache(maxsize=8)
+def _sharded_hist_fn(dmesh: DeviceMesh):
+    """Jitted per-device-mesh histogram reducer. Memoized: rebuilding
+    jit(shard_map(...)) per call would retrace on every histogram
+    (parmmg-lint PML004)."""
     from ..ops import quality
 
     def body(blk: Mesh):
@@ -78,9 +81,15 @@ def sharded_quality_histogram(stacked: Mesh, dmesh: DeviceMesh):
         h = quality.quality_histogram(m)
         return quality.reduce_histograms(h, AXIS)
 
-    f = jax.jit(
+    return jax.jit(
         jax.shard_map(
             body, mesh=dmesh, in_specs=(P(AXIS),), out_specs=P()
         )
     )
-    return f(stacked)
+
+
+def sharded_quality_histogram(stacked: Mesh, dmesh: DeviceMesh):
+    """Distributed quality histogram: per-shard histogram + cross-shard
+    reduction (reference `PMMG_qualhisto`, `src/quality_pmmg.c:156` — the
+    custom MPI_Op becomes `reduce_histograms`' pmin/psum)."""
+    return _sharded_hist_fn(dmesh)(stacked)

@@ -29,15 +29,15 @@ for _accel in ("axon", "tpu", "cuda", "rocm"):
     _xb._backend_factories.pop(_accel, None)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
-# Persistent compile cache for the CPU suite (round-5): the round-2-era
-# segfault in executable (de)serialization no longer reproduces on this
-# tree — measured warm adapt 50.4 s -> 6.1 s (8x). The cpu_aot_loader
-# logs a noisy per-load "machine feature +prefer-no-scatter not
-# supported" ERROR; those are XLA's own scheduling pseudo-features on a
-# same-machine cache, not real ISA features, so the loads are safe —
-# TF_CPP_MIN_LOG_LEVEL=3 (set above, before jax import) silences them.
-# PARMMG_NO_CPU_CACHE=1 restores the uncached behavior.
-if not os.environ.get("PARMMG_NO_CPU_CACHE"):
+# Persistent compile cache for the CPU suite: OPT-IN ONLY
+# (PARMMG_CPU_CACHE=1). The round-2-era executable (de)serialization
+# crash DOES reproduce on this jaxlib (re-measured PR 1): a cold run
+# that only WRITES cache entries completes its tests cleanly, while the
+# next warm run ABORTS (SIGABRT in jax Array._value) executing a
+# deserialized executable — both with the previously committed blobs
+# and with blobs freshly written by this very jaxlib. Cold compiles are
+# slower but stable, and stability is what the tier-1 gate measures.
+if os.environ.get("PARMMG_CPU_CACHE"):
     _cache = os.path.join(os.path.dirname(__file__), ".jax_cache_cpu")
     jax.config.update("jax_compilation_cache_dir", _cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
@@ -57,6 +57,199 @@ def pytest_configure(config):
     )
 
 
+# ---------------------------------------------------------------------------
+# reference-fixture synthesis
+#
+# The reference checkout (/root/reference) is not mounted in every
+# environment. The fixture tests assert cube-GENERIC properties (12/12
+# two-slab unit cube, scalar met 0.5, structural communicator records),
+# so when the reference files are absent we synthesize equivalent
+# fixtures with the package's own writers: a unit cube as two stacked
+# Freudenthal-split slabs (12 vertices, 12 positively-oriented tets,
+# full boundary triangulation) and a 4-shard x-sliced "wave" with
+# ParallelTriangleCommunicators records.
+# ---------------------------------------------------------------------------
+
+import itertools  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+
+def _freudenthal_box(vid):
+    """6-tet Kuhn split of a box; `vid[(i,j,k)]` -> vertex id. The six
+    path tets share the main diagonal, so stacked boxes conform."""
+    tets = []
+    for perm in itertools.permutations(range(3)):
+        p = [0, 0, 0]
+        path = [tuple(p)]
+        for ax in perm:
+            p[ax] = 1
+            path.append(tuple(p))
+        tets.append([vid[q] for q in path])
+    return tets
+
+
+def _orient_positive(verts, tets):
+    tets = np.asarray(tets, np.int32)
+    c = verts[tets]
+    vol = np.einsum(
+        "ti,ti->t",
+        np.cross(c[:, 1] - c[:, 0], c[:, 2] - c[:, 0]),
+        c[:, 3] - c[:, 0],
+    )
+    flip = vol < 0
+    tets[flip] = tets[flip][:, [0, 1, 3, 2]]
+    return tets
+
+
+def _boundary_trias(tets):
+    from parmmg_tpu.core.mesh import FACE_VERTS
+
+    seen = {}
+    for tet in np.asarray(tets):
+        for f in range(4):
+            tri = tuple(int(v) for v in tet[FACE_VERTS[f]])
+            seen.setdefault(tuple(sorted(tri)), []).append(tri)
+    return np.asarray(
+        [v[0] for v in seen.values() if len(v) == 1], np.int32
+    ).reshape(-1, 3)
+
+
+def _tria_plane_refs(verts, trias):
+    """Stable per-face references: 1..6 for the axis-aligned bounding
+    planes, 0 elsewhere."""
+    refs = np.zeros(len(trias), np.int32)
+    lo, hi = verts.min(axis=0), verts.max(axis=0)
+    for r, (ax, val) in enumerate(
+        [(2, lo[2]), (2, hi[2]), (0, lo[0]), (0, hi[0]),
+         (1, lo[1]), (1, hi[1])], start=1
+    ):
+        on = np.all(np.isclose(verts[trias][:, :, ax], val), axis=1)
+        refs[on & (refs == 0)] = r
+    return refs
+
+
+def _grid_mesh(nx):
+    """(verts, tets) of [0,1]^3 sliced into nx Freudenthal slabs
+    along x. nx=1 with a z-split of 2 gives the canonical 12/12 cube."""
+    vid = {}
+    verts = []
+
+    def v(i, j, k, scale):
+        key = (i, j, k)
+        if key not in vid:
+            vid[key] = len(verts)
+            verts.append([i * scale[0], j * scale[1], k * scale[2]])
+        return vid[key]
+
+    tets = []
+    for bx in range(nx):
+        box = {
+            (i, j, k): v(bx + i, j, k, (1.0 / nx, 1.0, 1.0))
+            for i in (0, 1) for j in (0, 1) for k in (0, 1)
+        }
+        tets.extend(_freudenthal_box(box))
+    verts = np.asarray(verts, np.float64)
+    return verts, _orient_positive(verts, tets)
+
+
+def _synth_cube(dirpath):
+    """cube.mesh + cube-met.sol: unit cube as two stacked z-slabs —
+    12 vertices, 12 tets, every vertex on the surface, volume 1."""
+    from parmmg_tpu.core.mesh import Mesh
+    from parmmg_tpu.io import medit
+
+    vid = {}
+    verts = []
+
+    def v(i, j, k):
+        key = (i, j, k)
+        if key not in vid:
+            vid[key] = len(verts)
+            verts.append([float(i), float(j), k * 0.5])
+        return vid[key]
+
+    tets = []
+    for bz in range(2):
+        box = {
+            (i, j, k): v(i, j, bz + k)
+            for i in (0, 1) for j in (0, 1) for k in (0, 1)
+        }
+        tets.extend(_freudenthal_box(box))
+    verts = np.asarray(verts, np.float64)
+    tets = _orient_positive(verts, tets)
+    trias = _boundary_trias(tets)
+    from parmmg_tpu.core import tags as _tags
+
+    # every input vertex is REQUIRED, like the reference example's
+    # coarse cube: all 12 sit on ridges/corners, and the collapse
+    # discipline tests expect the input skeleton to be preserved
+    m = Mesh.from_numpy(
+        verts, tets, trias=trias,
+        trrefs=_tria_plane_refs(verts, trias),
+        vtags=np.full(len(verts), _tags.REQUIRED, np.int32),
+    )
+    mesh_path = str(dirpath / "cube.mesh")
+    medit.save_mesh(m, mesh_path)
+    medit.save_sol(
+        str(dirpath / "cube-met.sol"),
+        np.full((len(verts), 1), 0.5),
+        [medit.SOL_SCALAR],
+    )
+    return mesh_path
+
+
+def _synth_wave(dirpath):
+    """wave.{0..3}.mesh: 4 x-slabs with ParallelTriangleCommunicators
+    (each interface tria shared, by global id, with its neighbor)."""
+    from parmmg_tpu.core.mesh import Mesh
+    from parmmg_tpu.io import medit
+
+    gverts, gtets = _grid_mesh(4)
+    # global tria numbering over sorted-vertex keys
+    gid_of = {}
+
+    def tri_gid(key):
+        if key not in gid_of:
+            gid_of[key] = len(gid_of)
+        return gid_of[key]
+
+    paths = []
+    for r in range(4):
+        sel = np.all(
+            (gverts[gtets][:, :, 0] >= r / 4 - 1e-9)
+            & (gverts[gtets][:, :, 0] <= (r + 1) / 4 + 1e-9),
+            axis=1,
+        )
+        tets_r = gtets[sel]
+        used = np.unique(tets_r)
+        l_of = {int(g): i for i, g in enumerate(used)}
+        ltets = np.vectorize(l_of.get)(tets_r).astype(np.int32)
+        lverts = gverts[used]
+        trias = _boundary_trias(ltets)
+        comms = {}
+        for t, tri in enumerate(trias):
+            x = lverts[tri][:, 0]
+            for nb, plane in ((r - 1, r / 4), (r + 1, (r + 1) / 4)):
+                if 0 <= nb < 4 and np.allclose(x, plane):
+                    key = tuple(sorted(int(used[v]) for v in tri))
+                    comms.setdefault(nb, ([], []))
+                    comms[nb][0].append(t)
+                    comms[nb][1].append(tri_gid(key))
+        face_comms = [
+            (nb, np.asarray(loc, np.int64), np.asarray(glob, np.int64))
+            for nb, (loc, glob) in sorted(comms.items())
+        ]
+        m = Mesh.from_numpy(
+            lverts, ltets, trias=trias,
+            trrefs=_tria_plane_refs(lverts, trias),
+        )
+        p = str(dirpath / f"wave.{r}.mesh")
+        medit.save_mesh(m, p, face_comms=face_comms)
+        paths.append(p)
+    return paths
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Workaround for a jaxlib CPU-compiler segfault: after many large
@@ -73,15 +266,32 @@ def _clear_jax_caches_between_modules():
 
 
 @pytest.fixture(scope="session")
-def cube_mesh_path():
-    return str(REF_EX0 / "cube.mesh")
+def _synth_dir(tmp_path_factory):
+    return tmp_path_factory.mktemp("synth_reference")
 
 
 @pytest.fixture(scope="session")
-def cube_met_path():
-    return str(REF_EX0 / "cube-met.sol")
+def cube_mesh_path(_synth_dir):
+    if (REF_EX0 / "cube.mesh").exists():
+        return str(REF_EX0 / "cube.mesh")
+    if not (_synth_dir / "cube.mesh").exists():
+        _synth_cube(_synth_dir)
+    return str(_synth_dir / "cube.mesh")
 
 
 @pytest.fixture(scope="session")
-def wave_shard_paths():
-    return [str(REF_EX1 / f"wave.{r}.mesh") for r in range(4)]
+def cube_met_path(_synth_dir):
+    if (REF_EX0 / "cube-met.sol").exists():
+        return str(REF_EX0 / "cube-met.sol")
+    if not (_synth_dir / "cube-met.sol").exists():
+        _synth_cube(_synth_dir)
+    return str(_synth_dir / "cube-met.sol")
+
+
+@pytest.fixture(scope="session")
+def wave_shard_paths(_synth_dir):
+    if (REF_EX1 / "wave.0.mesh").exists():
+        return [str(REF_EX1 / f"wave.{r}.mesh") for r in range(4)]
+    if not (_synth_dir / "wave.0.mesh").exists():
+        _synth_wave(_synth_dir)
+    return [str(_synth_dir / f"wave.{r}.mesh") for r in range(4)]

@@ -1,0 +1,451 @@
+"""M11: the JAX-invariant linter (parmmg_tpu.lint) + runtime contracts.
+
+Fixture-file tests: every rule has a known-bad snippet that must fire
+(by ID) and a known-good/suppressed variant that must not.  The
+analyzer half is pure AST — the fixtures are written to tmp_path and
+linted in-process.
+"""
+
+import textwrap
+
+import pytest
+
+from parmmg_tpu.lint import run_lint
+from parmmg_tpu.lint.rules import RULES
+
+
+def lint(tmp_path, src, name="mod.py"):
+    (tmp_path / name).write_text(textwrap.dedent(src))
+    return run_lint([str(tmp_path)], root=str(tmp_path))
+
+
+def rule_ids(findings):
+    return {f.rule for f in findings}
+
+
+HEADER = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import lru_cache, partial
+"""
+
+
+def test_rule_catalog_size():
+    # acceptance: >= 8 implemented rules, each with a stable PML id
+    assert len(RULES) >= 8
+    assert all(r.startswith("PML") for r in RULES)
+
+
+# --- PML001 host-sync ----------------------------------------------------
+
+
+def test_pml001_device_get_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        y = jnp.sum(x)
+        return jax.device_get(y)
+    """)
+    assert "PML001" in rule_ids(out)
+
+
+def test_pml001_item_and_numpy_fire(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        a = x.item()
+        b = np.asarray(x)
+        return a, b
+    """)
+    assert sum(f.rule == "PML001" for f in out) == 2
+
+
+def test_pml001_host_code_clean(tmp_path):
+    # not jit-reachable: numpy syncs on host code are fine
+    out = lint(tmp_path, HEADER + """
+    def host(x):
+        return np.asarray(x).item()
+    """)
+    assert "PML001" not in rule_ids(out)
+
+
+# --- PML002 traced bool --------------------------------------------------
+
+
+def test_pml002_if_on_traced_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        if x > 0:
+            return x
+        return -x
+    """)
+    assert "PML002" in rule_ids(out)
+
+
+def test_pml002_static_argnames_clean(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @partial(jax.jit, static_argnames=("flag",))
+    def f(x, flag):
+        if flag:
+            return x
+        return -x
+    """)
+    assert "PML002" not in rule_ids(out)
+
+
+def test_pml002_is_none_clean(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x, y=None):
+        if y is None:
+            return x
+        return x + y
+    """)
+    assert "PML002" not in rule_ids(out)
+
+
+def test_pml002_interprocedural_taint(tmp_path):
+    # taint flows through the call into the helper's parameter
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def entry(x):
+        return helper(x * 2)
+
+    def helper(y):
+        if y > 0:
+            return y
+        return -y
+    """)
+    bad = [f for f in out if f.rule == "PML002"]
+    assert bad and "helper" in bad[0].func
+
+
+# --- PML003 traced loop --------------------------------------------------
+
+
+def test_pml003_for_over_traced_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        total = 0
+        for v in x:
+            total = total + v
+        return total
+    """)
+    assert "PML003" in rule_ids(out)
+
+
+def test_pml003_static_range_clean(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        for k in range(4):
+            x = x + k
+        return x
+    """)
+    assert "PML003" not in rule_ids(out)
+
+
+# --- PML004 inline jit ---------------------------------------------------
+
+
+def test_pml004_inline_jit_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    def g(f, x):
+        return jax.jit(f)(x)
+    """)
+    assert "PML004" in rule_ids(out)
+
+
+def test_pml004_module_level_clean(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        return x
+    """)
+    assert "PML004" not in rule_ids(out)
+
+
+def test_pml004_memoized_factory_clean(tmp_path):
+    # @lru_cache factories are the sanctioned fix, not a violation
+    out = lint(tmp_path, HEADER + """
+    @lru_cache(maxsize=8)
+    def make(key):
+        def body(x):
+            return x * key
+        return jax.jit(body)
+    """)
+    assert "PML004" not in rule_ids(out)
+
+
+# --- PML005 missing donation --------------------------------------------
+
+
+def test_pml005_mesh_without_donate_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(mesh):
+        return mesh
+    """)
+    assert "PML005" in rule_ids(out)
+
+
+def test_pml005_donating_clean(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @partial(jax.jit, donate_argnums=0)
+    def f(mesh):
+        return mesh
+    """)
+    assert "PML005" not in rule_ids(out)
+
+
+def test_pml005_partial_wrap_assignment(tmp_path):
+    # the `name = partial(jax.jit, ...)(impl)` module-level idiom
+    out = lint(tmp_path, HEADER + """
+    def _impl(mesh, k):
+        return mesh
+
+    wrapped = partial(jax.jit, static_argnames=("k",))(_impl)
+    """)
+    assert "PML005" in rule_ids(out)
+
+
+# --- PML006 dtype widening ----------------------------------------------
+
+
+def test_pml006_float64_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    def f(x):
+        return x.astype(jnp.float64)
+    """)
+    assert "PML006" in rule_ids(out)
+
+
+def test_pml006_host_numpy_clean(tmp_path):
+    # host-side numpy int64 (sort keys etc.) is fine
+    out = lint(tmp_path, HEADER + """
+    def f(x):
+        return np.asarray(x, np.int64)
+    """)
+    assert "PML006" not in rule_ids(out)
+
+
+# --- PML007 dynamic shapes ----------------------------------------------
+
+
+def test_pml007_boolean_mask_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        return x[x > 0]
+    """)
+    assert "PML007" in rule_ids(out)
+
+
+def test_pml007_nonzero_fires_unique_sized_clean(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        a = jnp.nonzero(x)
+        b = jnp.unique(x, size=4)
+        return a, b
+    """)
+    assert sum(f.rule == "PML007" for f in out) == 1
+
+
+def test_pml007_three_arg_where_clean(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x, mask):
+        return jnp.where(mask, x, 0.0)
+    """)
+    assert "PML007" not in rule_ids(out)
+
+
+# --- PML008 print under trace -------------------------------------------
+
+
+def test_pml008_print_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        print("tracing", x)
+        return x
+    """)
+    assert "PML008" in rule_ids(out)
+
+
+# --- PML009 arange dtype -------------------------------------------------
+
+
+def test_pml009_arange_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        return jnp.arange(x.shape[0])
+    """)
+    assert "PML009" in rule_ids(out)
+
+
+def test_pml009_arange_with_dtype_clean(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        return jnp.arange(x.shape[0], dtype=jnp.int32)
+    """)
+    assert "PML009" not in rule_ids(out)
+
+
+# --- suppressions --------------------------------------------------------
+
+
+def test_suppression_same_line(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        return jax.device_get(x)  # parmmg-lint: disable=PML001 -- why
+    """)
+    assert "PML001" not in rule_ids(out)
+
+
+def test_suppression_previous_line(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        # parmmg-lint: disable=PML001
+        return jax.device_get(x)
+    """)
+    assert "PML001" not in rule_ids(out)
+
+
+def test_suppression_def_scope(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    # parmmg-lint: disable=PML008
+    @jax.jit
+    def f(x):
+        print("a")
+        print("b")
+        return x
+    """)
+    assert "PML008" not in rule_ids(out)
+
+
+def test_suppression_file_level(tmp_path):
+    out = lint(tmp_path, """
+    # parmmg-lint: disable-file=PML006
+    import jax.numpy as jnp
+
+    def f(x):
+        return x.astype(jnp.float64)
+    """)
+    assert "PML006" not in rule_ids(out)
+
+
+def test_suppression_wrong_rule_still_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    @jax.jit
+    def f(x):
+        return jax.device_get(x)  # parmmg-lint: disable=PML008
+    """)
+    assert "PML001" in rule_ids(out)
+
+
+# --- repo gate -----------------------------------------------------------
+
+
+def test_repo_is_lint_clean():
+    """Acceptance: the committed tree lints clean (all findings fixed
+    or explicitly suppressed)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = run_lint(
+        [os.path.join(root, "parmmg_tpu"), os.path.join(root, "tools")],
+        root=root,
+    )
+    assert out == [], "\n".join(f.format() for f in out)
+
+
+# --- runtime contracts ---------------------------------------------------
+
+
+def test_contracts_mesh_ok_and_corruption_caught():
+    import jax
+
+    from parmmg_tpu.core import adjacency
+    from parmmg_tpu.lint import contracts as C
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    m = adjacency.build_adjacency(unit_cube_mesh(3))
+    rep = C.assert_mesh_ok(m)
+    assert all(v == 0 for v in rep.values())
+
+    bad = m.replace(tet=m.tet.at[0, 0].set(10 ** 6))
+    with pytest.raises(C.MeshContractError) as ei:
+        C.assert_mesh_ok(bad)
+    assert ei.value.report["tet_conn_bad"] == 1
+
+    bad2 = m.replace(adja=m.adja.at[0, 0].set(-5))
+    with pytest.raises(C.MeshContractError) as ei:
+        C.assert_mesh_ok(bad2)
+    assert ei.value.report["adja_sentinel_bad"] == 1
+
+
+def test_contracts_report_is_jittable():
+    import jax
+
+    from parmmg_tpu.lint import contracts as C
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    rep = jax.jit(C.mesh_invariant_report)(unit_cube_mesh(3))
+    assert int(rep["tet_conn_bad"]) == 0
+
+
+def test_contracts_owner_consistency():
+    from types import SimpleNamespace
+
+    import jax.numpy as jnp
+
+    from parmmg_tpu.lint import contracts as C
+
+    comm = SimpleNamespace(
+        l2g=jnp.asarray([[0, 1, 2, -1], [1, 2, 3, -1]], jnp.int32),
+        owner=jnp.asarray(
+            [[True, True, True, False], [False, False, True, False]]
+        ),
+        comm_idx=jnp.asarray(
+            [[[-1, -1], [1, 2]], [[0, 1], [-1, -1]]], jnp.int32
+        ),
+        counts=jnp.asarray([[0, 2], [2, 0]], jnp.int32),
+    )
+    rep = C.assert_comm_ok(comm)
+    assert all(v == 0 for v in rep.values())
+
+    # two owners for gid 1 -> owner_bad
+    comm.owner = comm.owner.at[1, 0].set(True)
+    with pytest.raises(C.MeshContractError) as ei:
+        C.assert_comm_ok(comm)
+    assert ei.value.report["owner_bad"] == 1
+
+
+def test_retrace_counter_and_budget():
+    import jax
+    import jax.numpy as jnp
+
+    from parmmg_tpu.lint import contracts as C
+
+    counter = C.RetraceCounter()
+    with counter:
+        with counter.phase("warm"):
+            f = jax.jit(lambda x: x * 2)
+            f(jnp.ones(3))
+        with counter.phase("steady", budget=0):
+            f(jnp.ones(3))  # cache hit: within budget
+    assert counter.counts.get("warm", 0) >= 1
+    assert counter.counts.get("steady", 0) == 0
+
+    with pytest.raises(C.RetraceBudgetExceeded):
+        with counter, counter.phase("strict", budget=0):
+            jax.jit(lambda x: x * 7)(jnp.ones(6))

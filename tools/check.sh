@@ -1,0 +1,29 @@
+#!/bin/bash
+# CI gate: static analysis first (fails fast, pure stdlib — no
+# accelerator touch), then the tier-1 test command from ROADMAP.md.
+#
+#   tools/check.sh            # lint + tier-1 tests
+#   tools/check.sh --lint-only
+#
+# The linter must exit 0 on the committed tree: every finding is either
+# fixed or carries an explicit `# parmmg-lint: disable=RULE -- why`
+# suppression. New findings therefore fail this gate.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+python -m parmmg_tpu.lint parmmg_tpu tools
+rc=$?
+echo "## lint rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+[ "${1:-}" = "--lint-only" ] && exit 0
+
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+echo "## tier1 rc=$rc"
+exit $rc

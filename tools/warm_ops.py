@@ -10,7 +10,7 @@ Exits nonzero (with a summary) if any op never warmed — a scripted
 `warm_ops && scale_run` must not proceed into the cold-compile
 livelock on a half-warm cache.
 
-Usage: python tools/warm_ops.py [n] [hsiz] [--stall S]
+Usage: python tools/warm_ops.py [n] [hsiz] [--stall S] [--attempts K]
 """
 
 import os
@@ -117,6 +117,12 @@ def main():
     # ~850k-tet capacities): a timeout below it livelocks — a killed
     # compile caches nothing
     stall = int(flags.get("stall", 1800))
+    # --attempts K: per-op retry cap. Scripted prep stages pass 1 so a
+    # compile that exceeds its (already long) stall cap fails fast
+    # instead of burning stall*3 of the stage budget (ADVICE r5)
+    attempts = int(flags.get("attempts", 3))
+    if attempts < 1:
+        raise SystemExit(f"--attempts must be >= 1, got {attempts}")
     # --ops a,b,c: warm a subset (lets two warmers split the list and
     # overlap server-side compiles — watch the compile-helper OOM risk)
     ops = flags.get("ops")
@@ -127,7 +133,7 @@ def main():
     failed = []
     for op in ops:
         ok = False
-        for attempt in (1, 2, 3):
+        for attempt in range(1, attempts + 1):
             t0 = time.time()
             try:
                 rc = subprocess.run(

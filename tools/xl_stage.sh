@@ -1,11 +1,26 @@
 #!/bin/bash
 # staged xl (n=16) warm+run: the prep chain is one giant analysis-shape
 # compile that can exceed the default 90-min stall on a loaded tunnel —
-# give it ONE long-capped attempt, then warm the rest, then measure.
-cd /root/repo
-python tools/warm_ops.py 16 0.02 --tight 1 --stall 10800 --ops prep
-echo "## stage prep rc=$?"
+# give it ONE long-capped attempt (--attempts 1: a second identical
+# attempt would just re-time-out), then warm the rest, then measure.
+#
+# Every stage's rc is captured and a failed warm ABORTS before
+# scale_run: warm_ops' contract is that a scripted warm+run must not
+# proceed into the cold-compile livelock on a half-warm cache —
+# scale_run's 2700 s stall is far below the cold prep compile budget
+# (10800 s), so running half-warm just burns its 4 retries mid-compile
+# and caches nothing (ADVICE r5).
+set -u
+cd /root/repo || exit 1
+python tools/warm_ops.py 16 0.02 --tight 1 --stall 10800 --attempts 1 --ops prep
+rc=$?
+echo "## stage prep rc=$rc"
+[ $rc -ne 0 ] && exit $rc
 python tools/warm_ops.py 16 0.02 --tight 1 --stall 5400 --ops compact,unique_edges,split,collapse,swap32,build_adjacency,swap23,smooth,histogram,polish
-echo "## stage rest rc=$?"
+rc=$?
+echo "## stage rest rc=$rc"
+[ $rc -ne 0 ] && exit $rc
 python tools/scale_run.py 16 0.02 --tight 1 --stall 2700 --retries 4
-echo "## stage run rc=$?"
+rc=$?
+echo "## stage run rc=$rc"
+exit $rc
