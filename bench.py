@@ -170,6 +170,14 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
     steady_misses = sum(
         v for k, v in counter.counts.items() if k.startswith("steady")
     )
+    # per-sweep fraction of unique edges the active-set sweep offered to
+    # its operators (round 6): 1.0 on a full/first sweep, decaying as
+    # the frontier drains — the byte-level-reduction telemetry the
+    # PERF_NOTES round-5 analysis called for
+    saf = [
+        round(r["n_active"] / max(r["n_unique"], 1), 4)
+        for r in info["history"] if "n_active" in r
+    ]
     return {
         "metric": "tets_per_sec",
         "value": round(tps, 1),
@@ -182,6 +190,7 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         "qavg": round(float(h.qavg), 5),
         "recompiles": dict(counter.counts),
         "steady_recompiles": steady_misses,
+        "sweep_active_fraction": saf,
     }
 
 

@@ -128,3 +128,202 @@ def unique_edges(mesh: Mesh, ecap: int):
 def boundary_faces(mesh: Mesh):
     """Mask [TC,4] of faces with no neighbor (requires fresh adjacency)."""
     return (mesh.adja < 0) & mesh.tmask[:, None]
+
+
+# ---------------------------------------------------------------------------
+# incremental (frontier-compacted) rebuilds — round 6
+#
+# Both functions share one contract with the frontier sweeps
+# (models/adapt.py): the existing table was computed on the SAME
+# vertex/tet numbering (no compaction since), and `changed_v` marks
+# every vertex of every tet row created, deleted, or rewritten since
+# the table was built (the operators' `changed_v` stats guarantee this:
+# a modified tet marks all of its vertices). It follows that a face or
+# edge whose pairing/membership could have changed has ALL its vertices
+# in `changed_v` — both sides of a stale face pairing share the 3 face
+# vertices of the modified side — so only those rows are recomputed,
+# gathered into a fixed-K compacted stream (static shape) and merged
+# into the previous table. Overflowing frontiers fall back to the full
+# rebuild via `lax.cond`, so the result is always exact.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("K",), donate_argnums=0)
+def update_adjacency(mesh: Mesh, changed_v: jax.Array, K: int) -> Mesh:
+    """Incrementally refresh `mesh.adja` for a frontier of changed
+    vertices: re-match only faces whose 3 vertices are all in
+    `changed_v`, at K-compacted sort size instead of 4*TC (see the
+    contract note above). More than `K` hot faces -> full
+    `build_adjacency`."""
+    from ..ops import common as _common
+
+    tc = mesh.tcap
+
+    def _full(m):
+        return build_adjacency(m)
+
+    def _incr(m):
+        fv = m.tet[:, FACE_VERTS]                      # [TC,4,3]
+        a, b, c = _sort3(fv[..., 0], fv[..., 1], fv[..., 2])
+        hot = (
+            changed_v[fv[..., 0]] & changed_v[fv[..., 1]]
+            & changed_v[fv[..., 2]] & m.tmask[:, None]
+        ).reshape(-1)
+        # compact hot faces into the K stream (scan + scatter, no sort)
+        rank = jnp.cumsum(hot.astype(jnp.int32)) - 1
+        tgt = _common.unique_oob(hot & (rank < K), rank, K)
+        slot = jnp.full(K, -1, jnp.int32).at[tgt].set(
+            jnp.arange(tc * 4, dtype=jnp.int32), mode="drop",
+            unique_indices=True,
+        )
+        valid = slot >= 0
+        src = jnp.maximum(slot, 0)
+        ka = jnp.where(valid, a.reshape(-1)[src], _BIG)
+        if _common.pack_ok(m.pcap, 2):
+            s = jnp.uint32(m.pcap + 1)
+            bc = (
+                b.reshape(-1)[src].astype(jnp.uint32) * s
+                + c.reshape(-1)[src].astype(jnp.uint32)
+            )
+            bc = jnp.where(valid, bc, jnp.arange(K, dtype=jnp.uint32))
+            order = jnp.lexsort((bc, ka)).astype(jnp.int32)
+            sa, sbc = ka[order], bc[order]
+            eq_next = (sa[:-1] == sa[1:]) & (sbc[:-1] == sbc[1:])
+        else:
+            kb = jnp.where(valid, b.reshape(-1)[src],
+                           jnp.arange(K, dtype=jnp.int32))
+            kc = jnp.where(valid, c.reshape(-1)[src],
+                           jnp.arange(K, dtype=jnp.int32))
+            order = jnp.lexsort((kc, kb, ka)).astype(jnp.int32)
+            sa, sb, sc = ka[order], kb[order], kc[order]
+            eq_next = (
+                (sa[:-1] == sa[1:]) & (sb[:-1] == sb[1:])
+                & (sc[:-1] == sc[1:])
+            )
+        eq_next = jnp.concatenate([eq_next, jnp.zeros(1, bool)])
+        eq_prev = jnp.concatenate([jnp.zeros(1, bool), eq_next[:-1]])
+        not_mid = ~(eq_next & eq_prev)
+        pair2 = eq_next & not_mid & jnp.roll(not_mid, -1)
+        gslot = slot[order]                            # global face slots
+        partner = jnp.where(
+            pair2,
+            jnp.roll(gslot, -1),
+            jnp.where(jnp.roll(pair2, 1), jnp.roll(gslot, 1), -1),
+        )
+        # every hot face gets its new pairing (or -1: became boundary);
+        # cold faces keep their rows — their partner cannot have changed
+        adja_flat = m.adja.reshape(-1).at[
+            _common.unique_oob(gslot >= 0, gslot, tc * 4)
+        ].set(partner, mode="drop", unique_indices=True)
+        adja = jnp.where(
+            m.tmask[:, None], adja_flat.reshape(tc, 4), -1
+        )
+        return m.replace(adja=adja)
+
+    n_hot = jnp.sum(
+        (
+            changed_v[mesh.tet[:, FACE_VERTS]].all(axis=-1)
+            & mesh.tmask[:, None]
+        ).astype(jnp.int32)
+    )
+    return jax.lax.cond(n_hot > K, _full, _incr, mesh)
+
+
+# parmmg-lint: disable=PML005 -- table query/update only: the caller keeps using the mesh; the big tables are rebuilt functionally inside a lax.cond (donation would be dropped by the cond anyway)
+@partial(jax.jit, static_argnames=("K",))
+def append_unique_edges(
+    mesh: Mesh,
+    changed_v: jax.Array,
+    edges: jax.Array,
+    emask: jax.Array,
+    t2e: jax.Array,
+    n_unique,
+    K: int,
+):
+    """Incrementally extend a `unique_edges` table after APPEND-ONLY
+    topology changes (tet rewrites/appends that never destroy an edge
+    and never renumber — exactly the 2-3 swap, which rewrites 2 tets,
+    appends 1, creates one apex edge and removes none).
+
+    Tets whose 4 vertices are all in `changed_v` (superset of the
+    modified rows, per the contract above) are gathered into a
+    K-compacted stream; their edges are matched against the existing
+    table, unmatched pairs become fresh slots appended at `n_unique`,
+    and only the hot tets' `t2e` rows are rewritten. Cold rows and all
+    existing edge slots are untouched — recomputing a hot-but-unmodified
+    tet reproduces its old slots by construction. Falls back to the full
+    re-sort when the frontier overflows K or the table overflows its
+    capacity. Returns (edges, emask, t2e, n_unique)."""
+    from ..ops import common as _common
+
+    tc = mesh.tcap
+    ecap = edges.shape[0]
+    hot_t = (
+        changed_v[mesh.tet].all(axis=-1) & mesh.tmask
+    )
+
+    def _full(_):
+        e, em, t2, nu = unique_edges(mesh, ecap)
+        return e, em, t2, jnp.asarray(nu, jnp.int32)
+
+    def _incr(_):
+        rank = jnp.cumsum(hot_t.astype(jnp.int32)) - 1
+        tgt = _common.unique_oob(hot_t & (rank < K), rank, K)
+        tslot = jnp.full(K, -1, jnp.int32).at[tgt].set(
+            jnp.arange(tc, dtype=jnp.int32), mode="drop",
+            unique_indices=True,
+        )
+        valid = tslot >= 0
+        ev = mesh.tet[jnp.maximum(tslot, 0)][:, EDGE_VERTS]  # [K,6,2]
+        lo = jnp.minimum(ev[..., 0], ev[..., 1]).reshape(-1)
+        hi = jnp.maximum(ev[..., 0], ev[..., 1]).reshape(-1)
+        live = jnp.broadcast_to(valid[:, None], (K, 6)).reshape(-1)
+        # slots already in the table (negative rows never match)
+        q = jnp.stack(
+            [jnp.where(live, lo, -1), jnp.where(live, hi, -1)], axis=1
+        )
+        old_keys = jnp.where(emask[:, None], edges, -1)
+        eid = _common.match_rows(old_keys, q, bound=mesh.pcap)
+        fresh = live & (eid < 0)
+        # unique the fresh pairs among themselves; live groups sort
+        # ahead of the shared dead sentinel, so their gids are dense
+        order, newgrp, live_s, slo, shi = _common.sorted_pair_groups(
+            lo, hi, ~fresh, mesh.pcap
+        )
+        gid = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
+        first = newgrp & live_s
+        n_new = jnp.sum(first.astype(jnp.int32))
+        new_slot_sorted = n_unique + gid
+        rep_tgt = _common.unique_oob(
+            first & (new_slot_sorted < ecap), new_slot_sorted, ecap
+        )
+        kw = dict(mode="drop", unique_indices=True)
+        edges_out = edges.at[rep_tgt, 0].set(slo.astype(jnp.int32), **kw)
+        edges_out = edges_out.at[rep_tgt, 1].set(shi.astype(jnp.int32),
+                                                 **kw)
+        emask_out = emask.at[rep_tgt].set(True, **kw)
+        # per-row final edge slot: matched -> old slot, fresh -> its
+        # group's appended slot (scatter sorted gids back to row order)
+        gid_rows = jnp.zeros(K * 6, jnp.int32).at[order].set(
+            gid, unique_indices=True
+        )
+        eid_final = jnp.where(fresh, n_unique + gid_rows, eid)
+        eid_final = jnp.where(
+            live & (eid_final < ecap), eid_final, -1
+        ).astype(jnp.int32)
+        t2e_out = _common.scatter_rows(
+            t2e, _common.unique_oob(valid, tslot, tc),
+            eid_final.reshape(K, 6), unique=True,
+        )
+        # int32 even under x64 (jnp.sum promotes): the frontier conds
+        # demand identical branch dtypes against the stored int32 tables
+        return edges_out, emask_out, t2e_out, (
+            jnp.asarray(n_unique, jnp.int32) + n_new
+        ).astype(jnp.int32)
+
+    n_hot = jnp.sum(hot_t.astype(jnp.int32))
+    # fresh-slot overflow bound: each hot tet appends at most 6 edges
+    fallback = (n_hot > K) | (
+        jnp.asarray(n_unique, jnp.int32) + 6 * n_hot > ecap
+    )
+    return jax.lax.cond(fallback, _full, _incr, 0)

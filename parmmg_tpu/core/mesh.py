@@ -356,14 +356,10 @@ def tet_volumes(mesh: Mesh) -> jax.Array:
     return jnp.einsum("ti,ti->t", jnp.cross(d1, d2), d3) / 6.0
 
 
-@partial(jax.jit, donate_argnums=0)
-def compact(mesh: Mesh) -> Mesh:
-    """Compact valid entities to array prefixes and drop unreferenced vertices.
-
-    Masked-compaction analog of the reference's pack step
-    (`PMMG_packParMesh`, `src/libparmmg1.c:195`): scan-based renumbering in
-    place of Mmg's serial in-place repacking.
-    """
+def _compact_impl(mesh: Mesh, aux):
+    """Shared compaction core; `aux` is an optional [PC] auxiliary
+    vertex array (e.g. the frontier mask) remapped through the same
+    renumbering (dropped vertices fall away, fill = zeros)."""
     # drop vertices not referenced by any valid tet/tria/edge and not REQUIRED
     pc = mesh.pcap
     used = jnp.zeros(pc, bool)
@@ -413,7 +409,10 @@ def compact(mesh: Mesh) -> Mesh:
         mesh.edge, mesh.edmask, (mesh.edref, mesh.edtag), (0, 0)
     )
 
-    return mesh.replace(
+    aux_out = None if aux is None else _common.scatter_rows(
+        jnp.zeros_like(aux), vidx, aux, unique=True
+    )
+    return aux_out, mesh.replace(
         vert=scat_v(mesh.vert, 0.0),
         vref=scat_v(mesh.vref, 0),
         vtag=scat_v(mesh.vtag, 0),
@@ -436,3 +435,24 @@ def compact(mesh: Mesh) -> Mesh:
         edref=edref,
         edtag=edtag,
     )
+
+
+@partial(jax.jit, donate_argnums=0)
+def compact(mesh: Mesh) -> Mesh:
+    """Compact valid entities to array prefixes and drop unreferenced
+    vertices.
+
+    Masked-compaction analog of the reference's pack step
+    (`PMMG_packParMesh`, `src/libparmmg1.c:195`): scan-based renumbering
+    in place of Mmg's serial in-place repacking.
+    """
+    return _compact_impl(mesh, None)[1]
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def compact_aux(mesh: Mesh, aux: jax.Array):
+    """`compact` that also remaps an auxiliary [PC] per-vertex array
+    (the frontier active mask) through the same vertex renumbering.
+    Returns (mesh, aux)."""
+    aux_out, out = _compact_impl(mesh, aux)
+    return out, aux_out

@@ -21,8 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import adjacency, metric as metric_mod, tags
-from ..core.mesh import Mesh, compact
-from ..ops import analysis, collapse, quality, smooth, split, swap
+from ..core.mesh import Mesh, compact, compact_aux
+from ..ops import analysis, collapse, common, quality, smooth, split, swap
 
 
 @dataclasses.dataclass
@@ -85,10 +85,20 @@ class AdaptOptions:
     # device-memory budget in MB for the mesh arrays (per shard in the
     # distributed driver) — the role of the reference's per-node memory
     # budget (`PMMG_parmesh_SetMemGloMax`, `src/zaldy_pmmg.c:53`; -m
-    # flag / IPARAM_mem). None = unlimited. Exceeding it raises
-    # RuntimeError, which the distributed loop degrades to LOWFAILURE
-    # with the last conformal mesh.
+    # flag / IPARAM_mem). None = derive from the device's reported
+    # memory at adapt() entry (the reference auto-derives node RAM ÷
+    # procs, `PMMG_parmesh_SetMemGloMax`); pass float("inf") for
+    # genuinely unbounded. Exceeding it raises RuntimeError, which the
+    # distributed loop degrades to LOWFAILURE with the last conformal
+    # mesh.
     mem_budget_mb: Optional[float] = None
+    # active-set (frontier) sweeps: each sweep records the vertices it
+    # changed and the next sweep's candidate generation, analysis
+    # rebuilds and apply phases address only entities near that
+    # frontier (round 6). False = full-table sweeps (the pre-frontier
+    # behavior, kept as the equivalence baseline; the distributed
+    # drivers always sweep full-table).
+    frontier: bool = True
     verbose: int = 0
 
 
@@ -99,6 +109,43 @@ class SweepStats(NamedTuple):
     nmoved: jax.Array
     n_unique: jax.Array
     split_capped: jax.Array
+    n_active: jax.Array     # active edges offered to this sweep's ops
+
+
+class Frontier(NamedTuple):
+    """Per-sweep active-set state threaded through the sweep engines.
+
+    `changed` is the RAW set of vertices the previous sweep changed
+    (geometry beyond smooth.MOVE_TOL, or 1-ring topology); each op gates
+    on its one-ring closure, computed against the current topology.
+    `dirty` is the staleness LEVEL of the compaction/edge tables:
+    0 = clean (reuse `tables` bit for bit), 1 = append-only topology
+    since the rebuild (2-3 swaps: no renumbering, no edge destroyed —
+    the tables are extended incrementally via
+    `adjacency.append_unique_edges`, no compaction), 2 = renumbering
+    topology (split/collapse/3-2 swap: full compact + re-sort).
+    `tables` is the (edges, emask, t2e, n_unique) tuple of the last
+    rebuild; `adja_ok` marks `mesh.adja` still valid for the CURRENT
+    numbering (lets a converged sweep skip `build_adjacency`)."""
+
+    changed: jax.Array      # [PC] bool
+    dirty: jax.Array        # scalar int32 level (host int unfused)
+    tables: tuple           # (edges [E,2], emask [E], t2e [TC,6], nu)
+    adja_ok: jax.Array      # scalar bool
+
+
+def empty_frontier(mesh: Mesh, ecap: int, full: bool = True) -> Frontier:
+    """Initial frontier: every vertex active (`full`, the exact
+    full-sweep fallback) or none; tables marked stale so the first
+    sweep rebuilds them."""
+    act = jnp.full(mesh.pcap, bool(full), bool)
+    tables = (
+        jnp.zeros((ecap, 2), jnp.int32),
+        jnp.zeros(ecap, bool),
+        jnp.full((mesh.tcap, 6), -1, jnp.int32),
+        jnp.int32(0),
+    )
+    return Frontier(act, jnp.int32(2), tables, jnp.bool_(False))
 
 
 def _sweep_body(
@@ -111,6 +158,7 @@ def _sweep_body(
     hausd: float = 0.01,
     fused: bool = True,
     phase_skip: bool = True,
+    frontier: Optional["Frontier"] = None,
 ):
     """One sweep: split → (collapse → swaps → smooth unless the sweep is
     split-dominant).
@@ -128,23 +176,144 @@ def _sweep_body(
     `MMG5_mmg3d1_delone`'s early passes are insertion-dominant, quality
     effort ramps as `ns` falls (reference `src/libparmmg1.c:739`).
 
+    Frontier mode (round 6): with `frontier=Frontier(...)` the sweep is
+    ACTIVE-SET driven — candidate generation in every operator is gated
+    on the one-ring closure of the previous sweep's changed vertices,
+    the compaction + `unique_edges` rebuilds at the sweep boundaries are
+    reused from `frontier.tables` when no topological op ran since they
+    were computed (exact reuse: recomputing over an unchanged mesh
+    returns the same tables bit for bit), and `build_adjacency` before
+    the 2-3 swap is skipped while `frontier.adja_ok` holds. The sweep
+    returns a third element, the successor Frontier. `frontier=None`
+    (all legacy callers and the distributed/vmapped paths) is the exact
+    pre-frontier full-table sweep.
+
     Called two ways: under the `remesh_sweep`/`remesh_sweeps` jit with
     `fused=True` (ONE fused device program — best runtime, but its XLA
     compile grows super-linearly with the array shapes: >2h on the TPU
-    tunnel at ~850k-tet capacities) — the phase skip is a `lax.cond`; or
-    DIRECTLY with `fused=False` for large meshes, where each constituent
-    op runs as its own jitted program and the skip is a host branch
-    (measured: single ops compile in seconds even at 5M rows — the
-    blowup is whole-program scheduling, not op codegen)."""
-    mesh = compact(mesh)
-    edges, emask, t2e, n_unique = adjacency.unique_edges(mesh, ecap)
+    tunnel at ~850k-tet capacities) — the phase skip and the frontier
+    reuse decisions are `lax.cond`s; or DIRECTLY with `fused=False` for
+    large meshes, where each constituent op runs as its own jitted
+    program and every skip is a host branch (measured: single ops
+    compile in seconds even at 5M rows — the blowup is whole-program
+    scheduling, not op codegen)."""
+    fr = frontier is not None
+
+    def _host_int(x):
+        if isinstance(x, (bool, int)):
+            # guarded by the isinstance above: x is a host scalar here
+            return int(x)  # parmmg-lint: disable=PML002
+        assert not isinstance(x, jax.core.Tracer), (
+            "_sweep_body(fused=False) requires concrete frontier flags; "
+            "under vmap/jit pass fused=True or frontier=None"
+        )
+        # intentional host sync: this IS the unfused host-side branch
+        # (same discipline as the fused=False phase skip below)
+        return int(jax.device_get(x))  # parmmg-lint: disable=PML001,PML002
+
+    def _host_bool(x):
+        return bool(_host_int(x))
+
+    def _closure(m, base):
+        return common.one_ring_closure(m.tet, m.tmask, base)
+
+    if not fr:
+        mesh = compact(mesh)
+        edges, emask, t2e, n_unique = adjacency.unique_edges(mesh, ecap)
+        act = None
+        chg = None
+        adja_ok = None
+    else:
+        act, dirty, tables_in, adja_ok = frontier
+        # append_unique_edges frontier-stream capacity: append-only
+        # sweeps touch a few % of tets; tcap//4 gives the incremental
+        # path a 4x-smaller sort with a fallback that stays exact
+        k_edge = max(64, mesh.tcap // 4)
+
+        def _entry_fresh(m, a):
+            # level 2: renumbering ops ran — compact and re-sort all
+            m, a = compact_aux(m, a)
+            e, em, t2, nu = adjacency.unique_edges(m, ecap)
+            # int32 under x64 too: the reuse branch passes the stored
+            # int32 tables and lax.cond demands identical branch types
+            return m, a, e, em, t2, jnp.asarray(nu, jnp.int32), jnp.bool_(False)
+
+        def _entry_append(m, a):
+            # level 1: append-only ops (2-3 swaps) ran — the mesh is
+            # still prefix-packed and no edge was destroyed, so skip the
+            # compaction and extend the tables incrementally from the
+            # changed set (exact; overflow falls back to the full sort)
+            e, em, t2, nu = tables_in
+            e, em, t2, nu = adjacency.append_unique_edges(
+                m, a, e, em, t2, nu, K=k_edge
+            )
+            return m, a, e, em, t2, nu, jnp.asarray(adja_ok, bool)
+
+        def _entry_reuse(m, a):
+            e, em, t2, nu = tables_in
+            return m, a, e, em, t2, nu, jnp.asarray(adja_ok, bool)
+
+        if fused:
+            def _entry_dirty(m, a):
+                return jax.lax.cond(
+                    dirty >= 2, _entry_fresh, _entry_append, m, a
+                )
+
+            mesh, act, edges, emask, t2e, n_unique, adja_ok = jax.lax.cond(
+                dirty >= 1, _entry_dirty, _entry_reuse, mesh, act
+            )
+        else:
+            lvl = _host_int(dirty)
+            entry = (
+                _entry_fresh if lvl >= 2
+                else _entry_append if lvl >= 1
+                else _entry_reuse
+            )
+            mesh, act, edges, emask, t2e, n_unique, adja_ok = entry(
+                mesh, act
+            )
+        chg = mesh.vmask & False   # varying zeros (shard_map discipline)
+
+    if fr:
+        g0 = _closure(mesh, act)
+        n_active = jnp.sum(
+            (emask & (g0[edges[:, 0]] | g0[edges[:, 1]])).astype(jnp.int32)
+        ).astype(jnp.int32)
+    else:
+        g0 = None
+        n_active = jnp.asarray(n_unique, jnp.int32)
+
     if not noinsert:
         mesh, s_split = split.split_long_edges(
-            mesh, edges, emask, t2e, nosurf=nosurf
+            mesh, edges, emask, t2e, nosurf=nosurf, active=g0
         )
-        mesh = compact(mesh)
-        edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
-        n_unique = jnp.maximum(n_unique, nu)
+        if not fr:
+            mesh = compact(mesh)
+            edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
+            n_unique = jnp.maximum(n_unique, nu)
+        else:
+            chg = chg | s_split.changed_v
+
+            def _ps_fresh(m, aux):
+                m, aux = compact_aux(m, aux)
+                e, em, t2, nu = adjacency.unique_edges(m, ecap)
+                return m, aux, e, em, t2, jnp.asarray(
+                    jnp.maximum(n_unique, nu), jnp.int32
+                )
+
+            def _ps_reuse(m, aux):
+                return m, aux, edges, emask, t2e, n_unique
+
+            aux = jnp.stack([act, chg], axis=1)
+            if fused:
+                mesh, aux, edges, emask, t2e, n_unique = jax.lax.cond(
+                    s_split.nsplit > 0, _ps_fresh, _ps_reuse, mesh, aux
+                )
+            elif _host_bool(s_split.nsplit > 0):
+                mesh, aux, edges, emask, t2e, n_unique = _ps_fresh(mesh, aux)
+            else:
+                mesh, aux, edges, emask, t2e, n_unique = _ps_reuse(mesh, aux)
+            act, chg = aux[:, 0], aux[:, 1]
         # split-dominant growth detection: while refinement is still
         # bisecting globally-long edges wholesale, collapse/swap/smooth
         # (~70% of sweep cost) buy nothing — the next sweep re-splits
@@ -156,49 +325,166 @@ def _sweep_body(
             & ~s_split.capped
         )
     else:
-        s_split = split.SplitStats(jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+        s_split = split.SplitStats(
+            jnp.int32(0), jnp.int32(0), jnp.bool_(False),
+            jnp.zeros(mesh.pcap, bool),
+        )
         growth = jnp.bool_(False)
 
-    def _quality_tail(mesh, edges, emask, t2e, n_unique):
+    def _quality_tail(mesh, edges, emask, t2e, n_unique, chg, adja_ok):
+        av = act
+        g = _closure(mesh, av | chg) if fr else None
         mesh, s_col = collapse.collapse_short_edges(
-            mesh, edges, emask, t2e, hausd=hausd, nosurf=nosurf
+            mesh, edges, emask, t2e, hausd=hausd, nosurf=nosurf, active=g
         )
-        mesh = compact(mesh)
-        edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
-        n_unique = jnp.maximum(n_unique, nu)
+        if not fr:
+            mesh = compact(mesh)
+            edges, emask, t2e, nu = adjacency.unique_edges(mesh, ecap)
+            n_unique = jnp.maximum(n_unique, nu)
+        else:
+            chg = chg | s_col.changed_v
+
+            def _pc_fresh(m, aux):
+                m, aux = compact_aux(m, aux)
+                e, em, t2, nu = adjacency.unique_edges(m, ecap)
+                return m, aux, e, em, t2, jnp.asarray(
+                    jnp.maximum(n_unique, nu), jnp.int32
+                )
+
+            def _pc_reuse(m, aux):
+                return m, aux, edges, emask, t2e, n_unique
+
+            aux = jnp.stack([av, chg], axis=1)
+            if fused:
+                mesh, aux, edges, emask, t2e, n_unique = jax.lax.cond(
+                    s_col.ncollapse > 0, _pc_fresh, _pc_reuse, mesh, aux
+                )
+            elif _host_bool(s_col.ncollapse > 0):
+                mesh, aux, edges, emask, t2e, n_unique = _pc_fresh(mesh, aux)
+            else:
+                mesh, aux, edges, emask, t2e, n_unique = _pc_reuse(mesh, aux)
+            av, chg = aux[:, 0], aux[:, 1]
 
         if not noswap:
-            mesh, s_32 = swap.swap_32(mesh, edges, emask, t2e)
+            g2 = _closure(mesh, av | chg) if fr else None
+            mesh, s_32 = swap.swap_32(mesh, edges, emask, t2e, active=g2)
             # swaps never delete vertices, so compact() keeps vertex ids
             # and the post-collapse edge list stays valid: swap_23 uses
             # it only for a conservative new-edge-exists check, and
             # smoothing below tolerates approximate neighborhoods (its
             # validity loop guards geometry) — two unique_edges re-sorts
             # (~1/3 of sweep sort cost) skipped
-            mesh = adjacency.build_adjacency(compact(mesh))
-            mesh, s_23 = swap.swap_23(mesh, edges, emask)
-            mesh = compact(mesh)
+            if not fr:
+                mesh = adjacency.build_adjacency(compact(mesh))
+                mesh, s_23 = swap.swap_23(mesh, edges, emask)
+                mesh = compact(mesh)
+                adja_ok_out = None
+            else:
+                chg = chg | s_32.changed_v
+                topo = (
+                    (s_split.nsplit > 0) | (s_col.ncollapse > 0)
+                    | (s_32.nswap32 > 0)
+                )
+                need = ~jnp.asarray(adja_ok, bool) | topo
+
+                def _adj_fresh(m, aux):
+                    m, aux = compact_aux(m, aux)
+                    return adjacency.build_adjacency(m), aux
+
+                def _adj_reuse(m, aux):
+                    return m, aux
+
+                aux = jnp.stack([av, chg], axis=1)
+                if fused:
+                    mesh, aux = jax.lax.cond(
+                        need, _adj_fresh, _adj_reuse, mesh, aux
+                    )
+                elif _host_bool(need):
+                    mesh, aux = _adj_fresh(mesh, aux)
+                else:
+                    mesh, aux = _adj_reuse(mesh, aux)
+                av, chg = aux[:, 0], aux[:, 1]
+                g3 = _closure(mesh, av | chg)
+                mesh, s_23 = swap.swap_23(mesh, edges, emask, active=g3)
+                chg = chg | s_23.changed_v
+                # the legacy post-swap23 compact is elided: 2-3 swaps
+                # append into the live prefix and delete no vertex, so
+                # the data is already canonical. Instead of declaring
+                # adja stale, the swapped faces (a K-compacted stream)
+                # are re-matched in place — adja stays warm across the
+                # converged tail, where swap+smooth sweeps dominate
+                k_face = max(64, mesh.tcap // 2)
+
+                def _adj_upd(m):
+                    return adjacency.update_adjacency(
+                        m, s_23.changed_v, K=k_face
+                    )
+
+                if fused:
+                    mesh = jax.lax.cond(
+                        s_23.nswap23 > 0, _adj_upd, lambda m: m, mesh
+                    )
+                elif _host_bool(s_23.nswap23 > 0):
+                    mesh = _adj_upd(mesh)
+                adja_ok_out = jnp.bool_(True)
             nswap = s_32.nswap32 + s_23.nswap23
+            if fr:
+                renum_tail = (
+                    (s_split.nsplit > 0) | (s_col.ncollapse > 0)
+                    | (s_32.nswap32 > 0)
+                )
+                append_tail = s_23.nswap23 > 0
         else:
             # varying zero (not a literal): under shard_map the cond
             # branches must agree on varying-ness too
             nswap = jnp.zeros_like(s_col.ncollapse)
+            adja_ok_out = (
+                jnp.asarray(adja_ok, bool)
+                & (s_split.nsplit == 0) & (s_col.ncollapse == 0)
+                if fr else None
+            )
+            if fr:
+                renum_tail = (s_split.nsplit > 0) | (s_col.ncollapse > 0)
+                append_tail = jnp.bool_(False)
 
         if not nomove:
+            g4 = _closure(mesh, av | chg) if fr else None
             mesh, s_sm = smooth.smooth_vertices(
-                mesh, edges, emask, nosurf=nosurf
+                mesh, edges, emask, nosurf=nosurf, active=g4
             )
             nmoved = s_sm.nmoved
+            if fr:
+                chg = chg | s_sm.changed_v
         else:
             nmoved = jnp.zeros_like(s_col.ncollapse)
         # int32 regardless of jax_enable_x64: the skip branch of the
         # phase cond emits int32 zeros and lax.cond requires identical
         # branch output types
+        dirty_tail = (
+            jnp.where(
+                renum_tail, 2, jnp.where(append_tail, 1, 0)
+            ).astype(jnp.int32)
+            if fr else None
+        )
         return (
             mesh, jnp.asarray(s_col.ncollapse, jnp.int32),
             jnp.asarray(nswap, jnp.int32), jnp.asarray(nmoved, jnp.int32),
-            n_unique,
+            n_unique, edges, emask, t2e, chg, adja_ok_out, dirty_tail,
         )
+
+    # tail-skipped sweeps leave adja untouched: it stays valid only if
+    # it was valid AND the split phase did nothing
+    adja_skip = (
+        jnp.asarray(adja_ok, bool) & (s_split.nsplit == 0) if fr else None
+    )
+    dirty_skip = (
+        jnp.where(s_split.nsplit > 0, 2, 0).astype(jnp.int32)
+        if fr else None
+    )
+
+    def _tail_skip(m, ed, em, te, nu, c, ak):
+        return (m, zero_c, zero_c, zero_c, nu, ed, em, te, c, adja_skip,
+                dirty_skip)
 
     if not phase_skip or noinsert:
         # distributed vmapped sweeps disable the skip on BOTH dispatch
@@ -208,8 +494,9 @@ def _sweep_body(
         # tail unconditionally keeps the fused and unfused distributed
         # paths result-equivalent across the UNFUSED_TCAP threshold.
         # noinsert: growth is statically False (no splits) — no cond
-        mesh, ncollapse, nswap, nmoved, n_unique = _quality_tail(
-            mesh, edges, emask, t2e, n_unique
+        (mesh, ncollapse, nswap, nmoved, n_unique, edges, emask, t2e, chg,
+         adja_ok, dirty_lvl) = _quality_tail(
+            mesh, edges, emask, t2e, n_unique, chg, adja_ok
         )
     elif fused:
         # skip-branch zeros derived from varying data (zeros_like of the
@@ -218,11 +505,12 @@ def _sweep_body(
         # branch outputs vary, and lax.cond rejects the branch-type
         # mismatch
         zero_c = (s_split.nsplit * 0).astype(jnp.int32)
-        mesh, ncollapse, nswap, nmoved, n_unique = jax.lax.cond(
+        (mesh, ncollapse, nswap, nmoved, n_unique, edges, emask, t2e, chg,
+         adja_ok, dirty_lvl) = jax.lax.cond(
             growth,
-            lambda m, ed, em, te, nu: (m, zero_c, zero_c, zero_c, nu),
+            _tail_skip,
             _quality_tail,
-            mesh, edges, emask, t2e, n_unique,
+            mesh, edges, emask, t2e, n_unique, chg, adja_ok,
         )
     else:
         assert not isinstance(growth, jax.core.Tracer), (
@@ -230,24 +518,37 @@ def _sweep_body(
             "concrete growth predicate; under vmap/jit pass "
             "phase_skip=False (tail runs unconditionally) or fused=True"
         )
+        zero_c = (s_split.nsplit * 0).astype(jnp.int32)
         # host-only branch: the assert above guarantees `growth` is
         # concrete here (fused=False runs un-traced), so the sync is
         # intentional — this IS the host-side phase skip
         if bool(jax.device_get(growth)):  # parmmg-lint: disable=PML001,PML002
-            ncollapse = nswap = nmoved = jnp.int32(0)
+            (mesh, ncollapse, nswap, nmoved, n_unique, edges, emask, t2e,
+             chg, adja_ok, dirty_lvl) = _tail_skip(
+                mesh, edges, emask, t2e, n_unique, chg, adja_ok
+            )
         else:
-            mesh, ncollapse, nswap, nmoved, n_unique = _quality_tail(
-                mesh, edges, emask, t2e, n_unique
+            (mesh, ncollapse, nswap, nmoved, n_unique, edges, emask, t2e,
+             chg, adja_ok, dirty_lvl) = _quality_tail(
+                mesh, edges, emask, t2e, n_unique, chg, adja_ok
             )
 
-    return mesh, SweepStats(
+    stats = SweepStats(
         nsplit=s_split.nsplit,
         ncollapse=ncollapse,
         nswap=nswap,
         nmoved=nmoved,
         n_unique=n_unique,
         split_capped=s_split.capped,
+        n_active=n_active,
     )
+    if not fr:
+        return mesh, stats
+    fr_out = Frontier(
+        changed=chg, dirty=dirty_lvl,
+        tables=(edges, emask, t2e, n_unique), adja_ok=adja_ok,
+    )
+    return mesh, stats, fr_out
 
 
 # no donate_argnums: the host-side callers that reach this wrapper
@@ -275,7 +576,7 @@ UNFUSED_TCAP = int(os.environ.get("PARMMG_UNFUSED_TCAP", 600_000))
 # history columns of remesh_sweeps: one int32 row per executed sweep
 HIST_COLS = (
     "nsplit", "ncollapse", "nswap", "nmoved", "ne", "np", "n_unique",
-    "capped",
+    "capped", "n_active",
 )
 
 
@@ -286,6 +587,7 @@ def _hist_row(stats: "SweepStats", ne, npo):
         stats.nsplit, stats.ncollapse, stats.nswap, stats.nmoved,
         jnp.asarray(ne, jnp.int32), jnp.asarray(npo, jnp.int32),
         stats.n_unique, stats.split_capped.astype(jnp.int32),
+        stats.n_active,
     ]).astype(jnp.int32)  # counters can arrive int64 under x64
 
 
@@ -293,7 +595,7 @@ def _hist_row(stats: "SweepStats", ne, npo):
     jax.jit,
     static_argnames=(
         "ecap", "max_sweeps", "noinsert", "noswap", "nomove", "nosurf",
-        "grow_trigger", "converge_frac",
+        "grow_trigger", "converge_frac", "frontier",
     ),
     donate_argnums=0,
 )
@@ -309,6 +611,7 @@ def remesh_sweeps(
     hausd: float = 0.01,
     converge_frac: float = 0.005,
     grow_trigger: float = 0.85,
+    frontier: bool = False,
 ):
     """Run up to `max_sweeps` fused sweeps in ONE device program.
 
@@ -326,16 +629,31 @@ def remesh_sweeps(
     options value so the compile cache is keyed only on mesh shapes);
     `n_left` is the DYNAMIC remaining sweep budget of this call.
 
+    With `frontier=True` (STATIC) the active-set state rides the
+    while_loop carry: sweep k+1's candidate generation, table rebuilds
+    and adjacency address only the one-ring closure of what sweep k
+    changed. The initial frontier is full/stale, so the first sweep of
+    each call is exactly the full-table sweep — re-entries after a
+    capacity event restart from a full frontier (capacities changed
+    shape anyway).
+
     Returns (mesh, hist [max_sweeps, len(HIST_COLS)] int32, n_done).
     """
 
     def body(state):
-        m, hist, k, _ = state
-        m, st = remesh_sweep(
-            m, ecap,
-            noinsert=noinsert, noswap=noswap, nomove=nomove, nosurf=nosurf,
-            hausd=hausd,
-        )
+        m, fr, hist, k, _ = state
+        if frontier:
+            m, st, fr = remesh_sweep(
+                m, ecap,
+                noinsert=noinsert, noswap=noswap, nomove=nomove,
+                nosurf=nosurf, hausd=hausd, frontier=fr,
+            )
+        else:
+            m, st = remesh_sweep(
+                m, ecap,
+                noinsert=noinsert, noswap=noswap, nomove=nomove,
+                nosurf=nosurf, hausd=hausd,
+            )
         ne = m.ntet
         npo = m.npoin
         nops = st.nsplit + st.ncollapse + st.nswap
@@ -354,15 +672,16 @@ def remesh_sweeps(
         stop = converged | st.split_capped | overflow | near_cap
         row = _hist_row(st, ne, npo)
         hist = hist.at[k].set(row)
-        return m, hist, k + 1, stop
+        return m, fr, hist, k + 1, stop
 
     def cond(state):
-        _, _, k, stop = state
+        _, _, _, k, stop = state
         return (k < jnp.minimum(max_sweeps, n_left)) & ~stop
 
     hist0 = jnp.zeros((max_sweeps, len(HIST_COLS)), jnp.int32)
-    mesh, hist, n_done, _ = jax.lax.while_loop(
-        cond, body, (mesh, hist0, jnp.int32(0), jnp.bool_(False))
+    fr0 = empty_frontier(mesh, ecap) if frontier else None
+    mesh, _, hist, n_done, _ = jax.lax.while_loop(
+        cond, body, (mesh, fr0, hist0, jnp.int32(0), jnp.bool_(False))
     )
     return mesh, hist, n_done
 
@@ -523,6 +842,35 @@ def estimate_mesh_bytes(
     return pc * per_v + tc * per_t + fc * per_f + ec * per_e
 
 
+def default_mem_budget_mb() -> Optional[float]:
+    """Device-memory budget when `AdaptOptions.mem_budget_mb` is unset —
+    the role of the reference's automatic per-process budget (node RAM
+    divided by procs, `PMMG_parmesh_SetMemGloMax`, `src/zaldy_pmmg.c:53`
+    when -m is absent): 90% of the device's reported `bytes_limit`
+    (accelerator backends), else 90% of the host's MemAvailable (CPU
+    backend, whose allocator draws from host RAM). None when neither is
+    detectable (budget stays unbounded)."""
+    dev = jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        limit = stats.get("bytes_limit") or stats.get(
+            "bytes_reservable_limit"
+        )
+        if limit:
+            return 0.9 * float(limit) / 1e6
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return 0.9 * float(line.split()[1]) / 1e3  # kB -> MB
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
 def _check_budget(mesh: Mesh, opts: AdaptOptions, pc, tc, fc, ec):
     if opts.mem_budget_mb is None:
         return
@@ -635,17 +983,36 @@ def run_batched_sweep_loop(
     dispatch + stats readback PER SWEEP with one per capacity event."""
     budget = opts.max_sweeps
     done = 0
+    fr = None
     while done < budget:
         mesh = ensure_capacity(mesh, opts)
         ecap = int(mesh.tcap * emult[0]) + 64
         if mesh.tcap > UNFUSED_TCAP:
             # large mesh: one sweep per call, each op its own program
             # (fused whole-program compile takes hours at these shapes)
-            mesh, stats = _sweep_body(
-                mesh, ecap, noinsert=opts.noinsert, noswap=opts.noswap,
-                nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
-                fused=False,
-            )
+            if opts.frontier:
+                # the frontier survives between unfused sweeps; a
+                # capacity/edge-cap event changes the table shapes, so
+                # restart from the full (exact fallback) frontier
+                if (
+                    fr is None
+                    or fr.changed.shape[0] != mesh.pcap
+                    or fr.tables[0].shape[0] != ecap
+                    or fr.tables[2].shape[0] != mesh.tcap
+                ):
+                    fr = empty_frontier(mesh, ecap)
+                mesh, stats, fr = _sweep_body(
+                    mesh, ecap, noinsert=opts.noinsert,
+                    noswap=opts.noswap, nomove=opts.nomove,
+                    nosurf=opts.nosurf, hausd=hausd, fused=False,
+                    frontier=fr,
+                )
+            else:
+                mesh, stats = _sweep_body(
+                    mesh, ecap, noinsert=opts.noinsert, noswap=opts.noswap,
+                    nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
+                    fused=False,
+                )
             hist = _hist_row(stats, mesh.ntet, mesh.npoin)[None, :]
             n = 1
         else:
@@ -655,6 +1022,7 @@ def run_batched_sweep_loop(
                 nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
                 converge_frac=opts.converge_frac,
                 grow_trigger=opts.grow_trigger,
+                frontier=opts.frontier,
             )
             n = int(n_done)
             if n == 0:
@@ -668,10 +1036,12 @@ def run_batched_sweep_loop(
             rec.update(iter=it, sweep=done + i)
             history.append(rec)
             if opts.verbose >= 2:
+                act = rec["n_active"] / max(rec["n_unique"], 1)
                 print(
                     f"  it {it} sweep {rec['sweep']}: +{rec['nsplit']} "
                     f"split -{rec['ncollapse']} collapse {rec['nswap']} "
-                    f"swap {rec['nmoved']} moved -> ne={rec['ne']}",
+                    f"swap {rec['nmoved']} moved -> ne={rec['ne']} "
+                    f"(active {act:.0%})",
                     flush=True,
                 )
         last = history[-1]
@@ -769,6 +1139,14 @@ def adapt(
     attachment point for `lint.contracts.RetraceCounter` per-phase
     compile accounting and for external progress monitors."""
     opts = opts or AdaptOptions()
+    if opts.mem_budget_mb is None:
+        # VERDICT coverage row 3: an unset budget derives from the
+        # device's reported memory instead of running unbounded (pass
+        # float("inf") to opt out); the options object is copied, not
+        # mutated
+        derived = default_mem_budget_mb()
+        if derived is not None:
+            opts = dataclasses.replace(opts, mem_budget_mb=derived)
     # unique-edge capacity multiplier: ~1.19 edges/tet asymptotically, but
     # pathological meshes can exceed 1.6x — grown on overflow
     emult = [1.6]
@@ -849,5 +1227,6 @@ def adapt(
         mesh = interp.interp_fields_only(mesh, old_snapshot)
     h1 = quality.quality_histogram(mesh)
     info = dict(history=history, qual_in=h0, qual_out=h1,
-                presize_skipped=presize_skipped)
+                presize_skipped=presize_skipped,
+                mem_budget_mb=opts.mem_budget_mb)
     return mesh, info

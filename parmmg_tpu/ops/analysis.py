@@ -243,13 +243,22 @@ def tria_normals(mesh: Mesh):
 
 # parmmg-lint: disable=PML005 -- pure query (normals); split/smooth reuse the mesh in the same sweep
 @jax.jit
-def vertex_normals(mesh: Mesh) -> jax.Array:
+def vertex_normals(mesh: Mesh, need: jax.Array | None = None) -> jax.Array:
     """[PC,3] area-weighted unit vertex normals over surface trias
     (zero where the vertex touches no surface tria). Across a ridge the
     blend is geometrically meaningless — ridge vertices are handled by
-    tangent-line logic in the smoothing kernel, not by this normal."""
+    tangent-line logic in the smoothing kernel, not by this normal.
+
+    `need` (frontier mode, round 6): [PC] bool mask of the vertices
+    whose normals the caller will actually read. Only trias touching a
+    needed vertex contribute — every tria of a needed vertex contains
+    that vertex, so needed rows come out EXACT while cold rows (whose
+    scatter traffic the active-set sweep is shedding) may be zero.
+    `need=None` computes every row (legacy full pass)."""
     unit, area, ok = tria_normals(mesh)
     pcap = mesh.pcap
+    if need is not None:
+        ok = ok & jnp.any(need[mesh.tria], axis=1)
     w = jnp.where(ok, area, 0.0)
     contrib = unit * w[:, None]
     acc = jnp.zeros((pcap, 3), mesh.vert.dtype)

@@ -54,6 +54,7 @@ class CollapseStats(NamedTuple):
     nrej_topo: jax.Array   # rejected by duplicate-tet (link) check
     nrej_surf: jax.Array   # rejected by surface fidelity (fold/hausd)
     nsurf: jax.Array       # accepted collapses that moved the surface
+    changed_v: jax.Array   # [PC] bool — vertices whose 1-ring changed
 
 
 @partial(jax.jit, static_argnames=("lshrt", "nosurf"), donate_argnums=0)
@@ -65,8 +66,16 @@ def collapse_short_edges(
     lshrt: float = float(metric_mod.LSHRT),
     hausd: float = 0.01,
     nosurf: bool = False,
+    active: jax.Array | None = None,
 ):
-    """One collapse sweep. Mesh must be compacted; adjacency left stale."""
+    """One collapse sweep. Mesh must be compacted; adjacency left stale.
+
+    With an `active` vertex mask (the one-ring closure of the previous
+    sweep's changes — frontier mode, round 6), candidates are restricted
+    to short edges near the frontier and the whole heavy phase (edge
+    classes, selection loop, validity evaluation, apply) is skipped via
+    `lax.cond` when no short active edge exists. `active=None`
+    reproduces the full-table sweep exactly."""
     ecap = edges.shape[0]
     tcap, pcap, fcap = mesh.tcap, mesh.pcap, mesh.fcap
     tet, tmask = mesh.tet, mesh.tmask
@@ -75,6 +84,11 @@ def collapse_short_edges(
     l = metric_mod.edge_length(
         mesh.vert[a], mesh.vert[b], mesh.met[a], mesh.met[b]
     )
+    pre = emask & (l < lshrt)
+    if active is not None:
+        # frontier gate: an inactive short edge was offered to the MIS
+        # last sweep with an identical ball and did not act
+        pre = pre & (active[a] | active[b])
 
     # --- vertex classes ---------------------------------------------------
     vt = mesh.vtag
@@ -92,28 +106,24 @@ def collapse_short_edges(
     if nosurf:
         score = jnp.where(free_i, 3, 0)
 
-    # --- edge classes -----------------------------------------------------
-    smask = surf_tria_mask(mesh)
-    tri_keys = common.tria_edge_keys(mesh, smask)
-    surf_e = common.sorted_membership(
-        tri_keys, jnp.where(emask[:, None], edges, -1), bound=mesh.pcap
-    )
-    feat = common.feature_edge_index(mesh, edges, emask)
-    feat_tag = jnp.where(feat >= 0, mesh.edtag[jnp.maximum(feat, 0)], 0)
-    feat_e = (feat_tag & _FEAT_BITS) != 0
+    # --- edge classes (inside the frontier skip: the surf/feat
+    # memberships are sort-merge passes) -----------------------------------
+    def _edge_classes(mesh):
+        smask = surf_tria_mask(mesh)
+        tri_keys = common.tria_edge_keys(mesh, smask)
+        surf_e = common.sorted_membership(
+            tri_keys, jnp.where(emask[:, None], edges, -1), bound=mesh.pcap
+        )
+        feat = common.feature_edge_index(mesh, edges, emask)
+        feat_tag = jnp.where(feat >= 0, mesh.edtag[jnp.maximum(feat, 0)], 0)
+        feat_e = (feat_tag & _FEAT_BITS) != 0
+        return surf_e, feat_e
 
     sa, sb = score[a], score[b]
     src_is_a = sa >= sb
     src = jnp.where(src_is_a, a, b)
     dst = jnp.where(src_is_a, b, a)
     s_src = jnp.maximum(sa, sb)
-    legal = (
-        (s_src == 3)
-        | ((s_src == 2) & surf_e)
-        | ((s_src == 1) & feat_e)
-    )
-    cand = emask & (l < lshrt) & legal
-    ncand = jnp.sum(cand.astype(jnp.int32))
 
     # --- arena selection: tets containing src or dst ----------------------
     def scatter_arena(vals):
@@ -131,35 +141,285 @@ def collapse_short_edges(
         )
         return jnp.maximum(ub[src], ub[dst])
 
-    # win-independent quantities, hoisted out of the evaluation
-    q_old = common.quality_of(mesh.vert, mesh.met, tet)
-    vol_old = common.vol_of(mesh.vert, tet)
-    # scale-relative positivity (common.POS_VOL_FRAC of the tet's own
-    # old volume)
-    vol_floor = common.POS_VOL_FRAC * jnp.abs(vol_old)
+    def _heavy(mesh):
+        surf_e, feat_e = _edge_classes(mesh)
+        legal = (
+            (s_src == 3)
+            | ((s_src == 2) & surf_e)
+            | ((s_src == 1) & feat_e)
+        )
+        cand = pre & legal
+        ncand = jnp.sum(cand.astype(jnp.int32)).astype(jnp.int32)
 
-    def raw_normal(tri):
-        p0, p1, p2 = mesh.vert[tri[:, 0]], mesh.vert[tri[:, 1]], mesh.vert[tri[:, 2]]
-        return jnp.cross(p1 - p0, p2 - p0)
+        # win-independent quantities, hoisted out of the evaluation
+        q_old = common.quality_of(mesh.vert, mesh.met, tet)
+        vol_old = common.vol_of(mesh.vert, tet)
+        # scale-relative positivity (common.POS_VOL_FRAC of the tet's own
+        # old volume)
+        vol_floor = common.POS_VOL_FRAC * jnp.abs(vol_old)
 
-    r_old = raw_normal(mesh.tria)
-    n_old = jnp.linalg.norm(r_old, axis=1)
-    req_tria = (mesh.trtag & tags.REQUIRED) != 0
-    eidx = jnp.arange(ecap, dtype=jnp.int32)
+        def raw_normal(tri):
+            p0, p1, p2 = mesh.vert[tri[:, 0]], mesh.vert[tri[:, 1]], mesh.vert[tri[:, 2]]
+            return jnp.cross(p1 - p0, p2 - p0)
 
-    def eval_winners(win):
-        """Validity of a winner set with pairwise-disjoint arenas.
+        r_old = raw_normal(mesh.tria)
+        n_old = jnp.linalg.norm(r_old, axis=1)
+        req_tria = (mesh.trtag & tags.REQUIRED) != 0
+        eidx = jnp.arange(ecap, dtype=jnp.int32)
 
-        Returns (accept, rej_geom, rej_surf, rej_topo [bool sets], aux
-        intermediates for the apply step)."""
-        # per-vertex winner map (each vertex touched by <= 1 winner)
+        def eval_winners(win):
+            """Validity of a winner set with pairwise-disjoint arenas.
+
+            Returns (accept, rej_geom, rej_surf, rej_topo [bool sets], aux
+            intermediates for the apply step)."""
+            # per-vertex winner map (each vertex touched by <= 1 winner)
+            wv = jnp.full(pcap, -1, jnp.int32)
+            wv = wv.at[jnp.where(win, src, pcap)].max(eidx, mode="drop")
+            wv = wv.at[jnp.where(win, dst, pcap)].max(eidx, mode="drop")
+
+            # per-tet winner and role
+            wt4 = wv[tet]                                   # [TC,4]
+            e_t = jnp.max(wt4, axis=1)                      # winner edge or -1
+            has = (e_t >= 0) & tmask
+            e_ts = jnp.maximum(e_t, 0)
+            src_t, dst_t = src[e_ts], dst[e_ts]
+            has_src = jnp.any(tet == src_t[:, None], axis=1) & has
+            has_dst = jnp.any(tet == dst_t[:, None], axis=1) & has
+            is_shell = has_src & has_dst
+            is_ball = has_src & ~is_shell
+
+            new_tet = jnp.where(
+                (tet == src_t[:, None]) & is_ball[:, None], dst_t[:, None], tet
+            )
+            q_new = common.quality_of(mesh.vert, mesh.met, new_tet)
+            vol_new = common.vol_of(mesh.vert, new_tet)
+
+            # --- geometric validity per winner --------------------------------
+            inf = jnp.inf
+            ball_old = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
+                q_old, mode="drop"
+            )
+            ball_new = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
+                jnp.where(vol_new > vol_floor, q_new, -inf), mode="drop"
+            )
+            # accept if the new ball keeps ~a third of the old worst quality
+            # (the class of criterion Mmg's colver uses) or is absolutely
+            # decent, with a hard floor against degenerate configurations
+            ok_geom = (ball_new >= 0.3 * ball_old) | (ball_new >= 0.3)
+            ok_geom = ok_geom & (ball_new > 0.02) & jnp.isfinite(ball_new)
+            rej_geom = win & ~ok_geom
+            accept = win & ok_geom
+
+            # --- surface fidelity for boundary collapses (chkcol_bdy role) ----
+            # per-tria winner/role mirrors the tet logic
+            wf3 = wv[mesh.tria]                              # [FC,3]
+            e_f = jnp.max(wf3, axis=1)
+            fhas = (e_f >= 0) & mesh.trmask
+            e_fs = jnp.maximum(e_f, 0)
+            src_f, dst_f = src[e_fs], dst[e_fs]
+            f_has_src = jnp.any(mesh.tria == src_f[:, None], axis=1) & fhas
+            f_has_dst = jnp.any(mesh.tria == dst_f[:, None], axis=1) & fhas
+            f_shell = f_has_src & f_has_dst                  # deleted trias
+            f_ball = f_has_src & ~f_shell                    # retargeted trias
+            new_tria = jnp.where(
+                (mesh.tria == src_f[:, None]) & f_ball[:, None],
+                dst_f[:, None], mesh.tria,
+            )
+
+            r_new = raw_normal(new_tria)
+            n_new = jnp.linalg.norm(r_new, axis=1)
+            dotn = jnp.einsum("fi,fi->f", r_old, r_new) / jnp.maximum(
+                n_old * n_new, 1e-30
+            )
+            # Hausdorff: removed vertex must stay within hausd of the plane
+            # of every retargeted tria (point-to-plane, the batched stand-in
+            # for Mmg's point-to-surface distance)
+            unit_new = r_new / jnp.maximum(n_new, 1e-30)[:, None]
+            dist = jnp.abs(
+                jnp.einsum(
+                    "fi,fi->f", unit_new,
+                    mesh.vert[src_f] - mesh.vert[new_tria[:, 0]],
+                )
+            )
+            degen = n_new < 1e-12 * jnp.maximum(n_old, 1e-30)
+            # hausd may be a per-tria-reference table (parsop local
+            # parameters): look up by the retargeted tria's reference
+            hausd_f = (
+                hausd[jnp.clip(mesh.trref, 0, hausd.shape[0] - 1)]
+                if getattr(hausd, "ndim", 0)
+                else hausd
+            )
+            tria_bad = f_ball & ((dotn < _COS_SURF) | (dist > hausd_f) | degen)
+            # REQUIRED trias are immutable: any touched required tria kills it
+            bad_surf = jnp.zeros(ecap, bool)
+            bad_surf = bad_surf.at[
+                jnp.where(tria_bad | (fhas & req_tria), e_f, ecap)
+            ].max(True, mode="drop")
+            rej_surf = accept & bad_surf
+            accept = accept & ~bad_surf
+
+            # --- topological check: tentative apply + duplicate detection -----
+            app_t = is_ball & accept[e_ts]
+            del_t = is_shell & accept[e_ts]
+            tet_tent = jnp.where(app_t[:, None], new_tet, tet)
+            valid_tent = tmask & ~del_t
+            dup = common.duplicate_tets(tet_tent, valid_tent, bound=mesh.pcap)
+            bad_e = jnp.zeros(ecap, bool).at[
+                jnp.where(dup & has, e_t, ecap)
+            ].max(True, mode="drop")
+            rej_topo = accept & bad_e
+            accept = accept & ~bad_e
+            aux = (e_ts, is_ball, is_shell, new_tet, e_fs, f_ball, f_shell,
+                   new_tria, wv)
+            return accept, rej_geom, rej_surf, rej_topo, aux
+
+        # Select → evaluate → commit, iterated. One round of the
+        # 2-vertex-ball arena MIS is far too sparse for bulk coarsening (a
+        # candidate must be the strict minimum of its whole 2-hop
+        # neighborhood), so committed winners keep occupying their arenas
+        # while further rounds pick among the remaining candidates.
+        #
+        # Each selection round is ONE arena max-propagation. Candidates
+        # carry a per-sweep UNIQUE f32-exact integer rank (shorter edge =
+        # higher rank, exact ties broken by a hashed index so uniform
+        # meshes don't serialize on spatially-sorted edge ids), and
+        # committed winners participate with +inf: a candidate whose arena
+        # overlaps a committed winner sees +inf and can never win, which
+        # implements arena claiming with no extra scatter/gather rounds
+        # (the previous scheme spent 2 propagation rounds on the two-phase
+        # priority+hash compare and a 3rd on explicit tet claiming — 3x the
+        # HBM traffic for the same winner sets). Rejected winners are
+        # excluded from the +inf set, so their arenas are released and stop
+        # starving their neighborhoods (the serial kernel simply moves to
+        # the next edge; this is the batched equivalent). Disjoint arenas
+        # keep simultaneous application safe: each tet and each vertex
+        # joins at most one winner.
+        if ecap < (1 << 24):
+            h24 = (
+                jnp.arange(ecap, dtype=jnp.uint32) * jnp.uint32(2654435761)
+            ) & jnp.uint32(0xFFFFFF)
+            order = jnp.lexsort((h24, jnp.where(cand, l, jnp.inf)))
+            rnk = (
+                jnp.zeros(ecap, jnp.float32)
+                .at[order]
+                .set(jnp.arange(ecap, 0, -1, dtype=jnp.float32))
+            )
+
+            def select_round(w_acc, rej, sup):
+                """One round: winners + newly-suppressed candidates.
+
+                A candidate that sees +inf is permanently blocked by a
+                committed winner; it must LEAVE the candidate pool (not
+                merely lose), else its own rank keeps suppressing its
+                neighborhood forever — candidates two hops from a winner
+                would starve."""
+                active = cand & ~w_acc & ~rej & ~sup
+                pv = jnp.where(active, rnk, -jnp.inf)
+                pv = jnp.where(w_acc, jnp.inf, pv)
+                best = gather_arena(scatter_arena(pv))
+                return active & (rnk >= best), active & jnp.isinf(best)
+        else:
+            # ranks stop being f32-exact beyond 2^24 edges: fall back to
+            # the two-phase compare (priority then hashed index)
+            def select_round(w_acc, rej, sup):
+                active = cand & ~w_acc & ~rej & ~sup
+                blocked = gather_arena(
+                    scatter_arena(jnp.where(w_acc, 1.0, -jnp.inf))
+                ) > 0.0
+                w = common.two_phase_winners(
+                    -l, active & ~blocked, scatter_arena, gather_arena
+                )
+                return w, active & blocked
+
+        # initial carries derived from mesh data (not fresh constants) so
+        # they inherit the device-varying type under shard_map — a literal
+        # jnp.zeros carry is 'unvarying' and the loop body would change its
+        # type on the first iteration
+        zero_e = cand & False
+
+        if common._split_scatter_cols():
+            # TPU: each propagation round is fixed scatter/gather cost
+            # whether or not it finds work, so the selection loops exit as
+            # soon as a round adds no winners (the common case once the mesh
+            # converges) and the validity evaluation is skipped when the
+            # trial set did not change. On CPU the nested
+            # while_loop/cond control flow costs more than it saves
+            # (latency-bound small meshes measured -23%), so that backend
+            # keeps the fixed fori_loop below.
+            def sel_cond(carry):
+                _, _, _, k, got = carry
+                return (k < 5) & got
+
+            def sel_body(carry):
+                w_acc, rej, sup, k, _ = carry
+                w, sup_add = select_round(w_acc, rej, sup)
+                return (w_acc | w, rej, sup | sup_add, k + 1, jnp.any(w))
+
+            def outer_cond(carry):
+                _, _, _, _, k, got = carry
+                return (k < 3) & got
+
+            def outer_body(carry):
+                win_acc, rej_g, rej_s, rej_t, k, _ = carry
+                rej = rej_g | rej_s | rej_t
+                # suppression resets each outer round: eval may reject
+                # winners, releasing arenas the suppressed candidates need
+                trial, _, _, _, _ = jax.lax.while_loop(
+                    sel_cond, sel_body,
+                    (win_acc, rej, zero_e, jnp.int32(0), jnp.any(cand)),
+                )
+                new_any = jnp.any(trial & ~win_acc)
+
+                def do_eval(_):
+                    acc, rg, rs, rt, _aux = eval_winners(trial)
+                    return acc, rej_g | rg, rej_s | rs, rej_t | rt
+
+                def skip_eval(_):
+                    # selection added nothing: the carried set was already
+                    # validated in the previous round
+                    return win_acc, rej_g, rej_s, rej_t
+
+                acc, rg_o, rs_o, rt_o = jax.lax.cond(
+                    new_any, do_eval, skip_eval, None
+                )
+                return acc, rg_o, rs_o, rt_o, k + 1, new_any
+
+            win_acc, rej_g, rej_s, rej_t, _, _ = jax.lax.while_loop(
+                outer_cond, outer_body,
+                (zero_e, zero_e, zero_e, zero_e, jnp.int32(0),
+                 jnp.any(cand)),
+            )
+        else:
+            def sel_body_f(_, carry):
+                w_acc, rej, sup = carry
+                w, sup_add = select_round(w_acc, rej, sup)
+                return w_acc | w, rej, sup | sup_add
+
+            def outer_body_f(_, carry):
+                win_acc, rej_g, rej_s, rej_t = carry
+                rej = rej_g | rej_s | rej_t
+                trial, _, _ = jax.lax.fori_loop(
+                    0, 5, sel_body_f, (win_acc, rej, zero_e)
+                )
+                acc, rg, rs, rt, _aux = eval_winners(trial)
+                return acc, rej_g | rg, rej_s | rs, rej_t | rt
+
+            win_acc, rej_g, rej_s, rej_t = jax.lax.fori_loop(
+                0, 3, outer_body_f,
+                (zero_e, zero_e, zero_e, zero_e),
+            )
+        # Cheap final pass: winners were fully validated inside the loop;
+        # re-derive only the apply intermediates (scatter/compare, no
+        # quality/surface re-evaluation) plus one duplicate guard on exactly
+        # the applied configuration — removing rejected winners restores
+        # their shell tets, which could in principle re-collide with a
+        # survivor's retarget.
+        win = win_acc
         wv = jnp.full(pcap, -1, jnp.int32)
         wv = wv.at[jnp.where(win, src, pcap)].max(eidx, mode="drop")
         wv = wv.at[jnp.where(win, dst, pcap)].max(eidx, mode="drop")
-
-        # per-tet winner and role
-        wt4 = wv[tet]                                   # [TC,4]
-        e_t = jnp.max(wt4, axis=1)                      # winner edge or -1
+        wt4 = wv[tet]
+        e_t = jnp.max(wt4, axis=1)
         has = (e_t >= 0) & tmask
         e_ts = jnp.maximum(e_t, 0)
         src_t, dst_t = src[e_ts], dst[e_ts]
@@ -167,316 +427,100 @@ def collapse_short_edges(
         has_dst = jnp.any(tet == dst_t[:, None], axis=1) & has
         is_shell = has_src & has_dst
         is_ball = has_src & ~is_shell
-
         new_tet = jnp.where(
             (tet == src_t[:, None]) & is_ball[:, None], dst_t[:, None], tet
         )
-        q_new = common.quality_of(mesh.vert, mesh.met, new_tet)
-        vol_new = common.vol_of(mesh.vert, new_tet)
-
-        # --- geometric validity per winner --------------------------------
-        inf = jnp.inf
-        ball_old = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
-            q_old, mode="drop"
-        )
-        ball_new = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
-            jnp.where(vol_new > vol_floor, q_new, -inf), mode="drop"
-        )
-        # accept if the new ball keeps ~a third of the old worst quality
-        # (the class of criterion Mmg's colver uses) or is absolutely
-        # decent, with a hard floor against degenerate configurations
-        ok_geom = (ball_new >= 0.3 * ball_old) | (ball_new >= 0.3)
-        ok_geom = ok_geom & (ball_new > 0.02) & jnp.isfinite(ball_new)
-        rej_geom = win & ~ok_geom
-        accept = win & ok_geom
-
-        # --- surface fidelity for boundary collapses (chkcol_bdy role) ----
-        # per-tria winner/role mirrors the tet logic
-        wf3 = wv[mesh.tria]                              # [FC,3]
+        wf3 = wv[mesh.tria]
         e_f = jnp.max(wf3, axis=1)
         fhas = (e_f >= 0) & mesh.trmask
         e_fs = jnp.maximum(e_f, 0)
         src_f, dst_f = src[e_fs], dst[e_fs]
         f_has_src = jnp.any(mesh.tria == src_f[:, None], axis=1) & fhas
         f_has_dst = jnp.any(mesh.tria == dst_f[:, None], axis=1) & fhas
-        f_shell = f_has_src & f_has_dst                  # deleted trias
-        f_ball = f_has_src & ~f_shell                    # retargeted trias
+        f_shell = f_has_src & f_has_dst
+        f_ball = f_has_src & ~f_shell
         new_tria = jnp.where(
             (mesh.tria == src_f[:, None]) & f_ball[:, None],
             dst_f[:, None], mesh.tria,
         )
-
-        r_new = raw_normal(new_tria)
-        n_new = jnp.linalg.norm(r_new, axis=1)
-        dotn = jnp.einsum("fi,fi->f", r_old, r_new) / jnp.maximum(
-            n_old * n_new, 1e-30
+        dup = common.duplicate_tets(
+            jnp.where((is_ball & win[e_ts])[:, None], new_tet, tet),
+            tmask & ~(is_shell & win[e_ts]),
+            bound=mesh.pcap,
         )
-        # Hausdorff: removed vertex must stay within hausd of the plane
-        # of every retargeted tria (point-to-plane, the batched stand-in
-        # for Mmg's point-to-surface distance)
-        unit_new = r_new / jnp.maximum(n_new, 1e-30)[:, None]
-        dist = jnp.abs(
-            jnp.einsum(
-                "fi,fi->f", unit_new,
-                mesh.vert[src_f] - mesh.vert[new_tria[:, 0]],
-            )
-        )
-        degen = n_new < 1e-12 * jnp.maximum(n_old, 1e-30)
-        # hausd may be a per-tria-reference table (parsop local
-        # parameters): look up by the retargeted tria's reference
-        hausd_f = (
-            hausd[jnp.clip(mesh.trref, 0, hausd.shape[0] - 1)]
-            if getattr(hausd, "ndim", 0)
-            else hausd
-        )
-        tria_bad = f_ball & ((dotn < _COS_SURF) | (dist > hausd_f) | degen)
-        # REQUIRED trias are immutable: any touched required tria kills it
-        bad_surf = jnp.zeros(ecap, bool)
-        bad_surf = bad_surf.at[
-            jnp.where(tria_bad | (fhas & req_tria), e_f, ecap)
-        ].max(True, mode="drop")
-        rej_surf = accept & bad_surf
-        accept = accept & ~bad_surf
-
-        # --- topological check: tentative apply + duplicate detection -----
-        app_t = is_ball & accept[e_ts]
-        del_t = is_shell & accept[e_ts]
-        tet_tent = jnp.where(app_t[:, None], new_tet, tet)
-        valid_tent = tmask & ~del_t
-        dup = common.duplicate_tets(tet_tent, valid_tent, bound=mesh.pcap)
         bad_e = jnp.zeros(ecap, bool).at[
             jnp.where(dup & has, e_t, ecap)
         ].max(True, mode="drop")
-        rej_topo = accept & bad_e
-        accept = accept & ~bad_e
-        aux = (e_ts, is_ball, is_shell, new_tet, e_fs, f_ball, f_shell,
-               new_tria, wv)
-        return accept, rej_geom, rej_surf, rej_topo, aux
+        accept = win & ~bad_e
+        nrej_geom = jnp.sum(rej_g.astype(jnp.int32)).astype(jnp.int32)
+        nrej_surf = jnp.sum(rej_s.astype(jnp.int32)).astype(jnp.int32)
+        nrej_topo = jnp.sum((rej_t | bad_e).astype(jnp.int32)).astype(jnp.int32)
 
-    # Select → evaluate → commit, iterated. One round of the
-    # 2-vertex-ball arena MIS is far too sparse for bulk coarsening (a
-    # candidate must be the strict minimum of its whole 2-hop
-    # neighborhood), so committed winners keep occupying their arenas
-    # while further rounds pick among the remaining candidates.
-    #
-    # Each selection round is ONE arena max-propagation. Candidates
-    # carry a per-sweep UNIQUE f32-exact integer rank (shorter edge =
-    # higher rank, exact ties broken by a hashed index so uniform
-    # meshes don't serialize on spatially-sorted edge ids), and
-    # committed winners participate with +inf: a candidate whose arena
-    # overlaps a committed winner sees +inf and can never win, which
-    # implements arena claiming with no extra scatter/gather rounds
-    # (the previous scheme spent 2 propagation rounds on the two-phase
-    # priority+hash compare and a 3rd on explicit tet claiming — 3x the
-    # HBM traffic for the same winner sets). Rejected winners are
-    # excluded from the +inf set, so their arenas are released and stop
-    # starving their neighborhoods (the serial kernel simply moves to
-    # the next edge; this is the batched equivalent). Disjoint arenas
-    # keep simultaneous application safe: each tet and each vertex
-    # joins at most one winner.
-    if ecap < (1 << 24):
-        h24 = (
-            jnp.arange(ecap, dtype=jnp.uint32) * jnp.uint32(2654435761)
-        ) & jnp.uint32(0xFFFFFF)
-        order = jnp.lexsort((h24, jnp.where(cand, l, jnp.inf)))
-        rnk = (
-            jnp.zeros(ecap, jnp.float32)
-            .at[order]
-            .set(jnp.arange(ecap, 0, -1, dtype=jnp.float32))
+        # --- final apply -------------------------------------------------------
+        app_t = is_ball & accept[e_ts]
+        del_t = is_shell & accept[e_ts]
+        tet_out = jnp.where(app_t[:, None], new_tet, tet)
+        tmask_out = tmask & ~del_t
+        vmask_out = mesh.vmask.at[jnp.where(accept, src, pcap)].set(
+            False, mode="drop"
         )
+        # trias: delete shells, retarget balls
+        app_f = f_ball & accept[e_fs]
+        del_f = f_shell & accept[e_fs]
+        tria_out = jnp.where(app_f[:, None], new_tria, mesh.tria)
+        trmask_out = mesh.trmask & ~del_f
+        # feature edges: same discipline
+        we2 = wv[mesh.edge]                              # [EC,2]
+        e_e = jnp.max(we2, axis=1)
+        ehas = (e_e >= 0) & mesh.edmask
+        e_es = jnp.maximum(e_e, 0)
+        src_e, dst_e = src[e_es], dst[e_es]
+        g_has_src = jnp.any(mesh.edge == src_e[:, None], axis=1) & ehas
+        g_has_dst = jnp.any(mesh.edge == dst_e[:, None], axis=1) & ehas
+        g_shell = g_has_src & g_has_dst
+        g_ball = g_has_src & ~g_shell
+        new_edge = jnp.where(
+            (mesh.edge == src_e[:, None]) & g_ball[:, None],
+            dst_e[:, None], mesh.edge,
+        )
+        app_g = g_ball & accept[e_es]
+        del_g = g_shell & accept[e_es]
+        edge_out = jnp.where(app_g[:, None], new_edge, mesh.edge)
+        edmask_out = mesh.edmask & ~del_g
 
-        def select_round(w_acc, rej, sup):
-            """One round: winners + newly-suppressed candidates.
+        ncollapse = jnp.sum(accept.astype(jnp.int32)).astype(jnp.int32)
+        nsurf = jnp.sum((accept & (s_src < 3)).astype(jnp.int32)).astype(jnp.int32)
 
-            A candidate that sees +inf is permanently blocked by a
-            committed winner; it must LEAVE the candidate pool (not
-            merely lose), else its own rank keeps suppressing its
-            neighborhood forever — candidates two hops from a winner
-            would starve."""
-            active = cand & ~w_acc & ~rej & ~sup
-            pv = jnp.where(active, rnk, -jnp.inf)
-            pv = jnp.where(w_acc, jnp.inf, pv)
-            best = gather_arena(scatter_arena(pv))
-            return active & (rnk >= best), active & jnp.isinf(best)
+        # frontier: every vertex of a retargeted or deleted tet (the
+        # deleted shell rows still read their original vertices, so src
+        # and the whole ring land in the mark)
+        chg = jnp.zeros(pcap, bool).at[
+            jnp.where((app_t | del_t)[:, None], new_tet, pcap).reshape(-1)
+        ].set(True, mode="drop")
+        chg = chg.at[jnp.where(accept, dst, pcap)].set(True, mode="drop")
+
+        out = mesh.replace(
+            tet=tet_out, tmask=tmask_out, vmask=vmask_out,
+            tria=tria_out, trmask=trmask_out,
+            edge=edge_out, edmask=edmask_out,
+        )
+        return (out, ncollapse, ncand, nrej_geom, nrej_topo, nrej_surf,
+                nsurf, chg)
+
+    def _skip(mesh):
+        z = jnp.int32(0)
+        return mesh, z, z, z, z, z, z, jnp.zeros(pcap, bool)
+
+    if active is None:
+        (out, ncollapse, ncand, nrej_geom, nrej_topo, nrej_surf, nsurf,
+         chg) = _heavy(mesh)
     else:
-        # ranks stop being f32-exact beyond 2^24 edges: fall back to
-        # the two-phase compare (priority then hashed index)
-        def select_round(w_acc, rej, sup):
-            active = cand & ~w_acc & ~rej & ~sup
-            blocked = gather_arena(
-                scatter_arena(jnp.where(w_acc, 1.0, -jnp.inf))
-            ) > 0.0
-            w = common.two_phase_winners(
-                -l, active & ~blocked, scatter_arena, gather_arena
-            )
-            return w, active & blocked
-
-    # initial carries derived from mesh data (not fresh constants) so
-    # they inherit the device-varying type under shard_map — a literal
-    # jnp.zeros carry is 'unvarying' and the loop body would change its
-    # type on the first iteration
-    zero_e = cand & False
-
-    if common._split_scatter_cols():
-        # TPU: each propagation round is fixed scatter/gather cost
-        # whether or not it finds work, so the selection loops exit as
-        # soon as a round adds no winners (the common case once the mesh
-        # converges) and the validity evaluation is skipped when the
-        # trial set did not change. On CPU the nested
-        # while_loop/cond control flow costs more than it saves
-        # (latency-bound small meshes measured -23%), so that backend
-        # keeps the fixed fori_loop below.
-        def sel_cond(carry):
-            _, _, _, k, got = carry
-            return (k < 5) & got
-
-        def sel_body(carry):
-            w_acc, rej, sup, k, _ = carry
-            w, sup_add = select_round(w_acc, rej, sup)
-            return (w_acc | w, rej, sup | sup_add, k + 1, jnp.any(w))
-
-        def outer_cond(carry):
-            _, _, _, _, k, got = carry
-            return (k < 3) & got
-
-        def outer_body(carry):
-            win_acc, rej_g, rej_s, rej_t, k, _ = carry
-            rej = rej_g | rej_s | rej_t
-            # suppression resets each outer round: eval may reject
-            # winners, releasing arenas the suppressed candidates need
-            trial, _, _, _, _ = jax.lax.while_loop(
-                sel_cond, sel_body,
-                (win_acc, rej, zero_e, jnp.int32(0), jnp.any(cand)),
-            )
-            new_any = jnp.any(trial & ~win_acc)
-
-            def do_eval(_):
-                acc, rg, rs, rt, _aux = eval_winners(trial)
-                return acc, rej_g | rg, rej_s | rs, rej_t | rt
-
-            def skip_eval(_):
-                # selection added nothing: the carried set was already
-                # validated in the previous round
-                return win_acc, rej_g, rej_s, rej_t
-
-            acc, rg_o, rs_o, rt_o = jax.lax.cond(
-                new_any, do_eval, skip_eval, None
-            )
-            return acc, rg_o, rs_o, rt_o, k + 1, new_any
-
-        win_acc, rej_g, rej_s, rej_t, _, _ = jax.lax.while_loop(
-            outer_cond, outer_body,
-            (zero_e, zero_e, zero_e, zero_e, jnp.int32(0),
-             jnp.any(cand)),
-        )
-    else:
-        def sel_body_f(_, carry):
-            w_acc, rej, sup = carry
-            w, sup_add = select_round(w_acc, rej, sup)
-            return w_acc | w, rej, sup | sup_add
-
-        def outer_body_f(_, carry):
-            win_acc, rej_g, rej_s, rej_t = carry
-            rej = rej_g | rej_s | rej_t
-            trial, _, _ = jax.lax.fori_loop(
-                0, 5, sel_body_f, (win_acc, rej, zero_e)
-            )
-            acc, rg, rs, rt, _aux = eval_winners(trial)
-            return acc, rej_g | rg, rej_s | rs, rej_t | rt
-
-        win_acc, rej_g, rej_s, rej_t = jax.lax.fori_loop(
-            0, 3, outer_body_f,
-            (zero_e, zero_e, zero_e, zero_e),
-        )
-    # Cheap final pass: winners were fully validated inside the loop;
-    # re-derive only the apply intermediates (scatter/compare, no
-    # quality/surface re-evaluation) plus one duplicate guard on exactly
-    # the applied configuration — removing rejected winners restores
-    # their shell tets, which could in principle re-collide with a
-    # survivor's retarget.
-    win = win_acc
-    wv = jnp.full(pcap, -1, jnp.int32)
-    wv = wv.at[jnp.where(win, src, pcap)].max(eidx, mode="drop")
-    wv = wv.at[jnp.where(win, dst, pcap)].max(eidx, mode="drop")
-    wt4 = wv[tet]
-    e_t = jnp.max(wt4, axis=1)
-    has = (e_t >= 0) & tmask
-    e_ts = jnp.maximum(e_t, 0)
-    src_t, dst_t = src[e_ts], dst[e_ts]
-    has_src = jnp.any(tet == src_t[:, None], axis=1) & has
-    has_dst = jnp.any(tet == dst_t[:, None], axis=1) & has
-    is_shell = has_src & has_dst
-    is_ball = has_src & ~is_shell
-    new_tet = jnp.where(
-        (tet == src_t[:, None]) & is_ball[:, None], dst_t[:, None], tet
-    )
-    wf3 = wv[mesh.tria]
-    e_f = jnp.max(wf3, axis=1)
-    fhas = (e_f >= 0) & mesh.trmask
-    e_fs = jnp.maximum(e_f, 0)
-    src_f, dst_f = src[e_fs], dst[e_fs]
-    f_has_src = jnp.any(mesh.tria == src_f[:, None], axis=1) & fhas
-    f_has_dst = jnp.any(mesh.tria == dst_f[:, None], axis=1) & fhas
-    f_shell = f_has_src & f_has_dst
-    f_ball = f_has_src & ~f_shell
-    new_tria = jnp.where(
-        (mesh.tria == src_f[:, None]) & f_ball[:, None],
-        dst_f[:, None], mesh.tria,
-    )
-    dup = common.duplicate_tets(
-        jnp.where((is_ball & win[e_ts])[:, None], new_tet, tet),
-        tmask & ~(is_shell & win[e_ts]),
-        bound=mesh.pcap,
-    )
-    bad_e = jnp.zeros(ecap, bool).at[
-        jnp.where(dup & has, e_t, ecap)
-    ].max(True, mode="drop")
-    accept = win & ~bad_e
-    nrej_geom = jnp.sum(rej_g.astype(jnp.int32))
-    nrej_surf = jnp.sum(rej_s.astype(jnp.int32))
-    nrej_topo = jnp.sum((rej_t | bad_e).astype(jnp.int32))
-
-    # --- final apply -------------------------------------------------------
-    app_t = is_ball & accept[e_ts]
-    del_t = is_shell & accept[e_ts]
-    tet_out = jnp.where(app_t[:, None], new_tet, tet)
-    tmask_out = tmask & ~del_t
-    vmask_out = mesh.vmask.at[jnp.where(accept, src, pcap)].set(
-        False, mode="drop"
-    )
-    # trias: delete shells, retarget balls
-    app_f = f_ball & accept[e_fs]
-    del_f = f_shell & accept[e_fs]
-    tria_out = jnp.where(app_f[:, None], new_tria, mesh.tria)
-    trmask_out = mesh.trmask & ~del_f
-    # feature edges: same discipline
-    we2 = wv[mesh.edge]                              # [EC,2]
-    e_e = jnp.max(we2, axis=1)
-    ehas = (e_e >= 0) & mesh.edmask
-    e_es = jnp.maximum(e_e, 0)
-    src_e, dst_e = src[e_es], dst[e_es]
-    g_has_src = jnp.any(mesh.edge == src_e[:, None], axis=1) & ehas
-    g_has_dst = jnp.any(mesh.edge == dst_e[:, None], axis=1) & ehas
-    g_shell = g_has_src & g_has_dst
-    g_ball = g_has_src & ~g_shell
-    new_edge = jnp.where(
-        (mesh.edge == src_e[:, None]) & g_ball[:, None],
-        dst_e[:, None], mesh.edge,
-    )
-    app_g = g_ball & accept[e_es]
-    del_g = g_shell & accept[e_es]
-    edge_out = jnp.where(app_g[:, None], new_edge, mesh.edge)
-    edmask_out = mesh.edmask & ~del_g
-
-    ncollapse = jnp.sum(accept.astype(jnp.int32))
-    nsurf = jnp.sum((accept & (s_src < 3)).astype(jnp.int32))
-
-    out = mesh.replace(
-        tet=tet_out, tmask=tmask_out, vmask=vmask_out,
-        tria=tria_out, trmask=trmask_out,
-        edge=edge_out, edmask=edmask_out,
-    )
+        # converged regions: no short active edge anywhere means no
+        # surf/feat sort-merge, no selection loop, no duplicate sorts
+        (out, ncollapse, ncand, nrej_geom, nrej_topo, nrej_surf, nsurf,
+         chg) = jax.lax.cond(jnp.any(pre), _heavy, _skip, mesh)
     return out, CollapseStats(
         ncollapse=ncollapse, ncand=ncand, nrej_geom=nrej_geom,
         nrej_topo=nrej_topo, nrej_surf=nrej_surf, nsurf=nsurf,
+        changed_v=chg,
     )

@@ -243,6 +243,50 @@ def rank_winners(
     return cand & (r >= best) & jnp.isfinite(r)
 
 
+# ---------------------------------------------------------------------------
+# frontier (active-set) helpers — round 6
+#
+# Every sweep records the vertices whose geometry or 1-ring topology it
+# changed (`changed_v` in the op stats); the NEXT sweep's candidate
+# generation addresses only entities near that frontier. A candidate's
+# decision depends on its arena — entities sharing a tet — so the gate
+# is the one-ring closure of the changed set: any competitor's change
+# lands in a shared tet, whose vertices the closure flags (see
+# PERF_NOTES round 6 for the argument). Overflow/first-sweep fallback
+# is the all-true mask: gating with it reproduces the full-table sweep
+# bit for bit.
+# ---------------------------------------------------------------------------
+
+
+def one_ring_closure(tet, tmask, changed_v):
+    """[PC] bool: vertices sharing a valid tet with a changed vertex
+    (including the changed vertices themselves). One gather + one
+    scatter — the whole frontier bookkeeping stays two cheap
+    single-column passes per sweep."""
+    pcap = changed_v.shape[0]
+    t_hot = jnp.any(changed_v[tet], axis=1) & tmask
+    idx = jnp.where(t_hot[:, None], tet, pcap)
+    av = jnp.zeros(pcap, bool).at[idx.reshape(-1)].set(True, mode="drop")
+    return av | changed_v
+
+
+def edge_active(active_v, a, b, emask):
+    """[E] bool: unique edge has an endpoint inside the active closure."""
+    return emask & (active_v[a] | active_v[b])
+
+
+def topk_candidates(cand, sortkey, K: int):
+    """Worst-first candidate compaction shared by the remesh operators:
+    the K lowest-`sortkey` rows among `cand` (non-candidates sort to
+    +inf). Returns (pick [K] int32 row ids, valid [K] bool). Overflowing
+    candidates — only in violent early sweeps — are the BEST-key rows
+    and are retried next sweep; the Jacobi schedule already assumes
+    multiple passes."""
+    key = jnp.where(cand, sortkey, jnp.inf)
+    pick = jnp.argsort(key)[:K].astype(jnp.int32)
+    return pick, cand[pick]
+
+
 # uint32 sentinel for packed invalid rows (valid packed keys are
 # < (bound+1)^2 - 1 <= 0xFFFE0000 when bound <= PACK_BOUND, so the
 # sentinel never collides). A NUMPY scalar, deliberately: a jnp

@@ -10,6 +10,13 @@ sweeps converge to the same unit-length goal as the serial kernel.
 
 Frozen entities (PARBDY interface, REQUIRED) are never split, matching the
 reference's interface-freezing discipline (`src/tag_pmmg.c`).
+
+Frontier mode (round 6): with an `active` vertex mask (one-ring closure
+of the previous sweep's changes) candidates are restricted to edges near
+the frontier, and the heavy phase — the tria-edge sort-merge, vertex
+normals, MIS, and all apply scatters — is skipped entirely via
+`lax.cond` when no long active edge exists. `active=None` reproduces the
+full-table sweep exactly.
 """
 
 from __future__ import annotations
@@ -31,6 +38,7 @@ class SplitStats(NamedTuple):
     nsplit: jax.Array       # edges split this sweep
     ncand: jax.Array        # long-edge candidates before selection
     capped: jax.Array       # bool: capacity limited the sweep
+    changed_v: jax.Array    # [PC] bool — vertices whose 1-ring changed
 
 
 # tag bits a new mid-edge vertex inherits from a surface/feature edge
@@ -45,12 +53,14 @@ def split_long_edges(
     t2e: jax.Array,
     llong: float = float(metric_mod.LLONG),
     nosurf: bool = False,
+    active: jax.Array | None = None,
 ):
     """One split sweep. Mesh must be compacted (valid slots are prefixes).
 
     Returns (mesh, SplitStats). Adjacency is left stale."""
     ecap = edges.shape[0]
     tcap = mesh.tcap
+    pcap = mesh.pcap
     np0 = mesh.npoin
     ne0 = mesh.ntet
     nf0 = mesh.ntria
@@ -60,225 +70,276 @@ def split_long_edges(
     l = metric_mod.edge_length(
         mesh.vert[a], mesh.vert[b], mesh.met[a], mesh.met[b]
     )
+    pre = emask & (l > llong)
+    if active is not None:
+        # frontier gate: an inactive long edge was already offered to
+        # the MIS last sweep with an identical arena and lost/was
+        # rejected — only edges near the change frontier can decide
+        # differently this sweep
+        pre = pre & (active[a] | active[b])
 
-    # one sort-merge pass maps every tria edge to its unique-edge slot;
-    # surface / required-tria masks and the tria-split step below all
-    # derive from it (keeps the hot path at a single tria-edge match)
-    fcap = mesh.fcap
-    edge_keys = jnp.where(emask[:, None], edges, -1)
-    tri_keys = common.tria_edge_keys(mesh)  # [3*FC, 2], pair order 01,12,02
-    eid3 = common.match_rows(edge_keys, tri_keys,
-                             bound=mesh.pcap).reshape(fcap, 3)
+    def _heavy(mesh):
+        # one sort-merge pass maps every tria edge to its unique-edge
+        # slot; surface / required-tria masks and the tria-split step
+        # below all derive from it (keeps the hot path at a single
+        # tria-edge match)
+        fcap = mesh.fcap
+        edge_keys = jnp.where(emask[:, None], edges, -1)
+        tri_keys = common.tria_edge_keys(mesh)  # [3*FC,2], order 01,12,02
+        eid3 = common.match_rows(edge_keys, tri_keys,
+                                 bound=mesh.pcap).reshape(fcap, 3)
 
-    def mark_edges(tri_mask):
-        tgt = jnp.where(tri_mask[:, None] & (eid3 >= 0), eid3, ecap)
-        return (
-            jnp.zeros(ecap, bool).at[tgt.reshape(-1)].set(True, mode="drop")
+        def mark_edges(tri_mask):
+            tgt = jnp.where(tri_mask[:, None] & (eid3 >= 0), eid3, ecap)
+            return (
+                jnp.zeros(ecap, bool).at[tgt.reshape(-1)].set(True,
+                                                              mode="drop")
+            )
+
+        surf = mark_edges(mesh.trmask)
+        feat = common.feature_edge_index(mesh, edges, emask)
+        feat_tag = jnp.where(feat >= 0, mesh.edtag[feat], 0)
+        # edges of REQUIRED triangles are frozen too, not just required
+        # feature edges (RequiredTriangles discipline, reference
+        # src/tag_pmmg.c)
+        in_req_tri = mark_edges(
+            mesh.trmask & ((mesh.trtag & tags.REQUIRED) != 0)
         )
+        frozen = (
+            ((mesh.vtag[a] & tags.PARBDY) != 0)
+            & ((mesh.vtag[b] & tags.PARBDY) != 0)
+        ) | ((feat_tag & tags.REQUIRED) != 0) | in_req_tri
+        if nosurf:
+            # -nosurf: the boundary surface is exactly preserved — no
+            # insertions on surface edges either (Mmg tags the whole
+            # boundary MG_REQ under nosurf)
+            frozen = frozen | surf
+        cand = pre & ~frozen
+        ncand = jnp.sum(cand.astype(jnp.int32)).astype(jnp.int32)
 
-    surf = mark_edges(mesh.trmask)
-    feat = common.feature_edge_index(mesh, edges, emask)
-    feat_tag = jnp.where(feat >= 0, mesh.edtag[feat], 0)
-    # edges of REQUIRED triangles are frozen too, not just required feature
-    # edges (RequiredTriangles discipline, reference src/tag_pmmg.c)
-    in_req_tri = mark_edges(
-        mesh.trmask & ((mesh.trtag & tags.REQUIRED) != 0)
-    )
-    frozen = (
-        ((mesh.vtag[a] & tags.PARBDY) != 0) & ((mesh.vtag[b] & tags.PARBDY) != 0)
-    ) | ((feat_tag & tags.REQUIRED) != 0) | in_req_tri
-    if nosurf:
-        # -nosurf: the boundary surface is exactly preserved — no
-        # insertions on surface edges either (Mmg tags the whole boundary
-        # MG_REQ under nosurf)
-        frozen = frozen | surf
-    cand = emask & (l > llong) & ~frozen
-    ncand = jnp.sum(cand.astype(jnp.int32))
+        # --- independent-set selection: arena = incident tets --------------
+        live_e = (t2e >= 0) & mesh.tmask[:, None]  # [TC,6]
+        safe_t2e = jnp.where(live_e, t2e, 0)
 
-    # --- independent-set selection: arena = incident tets ------------------
-    live_e = (t2e >= 0) & mesh.tmask[:, None]  # [TC,6]
-    safe_t2e = jnp.where(live_e, t2e, 0)
+        def scatter_arena(vals):  # [E] -> [TC] max over own edges
+            v6 = jnp.where(live_e, vals[safe_t2e], -jnp.inf)
+            return jnp.max(v6, axis=1)
 
-    def scatter_arena(vals):  # [E] -> [TC] max over own edges
-        v6 = jnp.where(live_e, vals[safe_t2e], -jnp.inf)
-        return jnp.max(v6, axis=1)
+        def gather_arena(av):  # [TC] -> [E] max over incident tets
+            tgt = jnp.where(live_e, t2e, ecap)
+            out = jnp.full(ecap, -jnp.inf, av.dtype)
+            return out.at[tgt.reshape(-1)].max(
+                jnp.broadcast_to(av[:, None], (tcap, 6)).reshape(-1),
+                mode="drop",
+            )
 
-    def gather_arena(av):  # [TC] -> [E] max over incident tets
-        tgt = jnp.where(live_e, t2e, ecap)
-        out = jnp.full(ecap, -jnp.inf, av.dtype)
-        return out.at[tgt.reshape(-1)].max(
-            jnp.broadcast_to(av[:, None], (tcap, 6)).reshape(-1), mode="drop"
+        win = common.rank_winners(l, cand, scatter_arena, gather_arena)
+
+        # --- capacity capping ----------------------------------------------
+        inc_t = jnp.zeros(ecap, jnp.int32).at[safe_t2e.reshape(-1)].add(
+            live_e.reshape(-1).astype(jnp.int32), mode="drop"
+        )  # tets per edge
+        wi = win.astype(jnp.int32)
+        rank_v = jnp.cumsum(wi) - 1                      # new-vertex rank
+        used_t = jnp.cumsum(wi * inc_t)                  # appended tets
+        used_f = jnp.cumsum(wi * surf.astype(jnp.int32) * 2)  # trias (<=2)
+        used_e = jnp.cumsum(wi * (feat >= 0).astype(jnp.int32))
+        fits = (
+            (np0 + rank_v + 1 <= mesh.pcap)
+            & (ne0 + used_t <= tcap)
+            & (nf0 + used_f <= mesh.fcap)
+            & (ned0 + used_e <= mesh.ecap)
         )
+        capped = jnp.any(win & ~fits)
+        win = win & fits
+        wi = win.astype(jnp.int32)
+        rank_v = jnp.cumsum(wi) - 1
+        nsplit = jnp.sum(wi).astype(jnp.int32)
 
-    win = common.rank_winners(l, cand, scatter_arena, gather_arena)
+        # new vertex slot per winner edge
+        vnew = jnp.where(win, np0 + rank_v, -1).astype(jnp.int32)
 
-    # --- capacity capping --------------------------------------------------
-    inc_t = jnp.zeros(ecap, jnp.int32).at[safe_t2e.reshape(-1)].add(
-        live_e.reshape(-1).astype(jnp.int32), mode="drop"
-    )  # tets per edge
-    wi = win.astype(jnp.int32)
-    rank_v = jnp.cumsum(wi) - 1                      # new-vertex rank
-    used_t = jnp.cumsum(wi * inc_t)                  # appended tets
-    used_f = jnp.cumsum(wi * surf.astype(jnp.int32) * 2)  # appended trias (<=2)
-    used_e = jnp.cumsum(wi * (feat >= 0).astype(jnp.int32))
-    fits = (
-        (np0 + rank_v + 1 <= mesh.pcap)
-        & (ne0 + used_t <= tcap)
-        & (nf0 + used_f <= mesh.fcap)
-        & (ned0 + used_e <= mesh.ecap)
-    )
-    capped = jnp.any(win & ~fits)
-    win = win & fits
-    wi = win.astype(jnp.int32)
-    rank_v = jnp.cumsum(wi) - 1
-    nsplit = jnp.sum(wi)
+        # per-tet winner mapping (shared by midpoint validation + split)
+        w6 = jnp.where(live_e, win[safe_t2e], False)  # [TC,6]
+        has = jnp.any(w6, axis=1) & mesh.tmask
+        k = jnp.argmax(w6, axis=1)                    # local edge slot
+        e_of_t = safe_t2e[jnp.arange(tcap, dtype=jnp.int32), k]
+        ev_j = jnp.asarray(EDGE_VERTS)
+        li = ev_j[k, 0]
+        lj = ev_j[k, 1]
+        rows = jnp.arange(tcap, dtype=jnp.int32)
 
-    # new vertex slot per winner edge
-    vnew = jnp.where(win, np0 + rank_v, -1).astype(jnp.int32)
+        # --- new vertex position -------------------------------------------
+        pa, pb = mesh.vert[a], mesh.vert[b]
+        mid = 0.5 * (pa + pb)
+        if not nosurf:
+            # Curvature-corrected midpoint for plain surface edges — the
+            # cubic Bezier tangent rule of Mmg's `MMG5_BezierTgt` patch
+            # evaluated at t=1/2: mid + ((e.nb)nb - (e.na)na)/8, which
+            # places the point on the circle through the endpoints with
+            # the endpoint normals. Feature edges and feature endpoints
+            # keep the linear midpoint (their blended vertex normals are
+            # meaningless), and any incident tet that the offset would
+            # squash below the positivity floor reverts that edge to the
+            # linear midpoint.
+            # frontier mode: normals are read only at the endpoints of
+            # candidate edges — exactly the rows `need` keeps exact
+            if active is not None:
+                need_v = jnp.zeros(pcap, bool)
+                need_v = need_v.at[jnp.where(pre, a, pcap)].set(
+                    True, mode="drop"
+                )
+                need_v = need_v.at[jnp.where(pre, b, pcap)].set(
+                    True, mode="drop"
+                )
+            else:
+                need_v = None
+            vn = vertex_normals(mesh, need=need_v)
+            surf_real = mark_edges(surf_tria_mask(mesh) & mesh.trmask)
+            na_, nb_ = vn[a], vn[b]
+            has_n = (jnp.sum(na_ * na_, axis=1) > 0.5) & (
+                jnp.sum(nb_ * nb_, axis=1) > 0.5
+            )
+            featv = (
+                (mesh.vtag[a] | mesh.vtag[b])
+                & (tags.RIDGE | tags.REF | tags.CORNER | tags.NOM
+                   | tags.PARBDY)
+            ) != 0
+            plain = surf_real & has_n & ~featv & (feat < 0)
+            e_vec = pb - pa
+            corr = (
+                jnp.einsum("ei,ei->e", e_vec, nb_)[:, None] * nb_
+                - jnp.einsum("ei,ei->e", e_vec, na_)[:, None] * na_
+            ) / 8.0
+            mid_c = mid + corr
+            # per-tet validity of the offset midpoint
+            c = mesh.vert[mesh.tet]                   # [TC,4,3]
+            newp = mid_c[e_of_t]                      # [TC,3]
+            cA = c.at[rows, lj].set(newp)
+            cB = c.at[rows, li].set(newp)
 
-    # per-tet winner mapping (shared by midpoint validation + tet split)
-    w6 = jnp.where(live_e, win[safe_t2e], False)  # [TC,6]
-    has = jnp.any(w6, axis=1) & mesh.tmask
-    k = jnp.argmax(w6, axis=1)                    # local edge slot
-    e_of_t = safe_t2e[jnp.arange(tcap, dtype=jnp.int32), k]
-    ev_j = jnp.asarray(EDGE_VERTS)
-    li = ev_j[k, 0]
-    lj = ev_j[k, 1]
-    rows = jnp.arange(tcap, dtype=jnp.int32)
+            def _vol(cc):
+                d1 = cc[:, 1] - cc[:, 0]
+                d2 = cc[:, 2] - cc[:, 0]
+                d3 = cc[:, 3] - cc[:, 0]
+                return jnp.einsum("ti,ti->t", jnp.cross(d1, d2), d3) / 6.0
 
-    # --- new vertex position ----------------------------------------------
-    pa, pb = mesh.vert[a], mesh.vert[b]
-    mid = 0.5 * (pa + pb)
-    if not nosurf:
-        # Curvature-corrected midpoint for plain surface edges — the
-        # cubic Bezier tangent rule of Mmg's `MMG5_BezierTgt` patch
-        # evaluated at t=1/2: mid + ((e.nb)nb - (e.na)na)/8, which places
-        # the point on the circle through the endpoints with the endpoint
-        # normals. Feature edges and feature endpoints keep the linear
-        # midpoint (their blended vertex normals are meaningless), and
-        # any incident tet that the offset would squash below the
-        # positivity floor reverts that edge to the linear midpoint.
-        vn = vertex_normals(mesh)
-        surf_real = mark_edges(surf_tria_mask(mesh) & mesh.trmask)
-        na_, nb_ = vn[a], vn[b]
-        has_n = (jnp.sum(na_ * na_, axis=1) > 0.5) & (
-            jnp.sum(nb_ * nb_, axis=1) > 0.5
+            vol_p = jnp.abs(_vol(c))
+            floor = common.POS_VOL_FRAC * vol_p
+            okt = (_vol(cA) > floor) & (_vol(cB) > floor)
+            bad_off = jnp.zeros(ecap, bool).at[
+                jnp.where(has & ~okt, e_of_t, ecap)
+            ].max(True, mode="drop")
+            mid = jnp.where((plain & ~bad_off)[:, None], mid_c, mid)
+        ma = mesh.met[a]
+        mets = jnp.stack([ma, mesh.met[b]], axis=-2)  # [E,2,C]
+        half = jnp.full(ecap, 0.5, mesh.vert.dtype)
+        bary = jnp.stack([half, half], axis=-1)
+        mmid = metric_mod.interp_metric(mets, bary)
+        new_tag = jnp.where(surf, tags.BDY, 0) | (feat_tag & _INHERIT)
+        new_ref = jnp.where(feat >= 0, mesh.edref[jnp.maximum(feat, 0)], 0)
+
+        # winner targets are distinct appended slots; distinct OOB
+        # sentinels keep the unique-indices promise (faster scatter
+        # lowering on TPU)
+        tgt_v = common.unique_oob(win, vnew, mesh.pcap)
+        kw = dict(mode="drop", unique_indices=True)
+        vert = common.scatter_rows(mesh.vert, tgt_v, mid, unique=True)
+        met = common.scatter_rows(mesh.met, tgt_v, mmid, unique=True)
+        ls = common.scatter_rows(
+            mesh.ls, tgt_v, 0.5 * (mesh.ls[a] + mesh.ls[b]), unique=True
         )
-        featv = (
-            (mesh.vtag[a] | mesh.vtag[b])
-            & (tags.RIDGE | tags.REF | tags.CORNER | tags.NOM | tags.PARBDY)
-        ) != 0
-        plain = surf_real & has_n & ~featv & (feat < 0)
-        e_vec = pb - pa
-        corr = (
-            jnp.einsum("ei,ei->e", e_vec, nb_)[:, None] * nb_
-            - jnp.einsum("ei,ei->e", e_vec, na_)[:, None] * na_
-        ) / 8.0
-        mid_c = mid + corr
-        # per-tet validity of the offset midpoint
-        c = mesh.vert[mesh.tet]                   # [TC,4,3]
-        newp = mid_c[e_of_t]                      # [TC,3]
-        cA = c.at[rows, lj].set(newp)
-        cB = c.at[rows, li].set(newp)
+        disp = common.scatter_rows(
+            mesh.disp, tgt_v, 0.5 * (mesh.disp[a] + mesh.disp[b]),
+            unique=True,
+        )
+        fields = common.scatter_rows(
+            mesh.fields, tgt_v, 0.5 * (mesh.fields[a] + mesh.fields[b]),
+            unique=True,
+        )
+        vtag = mesh.vtag.at[tgt_v].set(new_tag, **kw)
+        vref = mesh.vref.at[tgt_v].set(new_ref, **kw)
+        vmask = mesh.vmask.at[tgt_v].set(True, **kw)
 
-        def _vol(cc):
-            d1 = cc[:, 1] - cc[:, 0]
-            d2 = cc[:, 2] - cc[:, 0]
-            d3 = cc[:, 3] - cc[:, 0]
-            return jnp.einsum("ti,ti->t", jnp.cross(d1, d2), d3) / 6.0
+        # --- split tets ----------------------------------------------------
+        nv_of_t = vnew[e_of_t]
+        # child A in place: vertex lj -> newv
+        tetA = mesh.tet.at[rows, lj].set(
+            jnp.where(has, nv_of_t, mesh.tet[rows, lj])
+        )
+        # child B appended: vertex li -> newv (of the ORIGINAL tet)
+        tetB = mesh.tet.at[rows, li].set(nv_of_t)
+        app_rank = jnp.cumsum(has.astype(jnp.int32)) - 1
+        tgt_t = common.unique_oob(has, ne0 + app_rank, tcap)
+        tet = common.scatter_rows(tetA, tgt_t, tetB, unique=True)
+        tref = mesh.tref.at[tgt_t].set(mesh.tref, **kw)
+        tmask = mesh.tmask.at[tgt_t].set(has, **kw)
 
-        vol_p = jnp.abs(_vol(c))
-        floor = common.POS_VOL_FRAC * vol_p
-        okt = (_vol(cA) > floor) & (_vol(cB) > floor)
-        bad_off = jnp.zeros(ecap, bool).at[
-            jnp.where(has & ~okt, e_of_t, ecap)
-        ].max(True, mode="drop")
-        mid = jnp.where((plain & ~bad_off)[:, None], mid_c, mid)
-    ma = mesh.met[a]
-    mets = jnp.stack([ma, mesh.met[b]], axis=-2)  # [E,2,C]
-    half = jnp.full(ecap, 0.5, mesh.vert.dtype)
-    bary = jnp.stack([half, half], axis=-1)
-    mmid = metric_mod.interp_metric(mets, bary)
-    new_tag = jnp.where(surf, tags.BDY, 0) | (feat_tag & _INHERIT)
-    new_ref = jnp.where(feat >= 0, mesh.edref[jnp.maximum(feat, 0)], 0)
+        # --- split trias (reuses eid3 from candidate selection) ------------
+        w3 = (eid3 >= 0) & win[jnp.maximum(eid3, 0)] & mesh.trmask[:, None]
+        fhas = jnp.any(w3, axis=1)
+        fk = jnp.argmax(w3, axis=1)
+        _TRI_PAIRS = jnp.array([[0, 1], [1, 2], [0, 2]], jnp.int32)
+        fu = _TRI_PAIRS[fk, 0]
+        fv = _TRI_PAIRS[fk, 1]
+        fe = jnp.maximum(eid3[jnp.arange(fcap, dtype=jnp.int32), fk], 0)
+        fnv = vnew[fe]
+        frows = jnp.arange(fcap, dtype=jnp.int32)
+        triA = mesh.tria.at[frows, fv].set(
+            jnp.where(fhas, fnv, mesh.tria[frows, fv])
+        )
+        triB = mesh.tria.at[frows, fu].set(fnv)
+        frank = jnp.cumsum(fhas.astype(jnp.int32)) - 1
+        tgt_f = common.unique_oob(fhas, nf0 + frank, fcap)
+        tria = common.scatter_rows(triA, tgt_f, triB, unique=True)
+        trref = mesh.trref.at[tgt_f].set(mesh.trref, **kw)
+        trtag = mesh.trtag.at[tgt_f].set(mesh.trtag, **kw)
+        trmask = mesh.trmask.at[tgt_f].set(fhas, **kw)
 
-    # winner targets are distinct appended slots; distinct OOB sentinels
-    # keep the unique-indices promise (faster scatter lowering on TPU)
-    tgt_v = common.unique_oob(win, vnew, mesh.pcap)
-    kw = dict(mode="drop", unique_indices=True)
-    vert = common.scatter_rows(mesh.vert, tgt_v, mid, unique=True)
-    met = common.scatter_rows(mesh.met, tgt_v, mmid, unique=True)
-    ls = common.scatter_rows(
-        mesh.ls, tgt_v, 0.5 * (mesh.ls[a] + mesh.ls[b]), unique=True
-    )
-    disp = common.scatter_rows(
-        mesh.disp, tgt_v, 0.5 * (mesh.disp[a] + mesh.disp[b]), unique=True
-    )
-    fields = common.scatter_rows(
-        mesh.fields, tgt_v, 0.5 * (mesh.fields[a] + mesh.fields[b]),
-        unique=True,
-    )
-    vtag = mesh.vtag.at[tgt_v].set(new_tag, **kw)
-    vref = mesh.vref.at[tgt_v].set(new_ref, **kw)
-    vmask = mesh.vmask.at[tgt_v].set(True, **kw)
+        # --- split feature edges -------------------------------------------
+        ehas = win & (feat >= 0)
+        fidx = jnp.where(ehas, feat, mesh.ecap).astype(jnp.int32)
+        # use the stored row's own endpoint order (rows are not
+        # canonically sorted): in place (r0,r1) -> (r0,newv), append
+        # (newv,r1)
+        r1 = mesh.edge[jnp.maximum(feat, 0), 1]
+        edge_arr = mesh.edge.at[fidx, 1].set(vnew, mode="drop")
+        erank = jnp.cumsum(ehas.astype(jnp.int32)) - 1
+        tgt_e = common.unique_oob(ehas, ned0 + erank, mesh.ecap)
+        newrow = jnp.stack([vnew, r1], axis=1)
+        edge_arr = common.scatter_rows(edge_arr, tgt_e, newrow, unique=True)
+        edref = mesh.edref.at[tgt_e].set(
+            jnp.where(feat >= 0, mesh.edref[jnp.maximum(feat, 0)], 0), **kw
+        )
+        edtag = mesh.edtag.at[tgt_e].set(feat_tag, **kw)
+        edmask = mesh.edmask.at[tgt_e].set(ehas, **kw)
 
-    # --- split tets --------------------------------------------------------
-    nv_of_t = vnew[e_of_t]
-    # child A in place: vertex lj -> newv
-    tetA = mesh.tet.at[rows, lj].set(
-        jnp.where(has, nv_of_t, mesh.tet[rows, lj])
-    )
-    # child B appended: vertex li -> newv (of the ORIGINAL tet)
-    tetB = mesh.tet.at[rows, li].set(nv_of_t)
-    app_rank = jnp.cumsum(has.astype(jnp.int32)) - 1
-    tgt_t = common.unique_oob(has, ne0 + app_rank, tcap)
-    tet = common.scatter_rows(tetA, tgt_t, tetB, unique=True)
-    tref = mesh.tref.at[tgt_t].set(mesh.tref, **kw)
-    tmask = mesh.tmask.at[tgt_t].set(has, **kw)
+        # frontier: the new midpoints plus every vertex of a split tet
+        chg = jnp.zeros(pcap, bool).at[tgt_v].set(True, **kw)
+        chg = chg.at[
+            jnp.where(has[:, None], mesh.tet, pcap).reshape(-1)
+        ].set(True, mode="drop")
 
-    # --- split trias (reuses eid3 from candidate selection) ---------------
-    w3 = (eid3 >= 0) & win[jnp.maximum(eid3, 0)] & mesh.trmask[:, None]
-    fhas = jnp.any(w3, axis=1)
-    fk = jnp.argmax(w3, axis=1)
-    _TRI_PAIRS = jnp.array([[0, 1], [1, 2], [0, 2]], jnp.int32)
-    fu = _TRI_PAIRS[fk, 0]
-    fv = _TRI_PAIRS[fk, 1]
-    fe = jnp.maximum(eid3[jnp.arange(fcap, dtype=jnp.int32), fk], 0)
-    fnv = vnew[fe]
-    frows = jnp.arange(fcap, dtype=jnp.int32)
-    triA = mesh.tria.at[frows, fv].set(
-        jnp.where(fhas, fnv, mesh.tria[frows, fv])
-    )
-    triB = mesh.tria.at[frows, fu].set(fnv)
-    frank = jnp.cumsum(fhas.astype(jnp.int32)) - 1
-    tgt_f = common.unique_oob(fhas, nf0 + frank, fcap)
-    tria = common.scatter_rows(triA, tgt_f, triB, unique=True)
-    trref = mesh.trref.at[tgt_f].set(mesh.trref, **kw)
-    trtag = mesh.trtag.at[tgt_f].set(mesh.trtag, **kw)
-    trmask = mesh.trmask.at[tgt_f].set(fhas, **kw)
+        out = mesh.replace(
+            vert=vert, met=met, ls=ls, disp=disp, fields=fields,
+            vtag=vtag, vref=vref, vmask=vmask,
+            tet=tet, tref=tref, tmask=tmask,
+            tria=tria, trref=trref, trtag=trtag, trmask=trmask,
+            edge=edge_arr, edref=edref, edtag=edtag, edmask=edmask,
+        )
+        return out, nsplit, ncand, capped, chg
 
-    # --- split feature edges ----------------------------------------------
-    ehas = win & (feat >= 0)
-    fidx = jnp.where(ehas, feat, mesh.ecap).astype(jnp.int32)
-    # use the stored row's own endpoint order (rows are not canonically
-    # sorted): in place (r0,r1) -> (r0,newv), append (newv,r1)
-    r1 = mesh.edge[jnp.maximum(feat, 0), 1]
-    edge_arr = mesh.edge.at[fidx, 1].set(vnew, mode="drop")
-    erank = jnp.cumsum(ehas.astype(jnp.int32)) - 1
-    tgt_e = common.unique_oob(ehas, ned0 + erank, mesh.ecap)
-    newrow = jnp.stack([vnew, r1], axis=1)
-    edge_arr = common.scatter_rows(edge_arr, tgt_e, newrow, unique=True)
-    edref = mesh.edref.at[tgt_e].set(
-        jnp.where(feat >= 0, mesh.edref[jnp.maximum(feat, 0)], 0), **kw
-    )
-    edtag = mesh.edtag.at[tgt_e].set(feat_tag, **kw)
-    edmask = mesh.edmask.at[tgt_e].set(ehas, **kw)
+    def _skip(mesh):
+        return (mesh, jnp.int32(0), jnp.int32(0), jnp.bool_(False),
+                jnp.zeros(pcap, bool))
 
-    out = mesh.replace(
-        vert=vert, met=met, ls=ls, disp=disp, fields=fields,
-        vtag=vtag, vref=vref, vmask=vmask,
-        tet=tet, tref=tref, tmask=tmask,
-        tria=tria, trref=trref, trtag=trtag, trmask=trmask,
-        edge=edge_arr, edref=edref, edtag=edtag, edmask=edmask,
-    )
-    return out, SplitStats(nsplit=nsplit, ncand=ncand, capped=capped)
+    if active is None:
+        out, nsplit, ncand, capped, chg = _heavy(mesh)
+    else:
+        # converged regions: no long active edge anywhere means no
+        # tria-edge sort, no vertex normals, no MIS, no apply scatters
+        out, nsplit, ncand, capped, chg = jax.lax.cond(
+            jnp.any(pre), _heavy, _skip, mesh
+        )
+    return out, SplitStats(nsplit=nsplit, ncand=ncand, capped=capped,
+                           changed_v=chg)

@@ -10,6 +10,14 @@ The 3-2 swap extracts the ring of a 3-tet interior edge shell without a
 walk: each shell tet contributes its two off-edge vertices, every ring
 vertex appears exactly twice, so {min, sum/2-min-max, max} are the three
 ring vertices — one scatter instead of Mmg's pointer chase.
+
+Both swaps are frontier-aware (round 6): with an `active` vertex mask
+(the one-ring closure of the previous sweep's changes) the candidate set
+is restricted to edges/faces near the frontier, and the whole heavy
+phase — candidate quality/volume, membership sorts, MIS, duplicate
+check, apply — is skipped via `lax.cond` when no candidate survives the
+cheap prefilter. `active=None` (the distributed/vmapped paths and all
+legacy callers) reproduces the full-table sweep exactly.
 """
 
 from __future__ import annotations
@@ -31,6 +39,7 @@ QTHRESH = 0.5        # only try to improve tets worse than this
 class SwapStats(NamedTuple):
     nswap32: jax.Array
     nswap23: jax.Array
+    changed_v: jax.Array   # [PC] bool — vertices whose 1-ring changed
 
 
 def _oriented(t4: jax.Array, vert) -> jax.Array:
@@ -43,12 +52,23 @@ def _oriented(t4: jax.Array, vert) -> jax.Array:
     return jnp.stack([v0, v1, t4[:, 2], t4[:, 3]], axis=1)
 
 
+def _mark_changed(pcap, win, cols):
+    """[PC] bool from the vertex columns of winning candidates."""
+    chg = jnp.zeros(pcap, bool)
+    # static unroll over the 5 ring columns (a python tuple of fixed
+    # length, not a traced entity count)
+    for c in cols:  # parmmg-lint: disable=PML003
+        chg = chg.at[jnp.where(win, c, pcap)].set(True, mode="drop")
+    return chg
+
+
 @partial(jax.jit, donate_argnums=0)
 def swap_32(
     mesh: Mesh,
     edges: jax.Array,
     emask: jax.Array,
     t2e: jax.Array,
+    active: jax.Array | None = None,
 ):
     """3-2 edge swap sweep. Mesh must be compacted; adjacency left stale.
 
@@ -63,6 +83,7 @@ def swap_32(
     shells and are retried next sweep."""
     ecap = edges.shape[0]
     tcap = mesh.tcap
+    pcap = mesh.pcap
     tet, tmask = mesh.tet, mesh.tmask
 
     live_e = (t2e >= 0) & tmask[:, None]
@@ -120,106 +141,134 @@ def swap_32(
         & ((mesh.vtag[a] & tags.PARBDY) == 0)
         & ((mesh.vtag[b] & tags.PARBDY) == 0)
     )
+    if active is not None:
+        # frontier gate: a shell's verdict can only have changed when a
+        # vertex of one of its (endpoint-incident) tets changed — the
+        # closure marks both endpoints in that case
+        cand_pre = cand_pre & (active[a] | active[b])
 
-    # compact, worst shell first
     K = min(ecap, max(256, ecap // 8))
-    sortkey = jnp.where(cand_pre, shell_min_q, jnp.inf)
-    pick = jnp.argsort(sortkey)[:K].astype(jnp.int32)
-    valid = cand_pre[pick]
-    ak, bk = a[pick], b[pick]
-    uk, vk, wk_ = u[pick], v[pick], w[pick]
-    s0 = jnp.clip(smin[pick], 0, tcap - 1)
-    s2 = jnp.clip(smax[pick], 0, tcap - 1)
-    s1 = jnp.clip(ssum[pick] - smin[pick] - smax[pick], 0, tcap - 1)
-    shell_q = shell_min_q[pick]
 
-    # new configuration (compacted rows only)
-    t1 = _oriented(jnp.stack([uk, vk, wk_, ak], axis=1), mesh.vert)
-    t2_ = _oriented(jnp.stack([uk, wk_, vk, bk], axis=1), mesh.vert)
-    q1 = common.quality_of(mesh.vert, mesh.met, t1)
-    q2 = common.quality_of(mesh.vert, mesh.met, t2_)
-    v1 = common.vol_of(mesh.vert, t1)
-    v2 = common.vol_of(mesh.vert, t2_)
-    # volume conservation rejects non-convex shells whose new tets are
-    # individually positive but overlap outside the old shell
-    shell_vol = vol_all[s0] + vol_all[s1] + vol_all[s2]
-    new_min = jnp.minimum(q1, q2)
-    pos_frac, cons_tol = common.vol_tols(mesh.dtype)
-    vref = jnp.maximum(shell_vol, 1e-30)
-    conserve = jnp.abs((v1 + v2) - shell_vol) <= cons_tol * vref
-    gain_ok = (
-        (new_min > GAIN * shell_q)
-        & (v1 > pos_frac * vref)
-        & (v2 > pos_frac * vref)
-        & conserve
-    )
-    # the new tets must not already exist
-    tet_keys = jnp.where(tmask[:, None], jnp.sort(tet, axis=1), -1)
-    exists = common.sorted_membership(
-        tet_keys,
-        jnp.concatenate([
-            jnp.sort(jnp.where(valid[:, None], t1, -1), axis=1),
-            jnp.sort(jnp.where(valid[:, None], t2_, -1), axis=1),
-        ]),
-        bound=mesh.pcap,
-    )
-    cand = valid & gain_ok & ~exists[:K] & ~exists[K:]
+    def _heavy(_):
+        # compact, worst shell first
+        pick, valid = common.topk_candidates(cand_pre, shell_min_q, K)
+        ak, bk = a[pick], b[pick]
+        uk, vk, wk_ = u[pick], v[pick], w[pick]
+        s0 = jnp.clip(smin[pick], 0, tcap - 1)
+        s2 = jnp.clip(smax[pick], 0, tcap - 1)
+        s1 = jnp.clip(ssum[pick] - smin[pick] - smax[pick], 0, tcap - 1)
+        shell_q = shell_min_q[pick]
 
-    # --- arena = the 3 shell tets (addressed directly) --------------------
-    def scatter_arena(vals):
-        out = jnp.full(tcap, -jnp.inf, vals.dtype)
-        out = out.at[s0].max(vals, mode="drop")
-        out = out.at[s1].max(vals, mode="drop")
-        out = out.at[s2].max(vals, mode="drop")
-        return out
+        # new configuration (compacted rows only)
+        t1 = _oriented(jnp.stack([uk, vk, wk_, ak], axis=1), mesh.vert)
+        t2_ = _oriented(jnp.stack([uk, wk_, vk, bk], axis=1), mesh.vert)
+        q1 = common.quality_of(mesh.vert, mesh.met, t1)
+        q2 = common.quality_of(mesh.vert, mesh.met, t2_)
+        v1 = common.vol_of(mesh.vert, t1)
+        v2 = common.vol_of(mesh.vert, t2_)
+        # volume conservation rejects non-convex shells whose new tets are
+        # individually positive but overlap outside the old shell
+        shell_vol = vol_all[s0] + vol_all[s1] + vol_all[s2]
+        new_min = jnp.minimum(q1, q2)
+        pos_frac, cons_tol = common.vol_tols(mesh.dtype)
+        vref = jnp.maximum(shell_vol, 1e-30)
+        conserve = jnp.abs((v1 + v2) - shell_vol) <= cons_tol * vref
+        gain_ok = (
+            (new_min > GAIN * shell_q)
+            & (v1 > pos_frac * vref)
+            & (v2 > pos_frac * vref)
+            & conserve
+        )
+        # the new tets must not already exist
+        tet_keys = jnp.where(tmask[:, None], jnp.sort(tet, axis=1), -1)
+        exists = common.sorted_membership(
+            tet_keys,
+            jnp.concatenate([
+                jnp.sort(jnp.where(valid[:, None], t1, -1), axis=1),
+                jnp.sort(jnp.where(valid[:, None], t2_, -1), axis=1),
+            ]),
+            bound=mesh.pcap,
+        )
+        cand = valid & gain_ok & ~exists[:K] & ~exists[K:]
 
-    def gather_arena(av):
-        return jnp.maximum(jnp.maximum(av[s0], av[s1]), av[s2])
+        # --- arena = the 3 shell tets (addressed directly) ----------------
+        def scatter_arena(vals):
+            out = jnp.full(tcap, -jnp.inf, vals.dtype)
+            out = out.at[s0].max(vals, mode="drop")
+            out = out.at[s1].max(vals, mode="drop")
+            out = out.at[s2].max(vals, mode="drop")
+            return out
 
-    win = common.rank_winners(new_min - shell_q, cand,
-                              scatter_arena, gather_arena)
+        def gather_arena(av):
+            return jnp.maximum(jnp.maximum(av[s0], av[s1]), av[s2])
 
-    # apply: t1 overwrites the min-slot shell tet, t2 the middle one,
-    # the max-slot one dies. Arena exclusivity makes every target tet
-    # belong to exactly one winner, so the unique-indices promise holds.
-    tgt0 = common.unique_oob(win, s0, tcap)
-    tgt1 = common.unique_oob(win, s1, tcap)
-    tet_new = common.scatter_rows(tet, tgt0, t1, unique=True)
-    tet_new = common.scatter_rows(tet_new, tgt1, t2_, unique=True)
-    tgt2 = common.unique_oob(win, s2, tcap)
-    tmask_new = tmask.at[tgt2].set(False, mode="drop", unique_indices=True)
+        win = common.rank_winners(new_min - shell_q, cand,
+                                  scatter_arena, gather_arena)
 
-    # duplicate post-check (cross-swap interactions). The killed tet
-    # (s2) cannot flag: its tmask was cleared before duplicate_tets ran,
-    # so only the two overwritten slots carry signal.
-    dup = common.duplicate_tets(tet_new, tmask_new, bound=mesh.pcap)
-    bad = (dup[s0] | dup[s1]) & win
-    win2 = win & ~bad
+        # apply: t1 overwrites the min-slot shell tet, t2 the middle one,
+        # the max-slot one dies. Arena exclusivity makes every target tet
+        # belong to exactly one winner, so the unique-indices promise holds.
+        tgt0 = common.unique_oob(win, s0, tcap)
+        tgt1 = common.unique_oob(win, s1, tcap)
+        tet_new = common.scatter_rows(tet, tgt0, t1, unique=True)
+        tet_new = common.scatter_rows(tet_new, tgt1, t2_, unique=True)
+        tgt2 = common.unique_oob(win, s2, tcap)
+        tmask_new = tmask.at[tgt2].set(False, mode="drop",
+                                       unique_indices=True)
 
-    def rebuild(_):
-        g0 = common.unique_oob(win2, s0, tcap)
-        g1 = common.unique_oob(win2, s1, tcap)
-        g2 = common.unique_oob(win2, s2, tcap)
-        t_o = common.scatter_rows(tet, g0, t1, unique=True)
-        t_o = common.scatter_rows(t_o, g1, t2_, unique=True)
-        tm_o = tmask.at[g2].set(False, mode="drop", unique_indices=True)
-        return t_o, tm_o
+        # duplicate post-check (cross-swap interactions). The killed tet
+        # (s2) cannot flag: its tmask was cleared before duplicate_tets
+        # ran, so only the two overwritten slots carry signal.
+        dup = common.duplicate_tets(tet_new, tmask_new, bound=mesh.pcap)
+        bad = (dup[s0] | dup[s1]) & win
+        win2 = win & ~bad
 
-    def keep(_):
-        return tet_new, tmask_new
+        def rebuild(_):
+            g0 = common.unique_oob(win2, s0, tcap)
+            g1 = common.unique_oob(win2, s1, tcap)
+            g2 = common.unique_oob(win2, s2, tcap)
+            t_o = common.scatter_rows(tet, g0, t1, unique=True)
+            t_o = common.scatter_rows(t_o, g1, t2_, unique=True)
+            tm_o = tmask.at[g2].set(False, mode="drop", unique_indices=True)
+            return t_o, tm_o
 
-    if common._split_scatter_cols():
-        tet_out, tmask_out = jax.lax.cond(jnp.any(bad), rebuild, keep, None)
+        def keep(_):
+            return tet_new, tmask_new
+
+        if common._split_scatter_cols():
+            tet_out, tmask_out = jax.lax.cond(jnp.any(bad), rebuild, keep,
+                                              None)
+        else:
+            tet_out, tmask_out = rebuild(None)
+
+        chg = _mark_changed(pcap, win2, (uk, vk, wk_, ak, bk))
+        return (tet_out, tmask_out,
+                jnp.sum(win2.astype(jnp.int32)).astype(jnp.int32), chg)
+
+    if active is None:
+        tet_out, tmask_out, nswap, chg = _heavy(None)
     else:
-        tet_out, tmask_out = rebuild(None)
+        # frontier mode: the compacted phase (quality eval, membership
+        # sort, MIS, duplicate sort, apply scatters) only runs when the
+        # cheap prefilter admits someone — converged sweeps skip it all
+        tet_out, tmask_out, nswap, chg = jax.lax.cond(
+            jnp.any(cand_pre), _heavy,
+            lambda _: (tet, tmask, jnp.int32(0), jnp.zeros(pcap, bool)),
+            None,
+        )
 
-    nswap = jnp.sum(win2.astype(jnp.int32))
     out = mesh.replace(tet=tet_out, tmask=tmask_out)
-    return out, SwapStats(nswap32=nswap, nswap23=jnp.int32(0))
+    return out, SwapStats(nswap32=nswap, nswap23=jnp.int32(0),
+                          changed_v=chg)
 
 
 @partial(jax.jit, donate_argnums=0)
-def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
+def swap_23(
+    mesh: Mesh,
+    edges: jax.Array,
+    emask: jax.Array,
+    active: jax.Array | None = None,
+):
     """2-3 face swap sweep. Requires FRESH adjacency; leaves it stale.
 
     The expensive work (three candidate-tet quality/volume evaluations,
@@ -233,6 +282,7 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
     which are retried next sweep — the Jacobi schedule already assumes
     multiple passes."""
     tcap = mesh.tcap
+    pcap = mesh.pcap
     tet, tmask, adja = mesh.tet, mesh.tmask, mesh.adja
     ne0 = mesh.ntet
 
@@ -248,144 +298,171 @@ def swap_23(mesh: Mesh, edges: jax.Array, emask: jax.Array):
         & (t_id_full < t2_full)          # each face once
         & (jnp.minimum(q_all[t_id_full], q_all[t2_full]) < QTHRESH)
     )
+    if active is not None:
+        # frontier gate at tet granularity: a face pair's verdict can
+        # only change when a vertex of either tet's 1-ring changed
+        tet_act = jnp.any(active[tet], axis=1)
+        pre = pre & (tet_act[t_id_full] | tet_act[t2_full])
 
-    # compact, worst pair first
     K = max(256, tcap // 2)
     sortkey = jnp.where(
         pre, jnp.minimum(q_all[t_id_full], q_all[t2_full]), jnp.inf
     )
-    pick = jnp.argsort(sortkey)[:K].astype(jnp.int32)
-    t_id = pick // 4
-    f_id = pick % 4
-    nb = nb_full[pick]
-    t2c = jnp.clip(nb // 4, 0, tcap - 1)
-    valid = pre[pick]
 
-    fvidx = jnp.asarray(FACE_VERTS)[f_id]               # [K,3] local slots
-    fv = jnp.take_along_axis(tet[t_id], fvidx, axis=1)  # [K,3] vertex ids
-    d1 = tet[t_id, f_id]
-    d2 = tet[t2c, nb % 4]
+    def _heavy(_):
+        # compact, worst pair first
+        pick, valid = common.topk_candidates(pre, sortkey, K)
+        t_id = pick // 4
+        f_id = pick % 4
+        nb = nb_full[pick]
+        t2c = jnp.clip(nb // 4, 0, tcap - 1)
 
-    old_min = jnp.minimum(q_all[t_id], q_all[t2c])
+        fvidx = jnp.asarray(FACE_VERTS)[f_id]               # [K,3] slots
+        fv = jnp.take_along_axis(tet[t_id], fvidx, axis=1)  # [K,3] ids
+        d1 = tet[t_id, f_id]
+        d2 = tet[t2c, nb % 4]
 
-    # edge (d1,d2) must not already exist
-    elo = jnp.minimum(d1, d2)
-    ehi = jnp.maximum(d1, d2)
-    ekeys = jnp.where(emask[:, None], edges, -1)
-    equery = jnp.stack(
-        [jnp.where(valid, elo, -1), jnp.where(valid, ehi, -1)], axis=1
-    )
-    edge_exists = common.sorted_membership(ekeys, equery, bound=mesh.pcap)
+        old_min = jnp.minimum(q_all[t_id], q_all[t2c])
 
-    # the face must not carry a stored tria: a 2-3 swap deletes the
-    # face, which would orphan a material-interface or open-boundary
-    # (-opnbdy) surface tria glued between same- or different-ref tets
-    fsort = jnp.sort(fv, axis=1)
-    trkeys = jnp.sort(
-        jnp.where(mesh.trmask[:, None], mesh.tria, -1), axis=1
-    )
-    face_has_tria = common.sorted_membership(
-        trkeys, jnp.where(valid[:, None], fsort, -1), bound=mesh.pcap
-    )
+        # edge (d1,d2) must not already exist
+        elo = jnp.minimum(d1, d2)
+        ehi = jnp.maximum(d1, d2)
+        ekeys = jnp.where(emask[:, None], edges, -1)
+        equery = jnp.stack(
+            [jnp.where(valid, elo, -1), jnp.where(valid, ehi, -1)], axis=1
+        )
+        edge_exists = common.sorted_membership(ekeys, equery,
+                                               bound=mesh.pcap)
 
-    # three new tets around (d1,d2)
-    x, y, z = fv[:, 0], fv[:, 1], fv[:, 2]
-    cands = [
-        jnp.stack([x, y, d1, d2], axis=1),
-        jnp.stack([y, z, d1, d2], axis=1),
-        jnp.stack([z, x, d1, d2], axis=1),
-    ]
-    cands = [_oriented(c, mesh.vert) for c in cands]
-    qs = [common.quality_of(mesh.vert, mesh.met, c) for c in cands]
-    vs = [common.vol_of(mesh.vert, c) for c in cands]
-    new_min = jnp.minimum(jnp.minimum(qs[0], qs[1]), qs[2])
-    vol_old2 = common.vol_of(mesh.vert, tet)
-    pair_vol = vol_old2[t_id] + vol_old2[t2c]
-    pos_frac, cons_tol = common.vol_tols(mesh.dtype)
-    vref = jnp.maximum(pair_vol, 1e-30)
-    conserve = jnp.abs((vs[0] + vs[1] + vs[2]) - pair_vol) <= cons_tol * vref
-    vol_ok = (
-        (vs[0] > pos_frac * vref)
-        & (vs[1] > pos_frac * vref)
-        & (vs[2] > pos_frac * vref)
-        & conserve
-    )
+        # the face must not carry a stored tria: a 2-3 swap deletes the
+        # face, which would orphan a material-interface or open-boundary
+        # (-opnbdy) surface tria glued between same- or different-ref tets
+        fsort = jnp.sort(fv, axis=1)
+        trkeys = jnp.sort(
+            jnp.where(mesh.trmask[:, None], mesh.tria, -1), axis=1
+        )
+        face_has_tria = common.sorted_membership(
+            trkeys, jnp.where(valid[:, None], fsort, -1), bound=mesh.pcap
+        )
 
-    cand = (
-        valid
-        & (old_min < QTHRESH)
-        & ~edge_exists
-        & ~face_has_tria
-        & vol_ok
-        & (new_min > GAIN * old_min)
-    )
+        # three new tets around (d1,d2)
+        x, y, z = fv[:, 0], fv[:, 1], fv[:, 2]
+        cands = [
+            jnp.stack([x, y, d1, d2], axis=1),
+            jnp.stack([y, z, d1, d2], axis=1),
+            jnp.stack([z, x, d1, d2], axis=1),
+        ]
+        cands = [_oriented(c, mesh.vert) for c in cands]
+        qs = [common.quality_of(mesh.vert, mesh.met, c) for c in cands]
+        vs = [common.vol_of(mesh.vert, c) for c in cands]
+        new_min = jnp.minimum(jnp.minimum(qs[0], qs[1]), qs[2])
+        vol_old2 = common.vol_of(mesh.vert, tet)
+        pair_vol = vol_old2[t_id] + vol_old2[t2c]
+        pos_frac, cons_tol = common.vol_tols(mesh.dtype)
+        vref = jnp.maximum(pair_vol, 1e-30)
+        conserve = (
+            jnp.abs((vs[0] + vs[1] + vs[2]) - pair_vol) <= cons_tol * vref
+        )
+        vol_ok = (
+            (vs[0] > pos_frac * vref)
+            & (vs[1] > pos_frac * vref)
+            & (vs[2] > pos_frac * vref)
+            & conserve
+        )
 
-    # --- arena = the two tets ---------------------------------------------
-    def scatter_arena(vals):
-        out = jnp.full(tcap, -jnp.inf, vals.dtype)
-        out = out.at[t_id].max(vals, mode="drop")
-        out = out.at[t2c].max(vals, mode="drop")
-        return out
+        cand = (
+            valid
+            & (old_min < QTHRESH)
+            & ~edge_exists
+            & ~face_has_tria
+            & vol_ok
+            & (new_min > GAIN * old_min)
+        )
 
-    def gather_arena(av):
-        return jnp.maximum(av[t_id], av[t2c])
+        # --- arena = the two tets -----------------------------------------
+        def scatter_arena(vals):
+            out = jnp.full(tcap, -jnp.inf, vals.dtype)
+            out = out.at[t_id].max(vals, mode="drop")
+            out = out.at[t2c].max(vals, mode="drop")
+            return out
 
-    win = common.rank_winners(new_min - old_min, cand,
-                              scatter_arena, gather_arena)
+        def gather_arena(av):
+            return jnp.maximum(av[t_id], av[t2c])
 
-    # capacity: one appended tet per winner
-    wi = win.astype(jnp.int32)
-    rank = jnp.cumsum(wi) - 1
-    fits = ne0 + rank + 1 <= tcap
-    win = win & fits
-    wi = win.astype(jnp.int32)
-    rank = jnp.cumsum(wi) - 1
+        win = common.rank_winners(new_min - old_min, cand,
+                                  scatter_arena, gather_arena)
 
-    # tentative apply: children 0/1 overwrite t and t2, child 2 appended
-    tet_out = tet
-    tgt_a = common.unique_oob(win, t_id, tcap)
-    tet_out = common.scatter_rows(tet_out, tgt_a, cands[0], unique=True)
-    tgt_b = common.unique_oob(win, t2c, tcap)
-    tet_out = common.scatter_rows(tet_out, tgt_b, cands[1], unique=True)
-    tgt_c = common.unique_oob(win, ne0 + rank, tcap)
-    tet_out = common.scatter_rows(tet_out, tgt_c, cands[2], unique=True)
-    tmask_out = tmask.at[tgt_c].set(win, mode="drop", unique_indices=True)
+        # capacity: one appended tet per winner
+        wi = win.astype(jnp.int32)
+        rank = jnp.cumsum(wi) - 1
+        fits = ne0 + rank + 1 <= tcap
+        win = win & fits
+        wi = win.astype(jnp.int32)
+        rank = jnp.cumsum(wi) - 1
 
-    # duplicate post-check: reject interacting winners and revert
-    dup = common.duplicate_tets(tet_out, tmask_out, bound=mesh.pcap)
-    bad = (
-        dup[jnp.clip(t_id, 0, tcap - 1)]
-        | dup[t2c]
-        | dup[jnp.clip(ne0 + rank, 0, tcap - 1)]
-    ) & win
-    win2 = win & ~bad
+        # tentative apply: children 0/1 overwrite t and t2, child 2
+        # appended
+        tet_out = tet
+        tgt_a = common.unique_oob(win, t_id, tcap)
+        tet_out = common.scatter_rows(tet_out, tgt_a, cands[0], unique=True)
+        tgt_b = common.unique_oob(win, t2c, tcap)
+        tet_out = common.scatter_rows(tet_out, tgt_b, cands[1], unique=True)
+        tgt_c = common.unique_oob(win, ne0 + rank, tcap)
+        tet_out = common.scatter_rows(tet_out, tgt_c, cands[2], unique=True)
+        tmask_out = tmask.at[tgt_c].set(win, mode="drop",
+                                        unique_indices=True)
 
-    def rebuild(_):
-        tgt_a2 = common.unique_oob(win2, t_id, tcap)
-        tgt_b2 = common.unique_oob(win2, t2c, tcap)
-        tgt_c2 = common.unique_oob(win2, ne0 + rank, tcap)
-        t_o = tet
-        t_o = common.scatter_rows(t_o, tgt_a2, cands[0], unique=True)
-        t_o = common.scatter_rows(t_o, tgt_b2, cands[1], unique=True)
-        t_o = common.scatter_rows(t_o, tgt_c2, cands[2], unique=True)
-        tm_o = tmask.at[tgt_c2].set(win2, mode="drop", unique_indices=True)
-        return t_o, tm_o
+        # duplicate post-check: reject interacting winners and revert
+        dup = common.duplicate_tets(tet_out, tmask_out, bound=mesh.pcap)
+        bad = (
+            dup[jnp.clip(t_id, 0, tcap - 1)]
+            | dup[t2c]
+            | dup[jnp.clip(ne0 + rank, 0, tcap - 1)]
+        ) & win
+        win2 = win & ~bad
 
-    def keep(_):
-        return tet_out, tmask_out
+        def rebuild(_):
+            tgt_a2 = common.unique_oob(win2, t_id, tcap)
+            tgt_b2 = common.unique_oob(win2, t2c, tcap)
+            tgt_c2 = common.unique_oob(win2, ne0 + rank, tcap)
+            t_o = tet
+            t_o = common.scatter_rows(t_o, tgt_a2, cands[0], unique=True)
+            t_o = common.scatter_rows(t_o, tgt_b2, cands[1], unique=True)
+            t_o = common.scatter_rows(t_o, tgt_c2, cands[2], unique=True)
+            tm_o = tmask.at[tgt_c2].set(win2, mode="drop",
+                                        unique_indices=True)
+            return t_o, tm_o
 
-    if common._split_scatter_cols():
-        # interacting winners are rare once sweeps settle: skip the
-        # 12-column rebuild scatter round when there are none (each
-        # random-index scatter is ~ms on TPU; the cond is free on the
-        # common path)
-        tet_out, tmask_out = jax.lax.cond(jnp.any(bad), rebuild, keep, None)
+        def keep(_):
+            return tet_out, tmask_out
+
+        if common._split_scatter_cols():
+            # interacting winners are rare once sweeps settle: skip the
+            # 12-column rebuild scatter round when there are none (each
+            # random-index scatter is ~ms on TPU; the cond is free on the
+            # common path)
+            tet_out, tmask_out = jax.lax.cond(jnp.any(bad), rebuild, keep,
+                                              None)
+        else:
+            tet_out, tmask_out = rebuild(None)
+        tgt_c = common.unique_oob(win2, ne0 + rank, tcap)
+        tref_out = mesh.tref.at[tgt_c].set(mesh.tref[t_id], mode="drop",
+                                           unique_indices=True)
+
+        chg = _mark_changed(pcap, win2, (x, y, z, d1, d2))
+        return (tet_out, tref_out, tmask_out,
+                jnp.sum(win2.astype(jnp.int32)).astype(jnp.int32), chg)
+
+    if active is None:
+        tet_out, tref_out, tmask_out, nswap, chg = _heavy(None)
     else:
-        tet_out, tmask_out = rebuild(None)
-    tgt_c = common.unique_oob(win2, ne0 + rank, tcap)
-    tref_out = mesh.tref.at[tgt_c].set(mesh.tref[t_id], mode="drop",
-                                       unique_indices=True)
+        tet_out, tref_out, tmask_out, nswap, chg = jax.lax.cond(
+            jnp.any(pre), _heavy,
+            lambda _: (tet, mesh.tref, tmask, jnp.int32(0),
+                       jnp.zeros(pcap, bool)),
+            None,
+        )
 
     out = mesh.replace(tet=tet_out, tref=tref_out, tmask=tmask_out)
-    return out, SwapStats(nswap32=jnp.int32(0),
-                          nswap23=jnp.sum(win2.astype(jnp.int32)))
+    return out, SwapStats(nswap32=jnp.int32(0), nswap23=nswap,
+                          changed_v=chg)

@@ -1,0 +1,196 @@
+"""Round-6 active-set (frontier) sweep tests.
+
+Equivalence discipline: frontier sweeps gate candidate generation on the
+one-ring closure of the previous sweep's changes and rebuild analysis
+tables incrementally — the RESULT must match full-table sweeps on the
+seeded cube workload (same element count, quality histogram and
+conformity within fp jitter), on both the fused and unfused dispatch
+paths. The incremental rebuilds (`update_adjacency`,
+`append_unique_edges`) must be bit-exact against their full
+counterparts, including their overflow fallbacks.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import parmmg_tpu.models.adapt as adapt_mod
+from parmmg_tpu.core import adjacency, tags
+from parmmg_tpu.core.mesh import compact
+from parmmg_tpu.models.adapt import (
+    AdaptOptions, Frontier, adapt, default_mem_budget_mb, remesh_sweep,
+)
+from parmmg_tpu.ops import quality, swap
+from parmmg_tpu.utils import conformity
+from parmmg_tpu.utils.gen import unit_cube_mesh
+
+
+def _copy(m):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, m
+    )
+
+
+def _run(frontier, unfused, monkeypatch):
+    # n=5 cube: adaptive enough to exercise every operator phase while
+    # keeping tier-1 time down (compile count, not rows, dominates)
+    if unfused:
+        monkeypatch.setattr(adapt_mod, "UNFUSED_TCAP", 0)
+    mesh = unit_cube_mesh(5)
+    opts = AdaptOptions(hsiz=0.12, niter=1, max_sweeps=10, hgrad=None,
+                        frontier=frontier)
+    out, info = adapt(mesh, opts)
+    h = quality.quality_histogram(out)
+    return out, info, h
+
+
+@pytest.mark.parametrize("unfused", [False, True],
+                         ids=["fused", "unfused"])
+def test_frontier_full_table_equivalence(monkeypatch, unfused):
+    """Active-set sweeps must reproduce the full-table result on the
+    seeded cube workload: same invariants (ne, qmin/qavg within fp
+    tolerance, conformity histogram) on both dispatch paths."""
+    out_f, info_f, h_f = _run(True, unfused, monkeypatch)
+    out_t, info_t, h_t = _run(False, unfused, monkeypatch)
+    ne_f, ne_t = int(out_f.ntet), int(out_t.ntet)
+    assert abs(ne_f - ne_t) <= max(0.02 * ne_t, 16), (ne_f, ne_t)
+    assert float(h_f.qmin) == pytest.approx(float(h_t.qmin), abs=0.05)
+    assert float(h_f.qavg) == pytest.approx(float(h_t.qavg), abs=0.02)
+    # conformity histogram: both conformal, same 5-bin quality shape
+    assert conformity.check_mesh(out_f).ok
+    assert conformity.check_mesh(out_t).ok
+    cf = np.asarray(h_f.counts, np.float64) / max(ne_f, 1)
+    ct = np.asarray(h_t.counts, np.float64) / max(ne_t, 1)
+    assert np.abs(cf - ct).max() < 0.05, (cf, ct)
+    # the frontier run reports a (weakly) shrinking active fraction
+    saf = [r["n_active"] / max(r["n_unique"], 1)
+           for r in info_f["history"]]
+    assert saf, "history missing n_active"
+    assert all(0.0 <= x <= 1.0 for x in saf)
+
+
+def test_noop_frontier_sweep_is_identity():
+    """A sweep offered an EMPTY frontier over clean tables must do
+    nothing: no ops, mesh arrays bit-identical — the converged
+    verification-sweep fast path."""
+    mesh = unit_cube_mesh(4)
+    out, _ = adapt(mesh, AdaptOptions(hsiz=0.2, niter=1, max_sweeps=8,
+                                      hgrad=None))
+    out = compact(out)
+    ecap = int(out.tcap * 1.6) + 64
+    edges, emask, t2e, nu = adjacency.unique_edges(out, ecap)
+    out = adjacency.build_adjacency(out)
+    fr = Frontier(
+        changed=jnp.zeros(out.pcap, bool),
+        dirty=jnp.int32(0),
+        tables=(edges, emask, t2e, jnp.asarray(nu, jnp.int32)),
+        adja_ok=jnp.bool_(True),
+    )
+    ref = _copy(out)
+    out2, st, fr2 = remesh_sweep(out, ecap, phase_skip=False, frontier=fr)
+    assert int(st.nsplit) == 0 and int(st.ncollapse) == 0
+    assert int(st.nswap) == 0 and int(st.nmoved) == 0
+    assert int(st.n_active) == 0
+    np.testing.assert_array_equal(np.asarray(out2.vert),
+                                  np.asarray(ref.vert))
+    np.testing.assert_array_equal(np.asarray(out2.tet),
+                                  np.asarray(ref.tet))
+    np.testing.assert_array_equal(np.asarray(out2.tmask),
+                                  np.asarray(ref.tmask))
+    # successor frontier stays drained and clean
+    assert int(jnp.sum(fr2.changed.astype(jnp.int32))) == 0
+    assert int(fr2.dirty) == 0
+
+
+def _jittered_cube(n=5, seed=0, amp=0.35):
+    """Structured cube with deterministically jittered interior vertices
+    — quality incentives make 2-3 swaps fire (the pristine cube has
+    none)."""
+    mesh = compact(unit_cube_mesh(n))
+    v = np.asarray(mesh.vert).copy()
+    vm = np.asarray(mesh.vmask)
+    vt = np.asarray(mesh.vtag)
+    interior = vm & ((vt & tags.BDY) == 0)
+    rng = np.random.default_rng(seed)
+    v[interior] += rng.uniform(-amp, amp, v[interior].shape) / n
+    return mesh.replace(vert=jnp.asarray(v, mesh.vert.dtype))
+
+
+def test_update_adjacency_exact():
+    """Incremental face rematch == full rebuild after a real 2-3 swap
+    pass, including the K-overflow fallback, and is a no-op on an empty
+    frontier."""
+    mesh = _jittered_cube()
+    m0 = adjacency.build_adjacency(mesh)
+    ref0 = np.asarray(m0.adja).copy()
+    K = m0.tcap * 4
+    m_all = adjacency.update_adjacency(
+        _copy(m0), jnp.ones(m0.pcap, bool), K=K
+    )
+    np.testing.assert_array_equal(ref0, np.asarray(m_all.adja))
+    m_none = adjacency.update_adjacency(
+        _copy(m0), jnp.zeros(m0.pcap, bool), K=K
+    )
+    np.testing.assert_array_equal(ref0, np.asarray(m_none.adja))
+
+    ecap = int(m0.tcap * 1.7) + 64
+    edges, emask, _, _ = adjacency.unique_edges(m0, ecap)
+    m1, st = swap.swap_23(_copy(m0), edges, emask)
+    assert int(st.nswap23) > 0, "workload produced no 2-3 swaps"
+    full = adjacency.build_adjacency(_copy(m1))
+    incr = adjacency.update_adjacency(_copy(m1), st.changed_v, K=K)
+    np.testing.assert_array_equal(np.asarray(full.adja),
+                                  np.asarray(incr.adja))
+    # K too small for the frontier -> exact via the full-rebuild fallback
+    fall = adjacency.update_adjacency(_copy(m1), st.changed_v, K=8)
+    np.testing.assert_array_equal(np.asarray(full.adja),
+                                  np.asarray(fall.adja))
+
+
+def test_append_unique_edges_exact():
+    """Incremental edge-table extension after a 2-3 swap pass matches
+    the full re-sort: same edge set, same n_unique, and every live
+    tet2edge row references the same vertex pair."""
+    mesh = _jittered_cube(seed=1)
+    m0 = adjacency.build_adjacency(mesh)
+    ecap = int(m0.tcap * 1.7) + 64
+    edges, emask, t2e, nu = adjacency.unique_edges(m0, ecap)
+    m1, st = swap.swap_23(_copy(m0), edges, emask)
+    assert int(st.nswap23) > 0
+    e_i, em_i, t2e_i, nu_i = adjacency.append_unique_edges(
+        m1, st.changed_v, edges, emask, t2e, nu, K=m0.tcap
+    )
+    e_f, em_f, t2e_f, nu_f = adjacency.unique_edges(m1, ecap)
+    assert int(nu_i) == int(nu_f)
+    set_i = {tuple(r) for r in np.asarray(e_i)[np.asarray(em_i)]}
+    set_f = {tuple(r) for r in np.asarray(e_f)[np.asarray(em_f)]}
+    assert set_i == set_f
+    Ei, Ti = np.asarray(e_i), np.asarray(t2e_i)
+    Ef, Tf = np.asarray(e_f), np.asarray(t2e_f)
+    live = np.nonzero(np.asarray(m1.tmask))[0]
+    assert (Ti[live] >= 0).all() and (Tf[live] >= 0).all()
+    np.testing.assert_array_equal(Ei[Ti[live]], Ef[Tf[live]])
+    # K-overflow fallback stays exact
+    _, _, _, nu_k = adjacency.append_unique_edges(
+        m1, st.changed_v, edges, emask, t2e, nu, K=2
+    )
+    assert int(nu_k) == int(nu_f)
+
+
+def test_mem_budget_autoderived():
+    """VERDICT coverage row 3: an unset mem_budget_mb derives from the
+    device's reported memory (CPU fallback: /proc/meminfo) instead of
+    running unbounded; float('inf') opts out."""
+    derived = default_mem_budget_mb()
+    assert derived is None or derived > 0
+    mesh = unit_cube_mesh(3)
+    out, info = adapt(mesh, AdaptOptions(hsiz=0.3, niter=1, max_sweeps=3))
+    assert int(out.ntet) > 0
+    if derived is not None:
+        assert info["mem_budget_mb"] == pytest.approx(derived, rel=0.5)
+    out2, info2 = adapt(unit_cube_mesh(3), AdaptOptions(
+        hsiz=0.3, niter=1, max_sweeps=3, mem_budget_mb=float("inf")
+    ))
+    assert info2["mem_budget_mb"] == float("inf")

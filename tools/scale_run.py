@@ -29,13 +29,27 @@ import time
 from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
 
 
+def _parse_budgets(spec):
+    """'sweeps=0,finalize=2' -> {'sweeps': 0, 'finalize': 2}; '' -> {}.
+    Phase names are adapt()'s own markers (analysis / metric /
+    input histogram / sweeps / finalize)."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if part:
+            name, _, val = part.partition("=")
+            out[name.strip()] = int(val)
+    return out
+
+
 def worker(n, hsiz, tight=False):
     import bench
 
     bench._enable_compile_cache()
     import jax
 
-    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.lint.contracts import run_adapt_with_budget
+    from parmmg_tpu.models.adapt import AdaptOptions
     from parmmg_tpu.ops import quality
 
     est = bench.est_out_tets(hsiz)
@@ -52,11 +66,22 @@ def worker(n, hsiz, tight=False):
     # qmin == qavg)
     opts = AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=20, hgrad=None,
                         verbose=2)
+    # per-phase retrace budgets (lint.contracts): the xl ladder sets
+    # PARMMG_RETRACE_BUDGETS="sweeps=64" after tools/warm_ops.py prep —
+    # an explosion guard against per-sweep retracing (each program still
+    # traces once even on disk-cache hits; the strict warm-cache
+    # steady_recompiles==0 contract lives in bench.py's in-process
+    # steady phase). Unset = counts recorded in the JSON, not enforced.
+    budgets = _parse_budgets(os.environ.get("PARMMG_RETRACE_BUDGETS"))
     t0 = time.perf_counter()
-    out, info = adapt(mesh, opts)
+    out, info = run_adapt_with_budget(mesh, opts, budgets=budgets)
     wall = time.perf_counter() - t0
     ne = int(out.ntet)
     h = quality.quality_histogram(out)
+    saf = [
+        round(r["n_active"] / max(r["n_unique"], 1), 4)
+        for r in info["history"] if "n_active" in r
+    ]
     # COLD timing: one adapt() with no warmup — compile time (or cache
     # hits) is folded in, so this number is NOT comparable to bench.py's
     # steady-state tets_per_sec; the metric name says so
@@ -66,6 +91,8 @@ def worker(n, hsiz, tight=False):
         "ne": ne, "wall_s": round(wall, 2),
         "platform": jax.devices()[0].platform,
         "qmin": round(float(h.qmin), 5), "qavg": round(float(h.qavg), 5),
+        "recompiles": info["recompiles"],
+        "sweep_active_fraction": saf,
     }
     print(json.dumps(rec), flush=True)
 

@@ -20,7 +20,17 @@ python tools/warm_ops.py 16 0.02 --tight 1 --stall 5400 --ops compact,unique_edg
 rc=$?
 echo "## stage rest rc=$rc"
 [ $rc -ne 0 ] && exit $rc
-python tools/scale_run.py 16 0.02 --tight 1 --stall 2700 --retries 4
+# measured stage runs on the disk cache the warm stages just filled.
+# NOTE the budget is an EXPLOSION guard, not 0: jax logs "Compiling"
+# before the persistent-cache lookup, so even a fully warmed run traces
+# each program once (disk hits, seconds each) — the warm-cache
+# steady_recompiles==0 contract is bench.py's in-process steady phase.
+# What must never happen here is per-sweep retracing (PML004 class):
+# the n=16 run executes ~20 sweeps over ~15 distinct programs, so >64
+# sweep-phase compiles means something retraces per sweep — fail loudly
+# via lint.contracts.run_adapt_with_budget instead of recording a
+# silently-livelocked number
+PARMMG_RETRACE_BUDGETS="sweeps=64" python tools/scale_run.py 16 0.02 --tight 1 --stall 2700 --retries 4
 rc=$?
 echo "## stage run rc=$rc"
 exit $rc
