@@ -497,12 +497,10 @@ class ParMesh:
                 self.info.get("status", ReturnStatus.SUCCESS)
             )
         except Exception as e:  # graded failure: keep last valid mesh
-            self.info = dict(error=str(e))
-            self.status = (
-                ReturnStatus.LOWFAILURE
-                if self.mesh is not None
-                else ReturnStatus.STRONGFAILURE
-            )
+            from . import failsafe
+
+            self.info = dict(error=str(e), error_type=type(e).__name__)
+            self.status = failsafe.classify(e, self.mesh is not None)
         return self.status
 
     def parmmglib_distributed(self) -> ReturnStatus:
@@ -523,7 +521,7 @@ class ParMesh:
                 self.info.get("status", ReturnStatus.SUCCESS)
             )
         except Exception as e:
-            self.info = dict(error=str(e))
+            self.info = dict(error=str(e), error_type=type(e).__name__)
             self.status = ReturnStatus.STRONGFAILURE
         return self.status
 
@@ -647,7 +645,7 @@ def adapt_file(inmesh: str, insol: str, outmesh: str, hsiz: float,
             status = int(info["status"])
         else:
             out, _info = _adapt(mesh, AdaptOptions(hsiz=hs, niter=niter))
-            status = int(ReturnStatus.SUCCESS)
+            status = int(_info.get("status", ReturnStatus.SUCCESS))
         medit.save_mesh(out, outmesh)
         return status
     except Exception:

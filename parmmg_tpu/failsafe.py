@@ -1,0 +1,697 @@
+"""Fail-safe layer: graded failure, checkpoint/resume, fault injection.
+
+The reference's contract is that adaptation *degrades, never crashes*:
+every phase ends in an ``MPI_Allreduce(ier, MIN)`` agreement and the
+``failed_handling`` ladder returns the best conformal mesh so far as
+``PMMG_LOWFAILURE``/``PMMG_STRONGFAILURE`` (reference
+`src/libparmmg1.c:812,831` and `src/libparmmg1.c:970-1011`). Under JAX's
+static-shape regime the failure *surface* differs — capacity exhaustion,
+non-finite scatter poisoning, retrace-triggered XLA errors, preemption —
+but the cure is the same: validate at phase boundaries, roll back to the
+last good state, grow-and-retry capacity, and checkpoint so a killed
+worker resumes instead of restarting. Four pieces:
+
+- **typed exception taxonomy** (`AdaptError` and friends) that both
+  drivers map onto `ReturnStatus.{SUCCESS,LOWFAILURE,STRONGFAILURE}`;
+- **PhaseValidator**: the cadence-configurable phase-boundary validator
+  (finiteness + positive orientation on device; host conformity via
+  `utils.conformity` and communicator symmetry via `parallel.chkcomm`
+  at the ``full`` level) replacing the ad-hoc ``_finite_ok``;
+- **Checkpointer**: atomic (tmp + ``os.replace``, via
+  `io.medit.atomic_replace`) per-iteration checkpoints carrying the
+  exact mesh arrays, sweep state, history and an options fingerprint;
+  a mismatched fingerprint *refuses* to resume with a clear error;
+- **FaultPlan**: deterministic fault injection parsed from
+  ``PARMMG_FAULTS="it1:remesh:nan,it2:migrate:overflow,it1:post:kill"``
+  with hooks at every phase boundary in both drivers, so every recovery
+  path above has a test that actually exercises it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import tags
+from .core.mesh import Mesh, tet_volumes
+
+# exit code of an injected ``kill`` fault (simulated preemption) — the
+# test harness and tools/check.sh smoke stage assert on it
+KILL_EXIT_CODE = 86
+
+CHECKPOINT_FORMAT = 1
+
+
+# ---------------------------------------------------------------------------
+# exception taxonomy
+# ---------------------------------------------------------------------------
+
+
+class AdaptError(RuntimeError):
+    """Base of the typed failure taxonomy (always also a RuntimeError so
+    pre-existing broad handlers keep catching it)."""
+
+
+class CapacityError(AdaptError):
+    """A static capacity (shard slots, entity tables) was undershot.
+
+    Recoverable: the caller can grow the relevant capacities and retry.
+    Carries the per-shard / per-entity overflow scalars the raising site
+    already computed:
+
+    - ``overflow``: ``[D, 4]`` int array of per-shard excess
+      ``[verts, tets, trias, edges]`` (integrate-side overflow), or None;
+    - ``counts`` / ``caps``: pack-side per-destination counts vs the
+      static slot caps ``[tets, trias, edges]``, or None.
+    """
+
+    def __init__(self, message: str, *, overflow=None, counts=None,
+                 caps=None):
+        super().__init__(message)
+        self.overflow = None if overflow is None else np.asarray(overflow)
+        self.counts = None if counts is None else np.asarray(counts)
+        self.caps = None if caps is None else np.asarray(caps)
+
+
+class MemoryBudgetError(AdaptError):
+    """The configured device-memory budget blocks a needed growth.
+
+    NOT recoverable by growing (growing is what the budget forbids): the
+    distributed loop degrades it to LOWFAILURE with the last conformal
+    snapshot; the centralized `adapt` raises it through (the budget is a
+    hard caller contract, `test_budget_blocks_growth`)."""
+
+
+class NumericalError(AdaptError):
+    """Phase-boundary validation failed: non-finite coordinates/metric,
+    inverted elements, broken conformity or communicator asymmetry.
+    Deterministic re-runs reproduce it, so recovery is rollback to the
+    last good state + LOWFAILURE, not retry."""
+
+
+class RetraceError(AdaptError):
+    """A transient XLA/executable error (the jax-0.9.0 stale-executable
+    class that `utils.retry.jit_retry` papers over, or an injected
+    fault). Recoverable once by ``jax.clear_caches()`` + retry."""
+
+
+class CheckpointMismatchError(AdaptError):
+    """A checkpoint exists but was written under incompatible options —
+    resuming would silently change the trajectory, so refuse loudly."""
+
+
+class PreemptionError(BaseException):
+    """In-process stand-in for the ``kill`` fault's ``os._exit``
+    (``FaultPlan(kill_mode="raise")``): derives from BaseException so no
+    driver recovery path can absorb it — exactly like a real
+    preemption, the run ends and only the checkpoint survives. Used by
+    tests that cannot afford a subprocess per driver."""
+
+
+def classify(exc: BaseException, have_mesh: bool) -> tags.ReturnStatus:
+    """Map an exception escaping a driver onto the graded status ladder
+    (the `failed_handling` role): LOWFAILURE iff a conformal result mesh
+    survives, STRONGFAILURE otherwise."""
+    if have_mesh:
+        return tags.ReturnStatus.LOWFAILURE
+    return tags.ReturnStatus.STRONGFAILURE
+
+
+def snapshot(state):
+    """Deep copy of a Mesh / stacked-Mesh pytree: the rollback target.
+    A real copy, not a reference — the sweep engines donate their input
+    buffers, so the kept-good state must own its arrays."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state
+    )
+
+
+# ---------------------------------------------------------------------------
+# phase-boundary validation
+# ---------------------------------------------------------------------------
+
+
+# parmmg-lint: disable=PML005 -- pure query; the driver keeps the mesh for rollback
+@jax.jit
+def _sanity_counts(mesh: Mesh) -> jax.Array:
+    """[3] int32: (non-finite vertices, non-finite metric rows,
+    non-positive tets) over the live entities — the cheap device half of
+    the validator (finiteness + positive orientation), one fused reduce
+    like the reference's per-phase ``MPI_Allreduce(ier, MIN)``."""
+    bad_v = jnp.sum(
+        (mesh.vmask & ~jnp.all(jnp.isfinite(mesh.vert), axis=-1))
+        .astype(jnp.int32)
+    )
+    bad_m = jnp.sum(
+        (mesh.vmask & ~jnp.all(jnp.isfinite(mesh.met), axis=-1))
+        .astype(jnp.int32)
+    )
+    vol = tet_volumes(mesh)
+    n_inv = jnp.sum((mesh.tmask & ~(vol > 0)).astype(jnp.int32))
+    return jnp.stack([bad_v, bad_m, n_inv]).astype(jnp.int32)
+
+
+@dataclasses.dataclass
+class PhaseValidator:
+    """Cadence-configurable phase-boundary validation.
+
+    ``level``: ``off`` (never), ``basic`` (device finiteness + positive
+    orientation — one fused reduce, cheap enough for every iteration),
+    ``full`` (basic + host-side conformity via `utils.conformity` and,
+    for distributed states with a communicator, geometric/topological
+    comm symmetry via `parallel.chkcomm`). ``every`` is the iteration
+    cadence: the checks run when ``(it + 1) % every == 0``.
+    """
+
+    level: str = "basic"
+    every: int = 1
+
+    @property
+    def active(self) -> bool:
+        return self.level != "off"
+
+    def due(self, it: int) -> bool:
+        return self.active and (it + 1) % max(self.every, 1) == 0
+
+    def check(self, state: Mesh, it: int, *, comm=None,
+              phase: str = "iteration", force: bool = False) -> None:
+        """Raise :class:`NumericalError` when the state is not a valid,
+        conformal mesh. ``state`` is a single Mesh or a stacked [D,...]
+        Mesh; ``comm`` (a ShardComm) arms the communicator checks at the
+        ``full`` level. ``force`` bypasses the level/cadence gate (used
+        right after a fault hook poisoned the state: the injection must
+        be caught deterministically at ITS boundary, not churned through
+        downstream phases first)."""
+        if force:
+            if not self.due(it):
+                # run at least the basic device checks out of cadence
+                return PhaseValidator(level="basic", every=1).check(
+                    state, it, comm=comm, phase=phase
+                )
+        elif not self.due(it):
+            return
+        stacked = state.vert.ndim == 3
+        counts = _sanity_counts if not stacked else jax.vmap(_sanity_counts)
+        rep = np.asarray(jax.device_get(counts(state)))
+        tot = rep.sum(axis=0) if stacked else rep
+        if tot.any():
+            raise NumericalError(
+                f"phase-boundary validation failed after {phase} "
+                f"(it {it}): {int(tot[0])} non-finite vertices, "
+                f"{int(tot[1])} non-finite metric rows, "
+                f"{int(tot[2])} non-positive tets"
+            )
+        if self.level != "full":
+            return
+        from .utils.conformity import check_mesh
+
+        if stacked:
+            from .parallel.distribute import unstack_mesh
+
+            for s, m in enumerate(unstack_mesh(state)):
+                r = check_mesh(m, check_boundary=False)
+                if not r.ok:
+                    raise NumericalError(
+                        f"conformity check failed after {phase} (it {it}) "
+                        f"on shard {s}: {r}"
+                    )
+            if comm is not None:
+                from .parallel import chkcomm
+                from .parallel.shard import device_mesh
+
+                try:
+                    chkcomm.assert_comm_ok(
+                        state, comm, device_mesh(state.vert.shape[0]),
+                        tol=1e-6,
+                    )
+                except AssertionError as e:
+                    raise NumericalError(
+                        f"communicator symmetry check failed after "
+                        f"{phase} (it {it}): {e}"
+                    ) from e
+        else:
+            r = check_mesh(state, check_boundary=False)
+            if not r.ok:
+                raise NumericalError(
+                    f"conformity check failed after {phase} (it {it}): {r}"
+                )
+
+
+# ---------------------------------------------------------------------------
+# deterministic fault injection
+# ---------------------------------------------------------------------------
+
+FAULT_PHASES = ("analysis", "metric", "remesh", "interp", "migrate", "post")
+FAULT_KINDS = ("nan", "overflow", "retrace", "kill")
+
+
+@dataclasses.dataclass
+class Fault:
+    it: int
+    phase: str
+    kind: str
+    fired: bool = False
+
+
+class FaultPlan:
+    """Deterministic fault schedule, e.g. parsed from
+    ``PARMMG_FAULTS="it1:remesh:nan,it2:migrate:overflow,it1:post:kill"``.
+
+    Each entry fires exactly once, at the matching (iteration, phase)
+    boundary hook of either driver:
+
+    - ``nan``: poisons the live state (NaN coordinate) — caught by the
+      next phase-boundary validation and rolled back;
+    - ``overflow``: a forced capacity undershoot — at the ``migrate``
+      hook the driver undershoots the real slot capacity (the genuine
+      `CapacityError` path fires); elsewhere a synthetic
+      :class:`CapacityError` is raised at the hook;
+    - ``retrace``: raises :class:`RetraceError` (the transient-XLA
+      class) — recovered by clear-caches + retry;
+    - ``kill``: simulated preemption — the process exits with
+      :data:`KILL_EXIT_CODE` (checkpoint/resume covers it).
+    """
+
+    def __init__(self, faults: Optional[List[Fault]] = None,
+                 kill_mode: str = "exit"):
+        self.faults: List[Fault] = list(faults or [])
+        if kill_mode not in ("exit", "raise"):
+            raise ValueError(f"kill_mode {kill_mode!r} not in (exit, raise)")
+        self.kill_mode = kill_mode
+
+    @classmethod
+    def parse(cls, spec: str, kill_mode: str = "exit") -> "FaultPlan":
+        faults = []
+        for tok in filter(None, (t.strip() for t in spec.split(","))):
+            parts = tok.split(":")
+            if len(parts) != 3 or not parts[0].startswith("it"):
+                raise ValueError(
+                    f"bad PARMMG_FAULTS token {tok!r} "
+                    "(want it<k>:<phase>:<kind>)"
+                )
+            it = int(parts[0][2:])
+            phase, kind = parts[1], parts[2]
+            if phase not in FAULT_PHASES:
+                raise ValueError(
+                    f"unknown fault phase {phase!r} (one of {FAULT_PHASES})"
+                )
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} (one of {FAULT_KINDS})"
+                )
+            faults.append(Fault(it, phase, kind))
+        return cls(faults, kill_mode=kill_mode)
+
+    @classmethod
+    def resolve(cls, opts) -> "FaultPlan":
+        """The plan for one driver run: ``opts.faults`` (a FaultPlan or
+        spec string) when set, else the ``PARMMG_FAULTS`` environment
+        variable, else an empty plan. A fresh run should get a fresh
+        plan — fired state is per-instance."""
+        given = getattr(opts, "faults", None)
+        if isinstance(given, FaultPlan):
+            return given
+        if isinstance(given, str):
+            return cls.parse(given)
+        env = os.environ.get("PARMMG_FAULTS")
+        if env:
+            return cls.parse(env)
+        return cls()
+
+    def take(self, it: int, phase: str, kind: str) -> bool:
+        """Consume a pending (phase, kind) fault scheduled at or before
+        iteration `it`; True if it fired. Used by the driver for faults
+        it must realize itself (the ``migrate`` overflow undershoots the
+        real slot capacity) — those need a realizable event, and e.g.
+        the first actual migration may come an iteration later than
+        scheduled (an idle front moves nothing), so the fault arms the
+        first opportunity at or after its iteration."""
+        for f in self.faults:
+            if not f.fired and f.it <= it and f.phase == phase \
+                    and f.kind == kind:
+                f.fired = True
+                return True
+        return False
+
+    def fire(self, it: int, phase: str, state):
+        """Apply every pending fault for this (it, phase) boundary.
+        Returns the (possibly poisoned) state; may raise or exit."""
+        for f in self.faults:
+            if f.fired or f.it != it or f.phase != phase:
+                continue
+            if f.phase == "migrate" and f.kind == "overflow":
+                # realized by the driver via take(): it undershoots the
+                # REAL slot capacity so the genuine raise + recovery
+                # path runs, not a synthetic stand-in
+                continue
+            f.fired = True
+            where = f"it{it}:{phase}"
+            if f.kind == "nan":
+                idx = (0,) * (state.vert.ndim - 1)
+                state = state.replace(
+                    vert=state.vert.at[idx].set(jnp.nan)
+                )
+            elif f.kind == "overflow":
+                raise CapacityError(
+                    f"injected capacity overflow at {where} (fault plan)",
+                    overflow=[[1, 1, 0, 0]],
+                )
+            elif f.kind == "retrace":
+                raise RetraceError(
+                    f"injected transient retrace/XLA error at {where} "
+                    "(fault plan)"
+                )
+            elif f.kind == "kill":
+                if self.kill_mode == "raise":
+                    raise PreemptionError(
+                        f"injected preemption at {where} (fault plan, "
+                        "kill_mode=raise)"
+                    )
+                print(
+                    f"[failsafe] injected preemption at {where} — "
+                    f"exiting with code {KILL_EXIT_CODE}",
+                    flush=True,
+                )
+                os._exit(KILL_EXIT_CODE)
+        return state
+
+
+# ---------------------------------------------------------------------------
+# atomic checkpoint / resume
+# ---------------------------------------------------------------------------
+
+# resume-safe option fields, excluded from the compatibility fingerprint:
+# they steer reporting, scheduling or the failsafe machinery itself, not
+# the adaptation trajectory from a given state. `niter` is excluded by
+# design: extending/shortening the remaining iterations is a legitimate
+# resume (the checkpoint records which iteration it holds).
+# `mem_budget_mb` is a per-machine resource knob (auto-derived when
+# unset), not a trajectory option.
+_FINGERPRINT_EXCLUDE = frozenset({
+    "verbose", "niter", "checkpoint_dir", "checkpoint_every", "faults",
+    "mem_budget_mb", "validate", "validate_every", "recovery_attempts",
+})
+
+_MESH_DATA_FIELDS = tuple(
+    f.name for f in dataclasses.fields(Mesh) if not f.metadata.get("static")
+)
+
+
+def options_fingerprint(opts) -> Tuple[str, Dict[str, str]]:
+    """(sha256 digest, field->repr dict) over the trajectory-relevant
+    option fields — the checkpoint compatibility key."""
+    fields = {
+        f.name: repr(getattr(opts, f.name))
+        for f in dataclasses.fields(opts)
+        if f.name not in _FINGERPRINT_EXCLUDE
+    }
+    blob = json.dumps(fields, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest(), fields
+
+
+def _histo_to_json(h) -> Optional[dict]:
+    if h is None:
+        return None
+    out = {}
+    for f in dataclasses.fields(h):
+        v = np.asarray(jax.device_get(getattr(h, f.name)))
+        out[f.name] = v.tolist()
+    return out
+
+
+def _histo_from_json(d: Optional[dict]):
+    if d is None:
+        return None
+    from .ops.quality import QualityHisto
+
+    return QualityHisto(**{k: jnp.asarray(np.asarray(v)) for k, v in
+                           d.items()})
+
+
+def _mesh_arrays(mesh: Mesh, prefix: str) -> Dict[str, np.ndarray]:
+    return {
+        prefix + name: np.asarray(jax.device_get(getattr(mesh, name)))
+        for name in _MESH_DATA_FIELDS
+    }
+
+
+def _mesh_static(mesh: Mesh) -> dict:
+    return dict(field_ncomp=list(mesh.field_ncomp), met_set=mesh.met_set)
+
+
+def _mesh_from_arrays(arrs, prefix: str, static: dict) -> Mesh:
+    return Mesh(
+        **{name: jnp.asarray(arrs[prefix + name])
+           for name in _MESH_DATA_FIELDS},
+        field_ncomp=tuple(static["field_ncomp"]),
+        met_set=bool(static["met_set"]),
+    )
+
+
+@dataclasses.dataclass
+class ResumeState:
+    """What `Checkpointer.load` hands back to a driver."""
+
+    it: int                      # last completed iteration
+    meshes: Dict[str, Mesh]      # "mesh" (+ "old" when fields ride along)
+    history: List[dict]
+    emult: float
+    meta: dict                   # hausd, qual_in, icap, presize_skipped...
+
+    @property
+    def mesh(self) -> Mesh:
+        return self.meshes["mesh"]
+
+
+class Checkpointer:
+    """Per-iteration atomic checkpoints under one directory.
+
+    Layout: ``ckpt_<it:05d>.npz`` (exact mesh arrays, full capacity —
+    restoring reproduces the running state bit for bit, capacities
+    included) + ``ckpt_<it:05d>.json`` (iteration, options fingerprint,
+    sweep state, history, auxiliary metadata). Both are written to a
+    temp file and published with ``os.replace`` (via
+    `io.medit.atomic_replace`), json LAST — the json is the commit
+    record, so a kill can never leave a readable-but-truncated
+    checkpoint. The latest two checkpoints are kept.
+    """
+
+    def __init__(self, dirpath: str, opts, driver: str, every: int = 1):
+        self.dir = dirpath
+        self.driver = driver
+        self.every = max(int(every), 1)
+        self.fingerprint, self.fields = options_fingerprint(opts)
+
+    # -- naming ----------------------------------------------------------
+    def _base(self, it: int) -> str:
+        return os.path.join(self.dir, f"ckpt_{it:05d}")
+
+    def _known(self) -> List[int]:
+        if not os.path.isdir(self.dir):
+            return []
+        its = []
+        for name in os.listdir(self.dir):
+            if name.startswith("ckpt_") and name.endswith(".json"):
+                try:
+                    its.append(int(name[5:-5]))
+                except ValueError:
+                    pass
+        return sorted(its)
+
+    # -- save ------------------------------------------------------------
+    def due(self, it: int) -> bool:
+        return (it + 1) % self.every == 0
+
+    def save(self, it: int, meshes: Dict[str, Mesh], *, history, emult,
+             meta: Optional[dict] = None,
+             aux_arrays: Optional[Dict[str, np.ndarray]] = None) -> None:
+        from .io.medit import atomic_replace
+
+        os.makedirs(self.dir, exist_ok=True)
+        arrs: Dict[str, np.ndarray] = {}
+        statics = {}
+        for key, m in meshes.items():
+            arrs.update(_mesh_arrays(m, key + "/"))
+            statics[key] = _mesh_static(m)
+        aux = dict(aux_arrays or {})
+        for k, v in aux.items():
+            arrs["aux/" + k] = np.asarray(jax.device_get(v))
+        base = self._base(it)
+        with atomic_replace(base + ".npz", "wb") as f:
+            np.savez(f, **arrs)
+        doc = dict(
+            format=CHECKPOINT_FORMAT,
+            driver=self.driver,
+            it=int(it),
+            fingerprint=self.fingerprint,
+            options=self.fields,
+            emult=float(emult),
+            history=history,
+            meshes=statics,
+            aux=sorted(aux),
+            meta=meta or {},
+        )
+        with atomic_replace(base + ".json", "w") as f:
+            json.dump(doc, f, default=str)
+        for old in self._known()[:-2]:
+            for ext in (".json", ".npz"):
+                try:
+                    os.unlink(self._base(old) + ext)
+                except OSError:
+                    pass
+
+    # -- load ------------------------------------------------------------
+    def load(self) -> Optional[ResumeState]:
+        """Most recent compatible checkpoint, or None when the directory
+        holds none. A checkpoint written under different options RAISES
+        :class:`CheckpointMismatchError` (silent restart would discard
+        the operator's intent); an unreadable newest checkpoint falls
+        back to the previous one."""
+        last_err = None
+        for it in reversed(self._known()):
+            base = self._base(it)
+            try:
+                with open(base + ".json") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError) as e:
+                last_err = e
+                continue
+            if doc.get("format") != CHECKPOINT_FORMAT \
+                    or doc.get("driver") != self.driver:
+                continue
+            if doc["fingerprint"] != self.fingerprint:
+                diff = sorted(
+                    k for k in set(doc.get("options", {})) | set(self.fields)
+                    if doc.get("options", {}).get(k) != self.fields.get(k)
+                )
+                raise CheckpointMismatchError(
+                    f"checkpoint {base}.json was written under "
+                    f"incompatible options (differing fields: {diff}); "
+                    "refusing to resume — delete the checkpoint "
+                    "directory or restore the original options"
+                )
+            try:
+                with np.load(base + ".npz") as z:
+                    arrs = {k: z[k] for k in z.files}
+            except (OSError, ValueError) as e:
+                last_err = e
+                continue
+            meshes = {
+                key: _mesh_from_arrays(arrs, key + "/", static)
+                for key, static in doc["meshes"].items()
+            }
+            meta = dict(doc.get("meta", {}))
+            meta["aux_arrays"] = {
+                k: arrs["aux/" + k] for k in doc.get("aux", ())
+            }
+            return ResumeState(
+                it=int(doc["it"]),
+                meshes=meshes,
+                history=list(doc["history"]),
+                emult=float(doc["emult"]),
+                meta=meta,
+            )
+        if last_err is not None:
+            import warnings
+
+            warnings.warn(
+                f"no readable checkpoint in {self.dir} "
+                f"(last error: {last_err}); starting fresh",
+                stacklevel=2,
+            )
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the harness the drivers hold
+# ---------------------------------------------------------------------------
+
+
+class FailsafeHarness:
+    """One driver run's failsafe state: validator + fault plan +
+    checkpointer + the bounded-recovery budget. Built by
+    :func:`harness`; every hook is a no-op when the corresponding
+    feature is off, so the drivers call unconditionally."""
+
+    def __init__(self, opts, driver: str,
+                 checkpoint_dir: Optional[str] = None):
+        self.validator = PhaseValidator(
+            level=getattr(opts, "validate", "basic") or "off",
+            every=int(getattr(opts, "validate_every", 1) or 1),
+        )
+        self.faults = FaultPlan.resolve(opts)
+        self.attempts = int(getattr(opts, "recovery_attempts", 0) or 0)
+        ckdir = checkpoint_dir or getattr(opts, "checkpoint_dir", None)
+        self.ckpt = (
+            Checkpointer(
+                ckdir, opts, driver,
+                every=getattr(opts, "checkpoint_every", 1),
+            )
+            if ckdir else None
+        )
+
+    @property
+    def rollback_enabled(self) -> bool:
+        return (
+            self.validator.active or self.attempts > 0
+            or self.ckpt is not None or bool(self.faults.faults)
+        )
+
+    def snapshot(self, state):
+        return snapshot(state) if self.rollback_enabled else None
+
+    def validate(self, state, it: int, *, comm=None,
+                 phase: str = "iteration") -> None:
+        self.validator.check(state, it, comm=comm, phase=phase)
+
+    def fire(self, it: int, phase: str, state):
+        """Fire pending faults at this boundary; when one poisoned the
+        state (``nan``), validate IMMEDIATELY (out of cadence) so the
+        injection is caught at its own boundary instead of being
+        churned through downstream phases first. No fault pending →
+        exactly the no-op path (no extra device work)."""
+        before = sum(f.fired for f in self.faults.faults)
+        state = self.faults.fire(it, phase, state)
+        if sum(f.fired for f in self.faults.faults) != before:
+            self.validator.check(state, it, phase=phase, force=True)
+        return state
+
+    def resume(self) -> Optional[ResumeState]:
+        return self.ckpt.load() if self.ckpt is not None else None
+
+    def save(self, it: int, meshes: Dict[str, Mesh], *, history, emult,
+             meta=None, aux_arrays=None) -> None:
+        if self.ckpt is None or not self.ckpt.due(it):
+            return
+        self.ckpt.save(it, meshes, history=history, emult=emult,
+                       meta=meta, aux_arrays=aux_arrays)
+
+    def post_iteration(self, it: int, state, history: List[dict]):
+        """Fire ``post``-phase faults after the checkpoint commit.
+        Raising kinds (retrace/overflow) are absorbed here — the
+        iteration's good state is already committed, so recovery is
+        record + clear-caches + continue, not a re-run."""
+        try:
+            return self.faults.fire(it, "post", state)
+        except (RetraceError, CapacityError) as e:
+            history.append(dict(
+                iter=it, phase="post", failure=str(e),
+                error=type(e).__name__, recovered=True,
+            ))
+            if isinstance(e, RetraceError):
+                jax.clear_caches()
+            return state
+
+
+def harness(opts, driver: str,
+            checkpoint_dir: Optional[str] = None) -> FailsafeHarness:
+    """The failsafe harness for one driver run (see
+    :class:`FailsafeHarness`)."""
+    return FailsafeHarness(opts, driver, checkpoint_dir=checkpoint_dir)

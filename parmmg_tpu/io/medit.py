@@ -16,6 +16,7 @@ very large files lives in `native/` and is used transparently when built.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import re
@@ -27,6 +28,33 @@ from ..core import tags
 from ..core.mesh import Mesh
 
 _COMMENT_RE = re.compile(r"#[^\n]*")
+
+
+@contextlib.contextmanager
+def atomic_replace(path: str, mode: str = "w"):
+    """Write-then-rename file publication: the payload goes to a
+    same-directory temp file and appears at `path` only via
+    ``os.replace`` after a successful close (+fsync), so a killed run
+    can never leave a truncated mesh/sol/checkpoint behind — a reader
+    sees either the old complete file or the new complete file. Every
+    writer in this module (and the failsafe checkpointer) publishes
+    through this."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    f = open(tmp, mode)
+    try:
+        yield f
+    except BaseException:
+        f.close()
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    else:
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
 
 # Medit sol type codes
 SOL_SCALAR = 1
@@ -277,10 +305,15 @@ def _read_sections_binary(path: str):
 
 class _BinWriter:
     """GMF version-2 writer (float64 reals, int32 ints/positions —
-    what Mmg's `MMG3D_saveMesh` emits for .meshb)."""
+    what Mmg's `MMG3D_saveMesh` emits for .meshb). Writes to a temp
+    file; `end()` publishes it atomically (`atomic_replace`
+    discipline), `abort()` discards it — the target path is never
+    observable half-written."""
 
     def __init__(self, path: str):
-        self.f = open(path, "wb")
+        self.path = path
+        self.tmp = f"{path}.tmp.{os.getpid()}"
+        self.f = open(self.tmp, "wb")
         self.f.write(np.array([1, 2], "<i4").tobytes())
 
     def _i4(self, *vals):
@@ -302,7 +335,18 @@ class _BinWriter:
 
     def end(self):
         self._i4(54, 0)
+        self.f.flush()
+        os.fsync(self.f.fileno())
         self.f.close()
+        os.replace(self.tmp, self.path)
+
+    def abort(self):
+        if not self.f.closed:
+            self.f.close()
+        try:
+            os.unlink(self.tmp)
+        except OSError:
+            pass
 
 
 def _rows_bytes(arr_i: np.ndarray, refs: np.ndarray | None,
@@ -320,22 +364,23 @@ def _save_mesh_binary(
     d: Dict[str, np.ndarray],
     comm_sections,
 ) -> None:
+    # the writer stages into a temp file (atomic_replace discipline):
+    # neither an exception nor a kill can leave a truncated .meshb at
+    # `path` — a later load would sniff the valid cookie and then fail
+    # mid-chain
+    w = _BinWriter(path)
     try:
-        _save_mesh_binary_inner(path, d, comm_sections)
-    except Exception:
-        # never leave a truncated .meshb behind: a later load would
-        # sniff the valid cookie and then fail mid-chain
-        if os.path.exists(path):
-            os.unlink(path)
+        _save_mesh_binary_inner(w, d, comm_sections)
+    except BaseException:
+        w.abort()
         raise
 
 
 def _save_mesh_binary_inner(
-    path: str,
+    w: "_BinWriter",
     d: Dict[str, np.ndarray],
     comm_sections,
 ) -> None:
-    w = _BinWriter(path)
     w.section("Dimension", b"", [3])
     verts = np.zeros(
         len(d["verts"]), np.dtype([("xyz", "<f8", (3,)), ("ref", "<i4")])
@@ -694,7 +739,7 @@ def save_mesh(
     if binary:
         _save_mesh_binary(path, d, comm_sections)
         return
-    with open(path, "w") as f:
+    with atomic_replace(path, "w") as f:
         f.write("MeshVersionFormatted 2\n\nDimension 3\n")
         _fmt_block(f, "Vertices", d["verts"], d["vrefs"], True)
         _fmt_block(f, "Tetrahedra", d["tets"], d["trefs"], True)
@@ -722,17 +767,21 @@ def save_sol(
         binary = os.path.splitext(path)[1] in (".meshb", ".solb")
     if binary:
         w = _BinWriter(path)
-        w.section("Dimension", b"", [dim])
-        payload = (
-            np.array(types, "<i4").tobytes()
-            + np.ascontiguousarray(values, "<f8").tobytes()
-        )
-        w.section(
-            "SolAtVertices", payload, [values.shape[0], len(types)]
-        )
-        w.end()
+        try:
+            w.section("Dimension", b"", [dim])
+            payload = (
+                np.array(types, "<i4").tobytes()
+                + np.ascontiguousarray(values, "<f8").tobytes()
+            )
+            w.section(
+                "SolAtVertices", payload, [values.shape[0], len(types)]
+            )
+            w.end()
+        except BaseException:
+            w.abort()
+            raise
         return
-    with open(path, "w") as f:
+    with atomic_replace(path, "w") as f:
         f.write(f"MeshVersionFormatted 2\n\nDimension {dim}\n\nSolAtVertices\n")
         f.write(f"{values.shape[0]}\n{len(types)} {' '.join(map(str, types))}\n")
         np.savetxt(f, values, fmt="%.15g")

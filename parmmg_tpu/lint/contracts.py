@@ -46,6 +46,32 @@ class RetraceBudgetExceeded(RuntimeError):
     """A phase recompiled more programs than its budget allows."""
 
 
+# the counter currently installed via `RetraceCounter.__enter__` (one at
+# a time — nesting replaces and restores). `budget_exempt` uses it to
+# route failure-recovery compiles out of the budgeted phases.
+_ACTIVE: Optional["RetraceCounter"] = None
+
+
+@contextmanager
+def budget_exempt(label: str = "failure-recovery"):
+    """Attribute compiles inside this block to a ``recovery:<label>``
+    phase instead of the current one. The failsafe layer wraps its
+    grow-and-retry / clear-caches-and-retry re-entries in this: a
+    recovery retry legitimately recompiles (capacities changed shape, or
+    the executable cache was cleared), and charging those compiles to
+    the steady phase would trip its budget for doing the right thing.
+    Recovery phases still appear in `RetraceCounter.counts`, so the
+    recompiles stay visible in BENCH/scale JSON — they are exempt from
+    budgets (unless a ``recovery:*`` budget is set explicitly), not
+    hidden."""
+    counter = _ACTIVE
+    if counter is None:
+        yield
+        return
+    with counter.phase(f"recovery:{label}"):
+        yield
+
+
 # ---------------------------------------------------------------------------
 # mesh invariants (jit-compatible)
 # ---------------------------------------------------------------------------
@@ -260,6 +286,9 @@ class RetraceCounter:
         self._phase = name
 
     def __enter__(self) -> "RetraceCounter":
+        global _ACTIVE
+        self._prev_active = _ACTIVE
+        _ACTIVE = self
         self._prev_flag = jax.config.jax_log_compiles
         jax.config.update("jax_log_compiles", True)
         self._handler = _CompileLogHandler(self)
@@ -278,6 +307,8 @@ class RetraceCounter:
         return self
 
     def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        _ACTIVE = self._prev_active
         src = logging.getLogger(_PXLA_LOGGER)
         src.removeHandler(self._handler)
         src.propagate = self._prev_prop

@@ -33,6 +33,7 @@ import numpy as np
 
 from ..core import adjacency, tags
 from ..core.mesh import Mesh, compact
+from ..failsafe import CapacityError
 from ..ops import analysis, interp, quality
 from ..parallel.distribute import (
     ShardComm,
@@ -396,15 +397,53 @@ def adapt_distributed(
     `src/libparmmg.c:1444`): preprocess → distribute → niter × [remesh
     with frozen interfaces → interpolate → rebuild comm] → global
     numbering. Use `merge_adapted` for the centralized-output path.
+
+    With `opts.checkpoint_dir` set, each iteration is checkpointed
+    atomically and a compatible checkpoint found at entry RESUMES the
+    run past the preprocess/distribute preamble (see
+    `parmmg_tpu.failsafe`).
     """
+    from .. import failsafe
+
     opts = opts or DistOptions()
     nparts = opts.nparts
+    fs = failsafe.harness(opts, driver="distributed")
+
+    resume = fs.resume()
+    if resume is not None:
+        stacked = resume.mesh
+        history: List[dict] = resume.history
+        h_in = failsafe._histo_from_json(resume.meta.get("qual_in"))
+        hausd = resume.meta.get("hausd")
+        if hausd is None and "hausd" in resume.meta.get("aux_arrays", {}):
+            hausd = jnp.asarray(
+                resume.meta["aux_arrays"]["hausd"], stacked.vert.dtype
+            )
+        if opts.verbose >= 1:
+            print(
+                f"  ## resuming from checkpoint: iteration {resume.it} "
+                f"complete, continuing at {resume.it + 1}", flush=True,
+            )
+        stacked, comm, status = _iteration_loop(
+            stacked, opts, hausd, history,
+            icap0=resume.meta.get("icap"), fs=fs,
+            start_it=resume.it + 1, emult0=resume.emult,
+            ckpt_meta=dict(qual_in=resume.meta.get("qual_in")),
+        )
+        h_out = quality.merge_stacked_histograms(
+            jax.vmap(quality.quality_histogram)(stacked)
+        )
+        info = dict(history=history, qual_in=h_in, qual_out=h_out,
+                    status=status)
+        return stacked, comm, info
 
     # --- preprocess (reference PMMG_preprocessMesh, src/libparmmg.c:128) --
     mesh = adjacency.build_adjacency(mesh)
     mesh = analysis.analyze(mesh, ang=opts.angle, opnbdy=opts.opnbdy)
+    mesh = fs.fire(0, "analysis", mesh)
     ecap0 = int(mesh.tcap * 1.6) + 64
     mesh = prepare_metric(mesh, opts, ecap0)
+    mesh = fs.fire(0, "metric", mesh)
     from .adapt import local_hausd_table
 
     hausd = local_hausd_table(mesh, opts, resolve_hausd(mesh, opts))
@@ -417,7 +456,12 @@ def adapt_distributed(
         int(mesh.ntet) < nparts * opts.min_shard_elts
         and not opts.noinsert
     ):
-        pre_opts = dataclasses.replace(opts, niter=1, hgrad=None)
+        # the pre-growth is an internal sub-run: it must not consume
+        # the outer run's fault plan or write into its checkpoint dir
+        pre_opts = dataclasses.replace(
+            opts, niter=1, hgrad=None, checkpoint_dir=None,
+            faults=failsafe.FaultPlan(),
+        )
         ne_before = int(mesh.ntet)
         mesh, pre_info = adapt_single(mesh, pre_opts)
         if int(mesh.ntet) <= ne_before:  # metric is satisfied: stop
@@ -435,8 +479,11 @@ def adapt_distributed(
     )
     stacked = _presize_for_target(stacked, opts)
 
-    history: List[dict] = []
-    stacked, comm, status = _iteration_loop(stacked, opts, hausd, history)
+    history = []
+    stacked, comm, status = _iteration_loop(
+        stacked, opts, hausd, history, fs=fs,
+        ckpt_meta=dict(qual_in=failsafe._histo_to_json(h_in)),
+    )
     h_out = quality.merge_stacked_histograms(
         jax.vmap(quality.quality_histogram)(stacked)
     )
@@ -445,21 +492,27 @@ def adapt_distributed(
     return stacked, comm, info
 
 
-def _finite_ok(stacked: Mesh) -> bool:
-    """Cheap sanity reduce at iteration boundaries (the role of the
-    reference's per-phase `MPI_Allreduce(ier, MIN)` agreement,
-    `src/libparmmg1.c:812,831`): all live coordinates/metrics finite."""
-    v_ok = jnp.all(
-        jnp.where(stacked.vmask[..., None], jnp.isfinite(stacked.vert), True)
+def _grow_stacked_for_recovery(st: Mesh, opts: DistOptions) -> Mesh:
+    """Uniform geometric growth for the CapacityError grow-and-retry
+    path of the iteration loop — budget-checked so a budget-bound run
+    degrades (MemoryBudgetError → LOWFAILURE) instead of looping."""
+    from .adapt import _check_budget
+
+    g = max(float(opts.grow_factor), 1.2)
+    want = (
+        int(st.vert.shape[1] * g) + 8,
+        int(st.tet.shape[1] * g) + 8,
+        int(st.tria.shape[1] * g) + 8,
+        int(st.edge.shape[1] * g) + 64,
     )
-    m_ok = jnp.all(
-        jnp.where(stacked.vmask[..., None], jnp.isfinite(stacked.met), True)
-    )
-    return bool(jax.device_get(v_ok & m_ok))
+    _check_budget(st, opts, *want)
+    return grow_stacked(st, *want)
 
 
 def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
-                    history: List[dict], icap0: int | None = None):
+                    history: List[dict], icap0: int | None = None,
+                    fs=None, start_it: int = 0, emult0: float | None = None,
+                    ckpt_meta: dict | None = None):
     """The niter remesh/interpolate/rebalance iterations shared by the
     centralized (`adapt_distributed`) and distributed-input
     (`adapt_stacked_input`) entry points — the `PMMG_parmmglib1` body
@@ -467,39 +520,114 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
     global ids assigned and comm tables rebuilt.
 
     Graded failure (`failed_handling`, `src/libparmmg1.c:970-1011` and
-    `PMMG_SUCCESS/LOWFAILURE/STRONGFAILURE`, `src/libparmmgtypes.h:45-66`):
-    a phase failure inside an iteration falls back to the snapshot taken
-    at that iteration's start — still a conformal, saveable mesh — and
-    returns LOWFAILURE instead of raising; only an unusable initial state
-    raises through (STRONGFAILURE is the caller's exception path).
+    `PMMG_SUCCESS/LOWFAILURE/STRONGFAILURE`, `src/libparmmgtypes.h:45-66`)
+    via the failsafe harness `fs` (`parmmg_tpu.failsafe`): each
+    iteration is validated at its boundary (the cadence-configurable
+    validator replacing the old ad-hoc `_finite_ok` — the role of the
+    reference's per-phase `MPI_Allreduce(ier, MIN)` agreement), rolled
+    back to the iteration-start snapshot on failure (still a conformal,
+    saveable mesh), retried with grown capacities (CapacityError) or
+    cleared caches (RetraceError) up to `opts.recovery_attempts` times,
+    and checkpointed atomically when `opts.checkpoint_dir` is set.
+    Anything unrecovered degrades to LOWFAILURE; only an unusable
+    initial state raises through (STRONGFAILURE is the caller's
+    exception path). Every absorbed failure appends a ``failure`` entry
+    to `history`.
     """
+    from .. import failsafe
+    from ..lint import contracts
+
+    if fs is None:
+        fs = failsafe.harness(opts, driver="distributed")
     nparts = opts.nparts
-    emult = [1.6]
+    emult = [emult0 if emult0 is not None else 1.6]
     icap = icap0
     comm = None
     status = tags.ReturnStatus.SUCCESS
-    last_good = stacked
-    for it in range(opts.niter):
-        try:
-            stacked, comm, icap = _one_iteration(
-                stacked, opts, hausd, history, it, comm, icap, emult,
-                nparts,
+    last_good = fs.snapshot(stacked)
+    it = start_it
+    attempts = 0
+    while it < opts.niter:
+
+        def _iteration(st, cm, ic):
+            st, cm, ic = _one_iteration(
+                st, opts, hausd, history, it, cm, ic, emult, nparts,
+                fs=fs,
             )
-            if not _finite_ok(stacked):
-                raise FloatingPointError(
-                    f"non-finite coordinates/metric after iteration {it}"
-                )
-            last_good = stacked
+            fs.validate(st, it, comm=cm, phase="iteration")
+            return st, cm, ic
+
+        try:
+            if attempts:
+                # recovery re-entry: recompiles (grown shapes / cleared
+                # caches) land in a recovery phase, exempt from the
+                # steady retrace budgets
+                with contracts.budget_exempt("iteration-retry"):
+                    stacked, comm, icap = _iteration(stacked, comm, icap)
+            else:
+                stacked, comm, icap = _iteration(stacked, comm, icap)
+        except failsafe.CapacityError as e:
+            history.append(dict(iter=it, phase="iteration",
+                                failure=str(e), error=type(e).__name__))
+            if last_good is None:
+                raise
+            stacked = failsafe.snapshot(last_good)
+            comm = None
+            icap = None
+            if attempts < fs.attempts:
+                attempts += 1
+                try:
+                    stacked = _grow_stacked_for_recovery(stacked, opts)
+                except failsafe.MemoryBudgetError as e2:
+                    history.append(dict(iter=it, failure=str(e2),
+                                        error=type(e2).__name__))
+                    status = tags.ReturnStatus.LOWFAILURE
+                    break
+                continue
+            status = tags.ReturnStatus.LOWFAILURE
+            break
+        except failsafe.RetraceError as e:
+            history.append(dict(iter=it, phase="iteration",
+                                failure=str(e), error=type(e).__name__))
+            if last_good is None:
+                raise
+            stacked = failsafe.snapshot(last_good)
+            comm = None
+            icap = None
+            if attempts < fs.attempts:
+                attempts += 1
+                jax.clear_caches()
+                continue
+            status = tags.ReturnStatus.LOWFAILURE
+            break
         except (FloatingPointError, ValueError, RuntimeError,
                 OverflowError) as e:
-            # numeric/capacity failures degrade gracefully; programming
-            # errors (TypeError, trace errors, ...) propagate — hiding
-            # them as LOWFAILURE would mask defects
-            history.append(dict(iter=it, failure=str(e)))
-            stacked = last_good
+            # numeric/capacity/budget failures degrade gracefully;
+            # programming errors (TypeError, trace errors, ...)
+            # propagate — hiding them as LOWFAILURE would mask defects
+            history.append(dict(iter=it, failure=str(e),
+                                error=type(e).__name__))
+            if last_good is None:
+                raise
+            stacked = failsafe.snapshot(last_good)
             status = tags.ReturnStatus.LOWFAILURE
+            comm = None
             icap = None
             break
+        attempts = 0
+        last_good = fs.snapshot(stacked)
+        if fs.ckpt is not None and fs.ckpt.due(it):
+            meta = dict(ckpt_meta or {})
+            meta["icap"] = int(icap) if icap is not None else None
+            aux = {}
+            if isinstance(hausd, (int, float)):
+                meta["hausd"] = float(hausd)
+            else:
+                aux["hausd"] = hausd
+            fs.save(it, {"mesh": stacked}, history=history,
+                    emult=emult[0], meta=meta, aux_arrays=aux)
+        stacked = fs.post_iteration(it, stacked, history)
+        it += 1
 
     stacked = assign_global_ids(stacked)
     comm = rebuild_comm(stacked, icap)
@@ -507,16 +635,22 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
 
 
 def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
-                   nparts):
+                   nparts, fs=None):
+    if fs is None:
+        from .. import failsafe
+
+        fs = failsafe.harness(opts, driver="distributed")
     # snapshot for interpolation (PMMG_update_oldGrps role,
     # src/grpsplit_pmmg.c:1224) — needs fresh adjacency for the walk
     old = jax.vmap(adjacency.build_adjacency)(stacked)
 
     stacked = remesh_phase(stacked, opts, emult, history, it, hausd)
     stacked = jax.vmap(compact)(stacked)
+    stacked = fs.fire(it, "remesh", stacked)
 
     # interpolate metric + fields from the snapshot
     stacked = interp_phase(stacked, old, opts)
+    stacked = fs.fire(it, "interp", stacked)
 
     if opts.check_comm:
         from ..parallel import chkcomm
@@ -549,7 +683,9 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
     last = it == opts.niter - 1
     if not opts.nobalancing and nparts > 1:
         from ..parallel import migrate as migrate_mod
+        from ..utils.retry import jit_retry
 
+        stacked = fs.fire(it, "migrate", stacked)
         stacked = assign_global_ids(stacked)
         comm = rebuild_comm(stacked, icap)
         stacked = jax.vmap(adjacency.build_adjacency)(stacked)
@@ -559,8 +695,9 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
         if graph_mode:
             color = partition_mod.stacked_graph_colors(stacked, nparts)
         else:
-            color = migrate_mod.displace_colors(
-                stacked, comm, nparts, round_id=0, layers=opts.ifc_layers
+            color = jit_retry(
+                migrate_mod.displace_colors, stacked, comm, nparts,
+                round_id=0, layers=opts.ifc_layers,
             )
         cnts = np.asarray(jax.device_get(
             migrate_mod.migration_counts(stacked, color, nparts)
@@ -593,6 +730,11 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
             stacked = _presize_for_target(stacked, opts)
         elif cnts.max() > 0:
             slot_cap = int(cnts.max()) + 8
+            if fs.faults.take(it, "migrate", "overflow"):
+                # injected fault: undershoot the real slot capacity so
+                # the genuine CapacityError raise site and the genuine
+                # grow-and-retry recovery below are what run
+                slot_cap = 1
             # headroom for incoming entities before the exchange
             pc = stacked.vert.shape[1]
             tc = stacked.tet.shape[1]
@@ -622,17 +764,60 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
                     color = jnp.pad(
                         color, ((0, 0), (0, pad)), constant_values=-1
                     )
-            try:
-                stacked = migrate_mod.migrate(
-                    stacked, color, nparts, slot_cap
-                )
-            except RuntimeError:
-                # capacity estimate fell short: full re-cut fallback
+            # bounded grow-and-retry on the typed CapacityError
+            # (reference reallocation ladder role): the error carries
+            # the per-shard/per-entity overflow scalars, so each retry
+            # is sized exactly; only repeated misses fall back to the
+            # host full re-cut
+            moved = None
+            for att in range(3):
+                try:
+                    moved = migrate_mod.migrate(
+                        stacked, color, nparts, slot_cap
+                    )
+                    break
+                except CapacityError as e:
+                    history.append(dict(
+                        iter=it, phase="migrate", failure=str(e),
+                        error=type(e).__name__, recovered=True,
+                    ))
+                    if att == 2:
+                        break
+                    if e.counts is not None:
+                        # pack-side slot undershoot: the true
+                        # per-destination max is in the error
+                        slot_cap = int(e.counts.max()) + 8
+                    if e.overflow is not None:
+                        # integrate-side shard overflow: grow each
+                        # entity by its measured excess (+30%)
+                        over = np.maximum(
+                            np.asarray(e.overflow), 0
+                        ).max(axis=0)
+                        stacked = grow_stacked(
+                            stacked,
+                            pcap=stacked.vert.shape[1]
+                            + int(over[0] * 1.3) + 8,
+                            tcap=stacked.tet.shape[1]
+                            + int(over[1] * 1.3) + 8,
+                            fcap=stacked.tria.shape[1]
+                            + int(over[2] * 1.3) + 8,
+                            ecap=stacked.edge.shape[1]
+                            + int(over[3] * 1.3) + 64,
+                        )
+                        pad = stacked.tet.shape[1] - color.shape[1]
+                        if pad:
+                            color = jnp.pad(
+                                color, ((0, 0), (0, pad)),
+                                constant_values=-1,
+                            )
+            if moved is None:
+                # capacity estimates kept falling short: full re-cut
+                # fallback (the pre-existing degradation)
                 stacked, comm = _rebalance_full(stacked, comm, nparts)
                 icap = None
                 stacked = _presize_for_target(stacked, opts)
             else:
-                stacked = jax.vmap(compact)(stacked)
+                stacked = jax.vmap(compact)(moved)
                 stacked, comm = migrate_mod.retag_interfaces(stacked)
                 icap = comm.icap
                 stacked = _presize_for_target(stacked, opts)
@@ -669,8 +854,32 @@ def adapt_stacked_input(
 
     Returns (stacked, comm, info) like `adapt_distributed`.
     """
+    from .. import failsafe
+
     opts = opts or DistOptions()
     opts = dataclasses.replace(opts, nparts=stacked.vert.shape[0])
+    fs = failsafe.harness(opts, driver="distributed-input")
+
+    resume = fs.resume()
+    if resume is not None:
+        st = resume.mesh
+        history: List[dict] = resume.history
+        h_in = failsafe._histo_from_json(resume.meta.get("qual_in"))
+        hausd = resume.meta.get("hausd")
+        if hausd is None and "hausd" in resume.meta.get("aux_arrays", {}):
+            hausd = jnp.asarray(
+                resume.meta["aux_arrays"]["hausd"], st.vert.dtype
+            )
+        st, comm, status = _iteration_loop(
+            st, opts, hausd, history, icap0=resume.meta.get("icap"),
+            fs=fs, start_it=resume.it + 1, emult0=resume.emult,
+            ckpt_meta=dict(qual_in=resume.meta.get("qual_in")),
+        )
+        h_out = quality.merge_stacked_histograms(
+            jax.vmap(quality.quality_histogram)(st)
+        )
+        return st, comm, dict(history=history, qual_in=h_in,
+                              qual_out=h_out, status=status)
 
     # per-shard preprocess: adjacency + analysis + metric, then the
     # cross-shard feature agreement pass for surface edges split by an
@@ -706,13 +915,14 @@ def adapt_stacked_input(
     )
 
     stacked = _presize_for_target(stacked, opts)
-    history: List[dict] = []
+    history = []
     # the supplied comm's tables stay valid in shape (interfaces are
     # frozen, shared lists can only shrink): reuse its capacity so the
     # rebuilt tables keep a stable static shape across iterations
     stacked, comm, status = _iteration_loop(
         stacked, opts, hausd, history,
         icap0=comm.icap if comm is not None else None,
+        fs=fs, ckpt_meta=dict(qual_in=failsafe._histo_to_json(h_in)),
     )
     h_out = quality.merge_stacked_histograms(
         jax.vmap(quality.quality_histogram)(stacked)

@@ -33,6 +33,10 @@ import numpy as np
 from ..core import tags
 from ..core.mesh import FACE_VERTS, Mesh
 from ..core.adjacency import build_adjacency
+# promoted to utils.retry (PR 3) so every host-side jitted entry point
+# shares the clear-caches-and-retry discipline; alias kept for the
+# in-module call sites
+from ..utils.retry import jit_retry as _jit_retry
 from . import common
 
 # default feature-detection dihedral angle, degrees (the reference's
@@ -89,22 +93,6 @@ def _missing_face_info(mesh: Mesh):
     return need, jnp.sum(need.astype(jnp.int32))
 
 
-def _jit_retry(fn, *args):
-    """Invoke a jitted fn, retrying once after `jax.clear_caches()` on
-    the jax-0.9.0 executable/buffer mismatch ("Executable expected
-    parameter N of size X but got buffer with incompatible size Y"):
-    a stale cached executable occasionally receives a misaligned
-    argument list on re-invocation (observed only on the CPU backend,
-    sequence-dependent). Clearing the executable cache and recompiling
-    always recovers; the retry keeps long-running CLI/library sessions
-    alive."""
-    try:
-        return fn(*args)
-    except ValueError as e:
-        if "Executable expected parameter" not in str(e):
-            raise
-        jax.clear_caches()
-        return fn(*args)
 
 
 def synthesize_boundary_trias(mesh: Mesh) -> Mesh:

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core.mesh import Mesh
+from ..utils.retry import jit_retry
 from .comm import halo_exchange
 from .distribute import ShardComm
 from .shard import AXIS, _squeeze
@@ -80,7 +81,9 @@ def check_node_comm(
     scalars; all zero/small means the tables are coherent.
     """
     f = _node_comm_checker(dmesh)
-    max_err, gid_mm, cnt_mm, val_mm = f(stacked, comm.comm_idx, comm.l2g)
+    max_err, gid_mm, cnt_mm, val_mm = jit_retry(
+        f, stacked, comm.comm_idx, comm.l2g
+    )
     return dict(
         max_coord_err=float(max_err),
         gid_mismatch=int(gid_mm),
@@ -191,8 +194,8 @@ def check_face_edge_comm(stacked: Mesh, comm: ShardComm, dmesh) -> dict:
     the same barycenter/midpoint. Returns dict(face_count_bad,
     max_face_bc_err, max_edge_mid_err, edge_tag_mismatch).
     """
-    face_err, face_bad, edge_err, tag_mm = _face_edge_checker(dmesh)(
-        stacked, comm.l2g
+    face_err, face_bad, edge_err, tag_mm = jit_retry(
+        _face_edge_checker(dmesh), stacked, comm.l2g
     )
     return dict(
         max_face_bc_err=float(face_err),

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..core import adjacency, tags
 from ..core.mesh import FACE_VERTS, Mesh
+from ..utils.retry import jit_retry
 
 
 @jax.tree_util.register_dataclass
@@ -376,8 +377,9 @@ def rebuild_comm(stacked: Mesh, icap: int | None = None) -> ShardComm:
     want_icap = icap
     while True:
         use_icap = want_icap if want_icap is not None else kv
-        comm_idx, counts, l2g, owner, need, _ = _rebuild_comm_device(
-            stacked.vglob, stacked.vmask, stacked.vtag, kv, use_icap
+        comm_idx, counts, l2g, owner, need, _ = jit_retry(
+            _rebuild_comm_device,
+            stacked.vglob, stacked.vmask, stacked.vtag, kv, use_icap,
         )
         need = int(need)
         if need <= use_icap:
@@ -391,8 +393,9 @@ def rebuild_comm(stacked: Mesh, icap: int | None = None) -> ShardComm:
         # interface is split among all its neighbors)
         tight = _pow2_at_least(max(need, 1))
         if tight < use_icap:
-            comm_idx, counts, l2g, owner, _, _ = _rebuild_comm_device(
-                stacked.vglob, stacked.vmask, stacked.vtag, kv, tight
+            comm_idx, counts, l2g, owner, _, _ = jit_retry(
+                _rebuild_comm_device,
+                stacked.vglob, stacked.vmask, stacked.vtag, kv, tight,
             )
     return ShardComm(
         comm_idx=comm_idx, counts=counts, l2g=l2g, owner=owner
@@ -422,6 +425,11 @@ def assign_global_ids(stacked: Mesh) -> Mesh:
     new vertex is strictly interior to its shard (interfaces are frozen),
     so numbering is an exclusive scan of per-shard new-vertex counts on
     top of the current global max; no halo agreement is required.
+
+    Not routed through `utils.retry.jit_retry`: the device fn donates
+    its input buffers, so a second invocation after a transient failure
+    could see already-deleted arrays — for donating entry points the
+    retry lives at the iteration level (failsafe RetraceError recovery).
     """
     return _assign_gids_device(stacked)
 

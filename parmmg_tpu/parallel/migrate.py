@@ -40,7 +40,9 @@ import numpy as np
 
 from ..core import adjacency, tags
 from ..core.mesh import FACE_VERTS, Mesh
+from ..failsafe import CapacityError
 from ..ops import common
+from ..utils.retry import jit_retry
 from .distribute import ShardComm, rebuild_comm
 
 
@@ -388,7 +390,7 @@ def _pack(stacked: Mesh, color: jax.Array, slot_cap: int,
         # one pass per destination (D is small and static)
         for dst in range(d):
             sel = out_s & (color_s == dst)
-            n_t = n_t.at[dst].set(jnp.sum(sel.astype(jnp.int32)))
+            n_t = n_t.at[dst].set(jnp.sum(sel, dtype=jnp.int32))
             rank = jnp.cumsum(sel.astype(jnp.int32)) - 1
             tgt = common.unique_oob(sel, rank, slot_cap)
             buf_ti = buf_ti.at[dst].set(
@@ -430,7 +432,7 @@ def _pack(stacked: Mesh, color: jax.Array, slot_cap: int,
             d1 = (cnt >= 1) & out_s[own1] & (color_s[own1] == dst)
             d2 = (cnt >= 2) & out_s[own2] & (color_s[own2] == dst)
             sel = real_tr & (d1 | d2)
-            n_f = n_f.at[dst].set(jnp.sum(sel.astype(jnp.int32)))
+            n_f = n_f.at[dst].set(jnp.sum(sel, dtype=jnp.int32))
             rank = jnp.cumsum(sel.astype(jnp.int32)) - 1
             tgt = common.unique_oob(sel, rank, tria_cap)
             buf_fi = buf_fi.at[dst].set(
@@ -461,7 +463,7 @@ def _pack(stacked: Mesh, color: jax.Array, slot_cap: int,
             idx = jnp.where(selt[:, None], m.tet, pcap)
             vd = vd.at[idx.reshape(-1)].set(True, mode="drop")
             sel = m.edmask & vd[m.edge[:, 0]] & vd[m.edge[:, 1]]
-            n_e = n_e.at[dst].set(jnp.sum(sel.astype(jnp.int32)))
+            n_e = n_e.at[dst].set(jnp.sum(sel, dtype=jnp.int32))
             rank = jnp.cumsum(sel.astype(jnp.int32)) - 1
             tgt = common.unique_oob(sel, rank, edge_cap)
             buf_ei = buf_ei.at[dst].set(
@@ -524,7 +526,9 @@ def _integrate(stacked: Mesh, out_t, rti, rtf, rfi, rei, tria_keep,
         loc = common.match_rows(lkeys, q)                   # [4K] or -1
         isnew_rep = rep_sorted & (loc < 0)
         nrank = jnp.cumsum(isnew_rep.astype(jnp.int32)) - 1
-        np0 = m.npoin
+        # int32-pinned live counts: npoin/ntet/... reduce to int64
+        # under x64 and would widen every slot scatter below
+        np0 = jnp.asarray(m.npoin, jnp.int32)
         slot_rep = jnp.where(isnew_rep, np0 + nrank, loc)   # [4K] sorted
         # per-group slot, then back to original corner order
         gslot = jnp.full(4 * k, -1, jnp.int32).at[
@@ -566,7 +570,7 @@ def _integrate(stacked: Mesh, out_t, rti, rtf, rfi, rei, tria_keep,
 
         # ---- tets ------------------------------------------------------
         cs4 = corner_slot.reshape(k, 4)
-        ne0 = m.ntet
+        ne0 = jnp.asarray(m.ntet, jnp.int32)
         trank = jnp.cumsum(t_valid.astype(jnp.int32)) - 1
         tgt_t = common.unique_oob(t_valid, ne0 + trank, tcap)
         tet = common.scatter_rows(m.tet, tgt_t, cs4, unique=True)
@@ -602,7 +606,7 @@ def _integrate(stacked: Mesh, out_t, rti, rtf, rfi, rei, tria_keep,
         # kept trias stay in place (mask only); appends go after the
         # pre-migration live prefix — compact() later repacks
         frank = jnp.cumsum(f_add.astype(jnp.int32)) - 1
-        free0 = m.ntria  # append after current live prefix
+        free0 = jnp.asarray(m.ntria, jnp.int32)  # append after live prefix
         tgt_f = common.unique_oob(f_add, free0 + frank, fcap)
         tria = common.scatter_rows(m.tria, tgt_f, fslot, unique=True)
         trref = m.trref.at[tgt_f].set(fi[:, 3], **kwu)
@@ -631,7 +635,9 @@ def _integrate(stacked: Mesh, out_t, rti, rtf, rfi, rei, tria_keep,
         ).reshape(-1, 2)
         e_add = e_add & jnp.all(eslot >= 0, axis=1)
         erank = jnp.cumsum(e_add.astype(jnp.int32)) - 1
-        tgt_e = common.unique_oob(e_add, m.nedge + erank, ecap)
+        tgt_e = common.unique_oob(
+            e_add, jnp.asarray(m.nedge, jnp.int32) + erank, ecap
+        )
         edge = common.scatter_rows(m.edge, tgt_e, eslot, unique=True)
         edref = m.edref.at[tgt_e].set(ei[:, 2], **kwu)
         edtag = m.edtag.at[tgt_e].set(ei[:, 3], **kwu)
@@ -643,7 +649,8 @@ def _integrate(stacked: Mesh, out_t, rti, rtf, rfi, rei, tria_keep,
             np0 + jnp.sum(wnew.astype(jnp.int32)) - pcap,
             ne0 + jnp.sum(t_valid.astype(jnp.int32)) - tcap,
             free0 + jnp.sum(f_add.astype(jnp.int32)) - fcap,
-            m.nedge + jnp.sum(e_add.astype(jnp.int32)) - ecap,
+            jnp.asarray(m.nedge, jnp.int32)
+            + jnp.sum(e_add.astype(jnp.int32)) - ecap,
         ])
         return m.replace(
             vert=vert, met=met, ls=ls, disp=disp, fields=fields,
@@ -666,31 +673,35 @@ def migrate(stacked: Mesh, color: jax.Array, nparts: int,
     growth decision)."""
     tria_cap = slot_cap + 8
     edge_cap = max(slot_cap // 2, 64)
-    (bti, btf, bfi, bei, tria_keep, edge_keep, pack_n), out_t = _pack(
-        stacked, color, slot_cap, tria_cap, edge_cap
+    (bti, btf, bfi, bei, tria_keep, edge_keep, pack_n), out_t = jit_retry(
+        _pack, stacked, color, slot_cap, tria_cap, edge_cap
     )
     # pack-side overflow check: a slot cap that undershoots would DROP
     # outgoing entities (their source copies are already released), so
-    # verify the true per-destination counts before anything is applied
+    # verify the true per-destination counts before anything is applied.
+    # The typed CapacityError carries the counts/caps the grow-and-retry
+    # loop in the distributed driver needs to size the retry exactly.
     pn = np.asarray(jax.device_get(pack_n))      # [D, 3(kind), D(dst)]
-    caps = np.asarray([slot_cap, tria_cap, edge_cap])[None, :, None]
-    if (pn > caps).any():
-        raise RuntimeError(
+    caps = np.asarray([slot_cap, tria_cap, edge_cap])
+    if (pn > caps[None, :, None]).any():
+        raise CapacityError(
             "migration slot capacities too small (per-source max "
             f"[tets,trias,edges]: {pn.max(axis=(0, 2)).tolist()} vs caps "
-            f"{caps.ravel().tolist()}) — raise slot_cap"
+            f"{caps.tolist()}) — raise slot_cap",
+            counts=pn, caps=caps,
         )
     rti, rtf, rfi, rei = (
         _exchange(bti), _exchange(btf), _exchange(bfi), _exchange(bei)
     )
-    out, overflow = _integrate(stacked, out_t, rti, rtf, rfi, rei,
-                               tria_keep, edge_keep)
+    out, overflow = jit_retry(_integrate, stacked, out_t, rti, rtf, rfi,
+                              rei, tria_keep, edge_keep)
     over = np.asarray(jax.device_get(overflow))
     if (over > 0).any():
-        raise RuntimeError(
+        raise CapacityError(
             "migration overflowed shard capacities "
             f"(excess per shard [verts,tets,trias,edges]: {over.tolist()})"
-            " — grow the stacked mesh before migrating"
+            " — grow the stacked mesh before migrating",
+            overflow=over,
         )
     return out
 
@@ -878,16 +889,24 @@ def retag_interfaces(stacked: Mesh, icap=None) -> Tuple[Mesh, ShardComm]:
     fcapq = min(4 * TC, max(2048, TC))  # 4*TC = exact upper bound
     for _ in range(2):
         (vtag, tria, trref, trtag, trmask,
-         n_open, n_missing, n_free) = _retag_device_core(stacked, fcapq)
+         n_open, n_missing, n_free) = jit_retry(
+            _retag_device_core, stacked, fcapq
+        )
         mx = int(jax.device_get(jnp.max(n_open)))
         if mx <= fcapq:
             break
         fcapq = 4 * TC  # every tet face open
     over = np.asarray(jax.device_get(n_missing > n_free))
     if over.any():
-        raise RuntimeError(
+        raise CapacityError(
             "tria capacity too small for interface trias "
-            f"(shards {np.nonzero(over)[0].tolist()})"
+            f"(shards {np.nonzero(over)[0].tolist()})",
+            overflow=np.stack([
+                np.zeros_like(np.asarray(n_missing)),
+                np.zeros_like(np.asarray(n_missing)),
+                np.asarray(jax.device_get(n_missing - n_free)),
+                np.zeros_like(np.asarray(n_missing)),
+            ], axis=1),
         )
     stacked = stacked.replace(
         vtag=vtag, tria=tria, trref=trref, trtag=trtag, trmask=trmask,
@@ -1014,7 +1033,7 @@ def _retag_interfaces_host(stacked: Mesh, icap=None) -> Tuple[Mesh, ShardComm]:
             continue
         free = np.nonzero(~trmask[s])[0]
         if need > len(free):
-            raise RuntimeError(
+            raise CapacityError(
                 f"tria capacity too small for {need} interface trias"
             )
         sel = free[:need]

@@ -1,0 +1,42 @@
+"""Clear-caches-and-retry for transient XLA/executable errors.
+
+Promoted from `ops.analysis._jit_retry`: on this jaxlib (0.9.0-era CPU
+backend) a stale cached executable occasionally receives a misaligned
+argument list on re-invocation ("Executable expected parameter N of
+size X but got buffer with incompatible size Y" — sequence-dependent,
+observed only on the CPU backend). Clearing the executable cache and
+recompiling always recovers, so every host-side jitted entry point
+(analysis, distribute/migrate/chkcomm factories) funnels its first
+invocation through :func:`jit_retry` to keep long-running CLI/library
+sessions alive. The failsafe layer treats the same class as
+`failsafe.RetraceError` when it escapes anyway.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# substrings identifying the transient executable/buffer mismatch class
+TRANSIENT_XLA_MARKERS = ("Executable expected parameter",)
+
+
+def is_transient_xla_error(exc: BaseException) -> bool:
+    """True for the stale-executable error class that a cache clear +
+    recompile reliably fixes."""
+    msg = str(exc)
+    return isinstance(exc, ValueError) and any(
+        m in msg for m in TRANSIENT_XLA_MARKERS
+    )
+
+
+def jit_retry(fn, *args, **kwargs):
+    """Invoke a jitted fn, retrying once after ``jax.clear_caches()``
+    when the transient executable/buffer mismatch fires. Anything else
+    propagates unchanged."""
+    try:
+        return fn(*args, **kwargs)
+    except ValueError as e:
+        if not is_transient_xla_error(e):
+            raise
+        jax.clear_caches()
+        return fn(*args, **kwargs)

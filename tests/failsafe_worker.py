@@ -1,0 +1,54 @@
+"""Subprocess worker for the kill/resume (simulated preemption) test.
+
+Mirrors conftest's hermetic-CPU environment dance, then runs a small
+centralized adaptation with checkpointing under a PARMMG_FAULTS plan
+that kills the process (os._exit(failsafe.KILL_EXIT_CODE)) at an
+iteration boundary. The parent test asserts the exit code, then resumes
+from the checkpoint directory in-process and compares against an
+uninterrupted run.
+
+Usage: python failsafe_worker.py <checkpoint_dir>
+"""
+
+import os
+import sys
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+for _accel in ("axon", "tpu", "cuda", "rocm"):
+    _xb._backend_factories.pop(_accel, None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parmmg_tpu.models.adapt import AdaptOptions, adapt  # noqa: E402
+from parmmg_tpu.utils.gen import unit_cube_mesh  # noqa: E402
+
+# KEEP IN SYNC with test_m13_failsafe.C_OPTS: the resume in the
+# parent process must produce a matching options fingerprint.
+OPTS = dict(hsiz=0.35, niter=2, max_sweeps=4, hgrad=None,
+            polish_sweeps=0)
+
+
+def main() -> None:
+    ckdir = sys.argv[1]
+    mesh = unit_cube_mesh(3)
+    # the PARMMG_FAULTS env (set by the parent) kills this process at
+    # the scheduled iteration boundary — after the checkpoint commit
+    adapt(mesh, AdaptOptions(**OPTS), checkpoint_dir=ckdir)
+    # reaching here means the fault plan did not fire
+    print("worker finished without being killed", flush=True)
+    sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

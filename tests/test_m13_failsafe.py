@@ -1,0 +1,333 @@
+"""M13: fail-safe layer — graded failure, checkpoint/resume, fault
+injection (`parmmg_tpu.failsafe`, the `failed_handling` /
+checkpoint-restart role of reference `src/libparmmg1.c:970-1011`).
+
+Covers the acceptance matrix: for each injected fault class (NaN,
+capacity overflow, forced retrace, simulated preemption) x each driver
+(centralized, distributed), the run returns a documented ReturnStatus
+with a conformal, saveable mesh and a ``failure`` entry in
+info.history — never an unhandled exception or a truncated file. Plus
+the previously-untested LOWFAILURE snapshot-rollback branch of
+`models/distributed._iteration_loop` (now the shared validator path),
+kill-and-resume equivalence, and fingerprint-mismatch refusal.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from parmmg_tpu import failsafe
+from parmmg_tpu.core.tags import ReturnStatus
+from parmmg_tpu.io import medit
+from parmmg_tpu.models.adapt import AdaptOptions, adapt
+from parmmg_tpu.models.distributed import (
+    DistOptions,
+    adapt_distributed,
+    merge_adapted,
+)
+from parmmg_tpu.parallel.distribute import unstack_mesh
+from parmmg_tpu.utils.conformity import check_mesh
+from parmmg_tpu.utils.gen import unit_cube_mesh
+
+# KEEP IN SYNC with failsafe_worker.OPTS (fingerprint compatibility)
+C_OPTS = dict(hsiz=0.35, niter=2, max_sweeps=4, hgrad=None,
+              polish_sweeps=0)
+D_OPTS = dict(hsiz=0.32, niter=2, max_sweeps=4, nparts=2,
+              min_shard_elts=8, hgrad=None, polish_sweeps=0)
+
+
+def _key(mesh, info):
+    """Mesh counts + quality-histogram fingerprint of a result."""
+    h = info["qual_out"]
+    return (
+        int(np.asarray(jax.device_get(mesh.vmask)).sum()),
+        int(np.asarray(jax.device_get(mesh.tmask)).sum()),
+        tuple(int(x) for x in np.asarray(jax.device_get(h.counts))),
+    )
+
+
+def _failures(info):
+    return [r for r in info["history"] if "failure" in r]
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan grammar + validator unit coverage (cheap, no adapt run)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_grammar():
+    plan = failsafe.FaultPlan.parse(
+        "it1:remesh:nan, it2:migrate:overflow,it1:post:kill"
+    )
+    assert [(f.it, f.phase, f.kind) for f in plan.faults] == [
+        (1, "remesh", "nan"), (2, "migrate", "overflow"),
+        (1, "post", "kill"),
+    ]
+    assert plan.take(2, "migrate", "overflow")
+    assert not plan.take(2, "migrate", "overflow")  # fires once
+    for bad in ("1:remesh:nan", "it1:bogus:nan", "it1:remesh:bogus",
+                "it1:remesh"):
+        with pytest.raises(ValueError):
+            failsafe.FaultPlan.parse(bad)
+
+
+def test_validator_catches_poison_and_cadence():
+    m = unit_cube_mesh(2)
+    v = failsafe.PhaseValidator(level="basic", every=1)
+    v.check(m, 0)  # clean mesh passes
+    bad = m.replace(vert=m.vert.at[0].set(float("nan")))
+    with pytest.raises(failsafe.NumericalError, match="non-finite"):
+        v.check(bad, 0)
+    # cadence: iteration 0 of every=2 is not due; level off never is
+    failsafe.PhaseValidator(level="basic", every=2).check(bad, 0)
+    failsafe.PhaseValidator(level="off").check(bad, 0)
+    # full level runs the host conformity check too
+    v_full = failsafe.PhaseValidator(level="full", every=1)
+    v_full.check(m, 0)
+
+
+def test_options_fingerprint_resume_safe_fields():
+    a = AdaptOptions(hsiz=0.3, niter=2)
+    fp_a, _ = failsafe.options_fingerprint(a)
+    # niter / verbose / checkpointing knobs are resume-safe
+    assert failsafe.options_fingerprint(
+        AdaptOptions(hsiz=0.3, niter=7, verbose=2,
+                     checkpoint_dir="/x")
+    )[0] == fp_a
+    # trajectory knobs are not
+    assert failsafe.options_fingerprint(
+        AdaptOptions(hsiz=0.25, niter=2)
+    )[0] != fp_a
+
+
+# ---------------------------------------------------------------------------
+# atomic writes (satellite: io/medit tmp + os.replace)
+# ---------------------------------------------------------------------------
+
+
+def test_save_mesh_atomic_no_truncation(tmp_path, monkeypatch):
+    m = unit_cube_mesh(2)
+    path = str(tmp_path / "out.mesh")
+    medit.save_mesh(m, path)
+    before = open(path).read()
+
+    calls = []
+    orig = medit._fmt_block
+
+    def boom(f, name, *a, **kw):
+        calls.append(name)
+        if name == "Tetrahedra":
+            raise IOError("injected mid-write failure")
+        return orig(f, name, *a, **kw)
+
+    monkeypatch.setattr(medit, "_fmt_block", boom)
+    with pytest.raises(IOError, match="injected"):
+        medit.save_mesh(m, path)
+    monkeypatch.setattr(medit, "_fmt_block", orig)
+    # the failed write left neither a truncated target nor temp litter
+    assert open(path).read() == before
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+def test_save_meshb_atomic_no_truncation(tmp_path):
+    m = unit_cube_mesh(2)
+    path = str(tmp_path / "out.meshb")
+    medit.save_mesh(m, path)
+    m2 = medit.load_mesh(path)
+    assert int(m2.ntet) == int(m.ntet)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+# ---------------------------------------------------------------------------
+# centralized driver: fault matrix + checkpoint/resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ref_centralized():
+    out, info = adapt(unit_cube_mesh(3), AdaptOptions(**C_OPTS))
+    assert info["status"] == ReturnStatus.SUCCESS
+    return _key(out, info)
+
+
+@pytest.mark.parametrize("fault,expect", [
+    ("it1:remesh:nan", ReturnStatus.LOWFAILURE),
+    ("it0:remesh:overflow", ReturnStatus.SUCCESS),
+])
+def test_fault_matrix_centralized(tmp_path, fault, expect):
+    out, info = adapt(
+        unit_cube_mesh(3), AdaptOptions(faults=fault, **C_OPTS)
+    )
+    assert info["status"] == expect
+    assert _failures(info), "absorbed fault must leave a history entry"
+    assert check_mesh(out, check_boundary=False).ok
+    medit.save_mesh(out, str(tmp_path / "out.mesh"))  # saveable
+
+
+def test_checkpoint_resume_equivalence_centralized(tmp_path,
+                                                   ref_centralized):
+    ck = str(tmp_path / "ck")
+    # partial run (one iteration), then resume with the full budget:
+    # niter is a resume-safe option by design
+    adapt(unit_cube_mesh(3),
+          AdaptOptions(**dict(C_OPTS, niter=1)), checkpoint_dir=ck)
+    assert sorted(os.listdir(ck)) == ["ckpt_00000.json",
+                                      "ckpt_00000.npz"]
+    out, info = adapt(unit_cube_mesh(3), AdaptOptions(**C_OPTS),
+                      checkpoint_dir=ck)
+    assert info["status"] == ReturnStatus.SUCCESS
+    assert _key(out, info) == ref_centralized
+    # a mismatched options fingerprint REFUSES to resume with a clear
+    # error naming the differing field (same checkpoint dir)
+    with pytest.raises(failsafe.CheckpointMismatchError, match="hsiz"):
+        adapt(unit_cube_mesh(3),
+              AdaptOptions(**dict(C_OPTS, hsiz=0.3)), checkpoint_dir=ck)
+
+
+def test_kill_and_resume_centralized(tmp_path, ref_centralized):
+    """In-process preemption (kill_mode="raise" — BaseException, no
+    driver can absorb it) at the it0 boundary, then resume: the resumed
+    run must reproduce the uninterrupted run bit for bit."""
+    ck = str(tmp_path / "ck")
+    plan = failsafe.FaultPlan.parse("it0:post:kill", kill_mode="raise")
+    with pytest.raises(failsafe.PreemptionError):
+        adapt(unit_cube_mesh(3),
+              AdaptOptions(faults=plan, **C_OPTS), checkpoint_dir=ck)
+    # the kill fired AFTER the atomic checkpoint commit
+    assert any(f.endswith(".json") for f in os.listdir(ck))
+    assert not [f for f in os.listdir(ck) if ".tmp." in f]
+    out, info = adapt(unit_cube_mesh(3), AdaptOptions(**C_OPTS),
+                      checkpoint_dir=ck)
+    assert info["status"] == ReturnStatus.SUCCESS
+    assert _key(out, info) == ref_centralized
+
+
+@pytest.mark.slow  # subprocess jax startup; tier-1 covers the
+# in-process preemption above, and tools/fault_smoke.py (the
+# tools/check.sh gate) runs this exact scenario end to end
+def test_kill_and_resume_subprocess(tmp_path, ref_centralized):
+    """Genuine preemption: a subprocess is os._exit()ed mid-run by the
+    PARMMG_FAULTS plan; the checkpoint directory must hold a complete
+    (atomically published) checkpoint that this process resumes into
+    the same final mesh as the uninterrupted run."""
+    ck = str(tmp_path / "ck")
+    worker = os.path.join(os.path.dirname(__file__),
+                          "failsafe_worker.py")
+    env = dict(os.environ, PARMMG_FAULTS="it0:post:kill",
+               JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, worker, ck], env=env,
+        capture_output=True, text=True, timeout=1500,
+    )
+    assert p.returncode == failsafe.KILL_EXIT_CODE, (
+        p.returncode, p.stdout[-2000:], p.stderr[-2000:],
+    )
+    assert not [f for f in os.listdir(ck) if ".tmp." in f]
+    out, info = adapt(unit_cube_mesh(3), AdaptOptions(**C_OPTS),
+                      checkpoint_dir=ck)
+    assert info["status"] == ReturnStatus.SUCCESS
+    assert _key(out, info) == ref_centralized
+
+
+# ---------------------------------------------------------------------------
+# distributed driver: fault matrix + rollback + kill/resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ref_distributed():
+    st, comm, info = adapt_distributed(
+        unit_cube_mesh(3), DistOptions(**D_OPTS)
+    )
+    assert info["status"] == ReturnStatus.SUCCESS
+    return _key(st, info)
+
+
+def test_lowfailure_rollback_returns_conformal_snapshot(tmp_path):
+    """The snapshot-rollback branch of `_iteration_loop` (previously an
+    untested except-branch): a NaN injected into iteration 1 must roll
+    the state back to the iteration-0 result — conformal, saveable —
+    and grade the run LOWFAILURE, never raise."""
+    st, comm, info = adapt_distributed(
+        unit_cube_mesh(3),
+        DistOptions(faults="it1:remesh:nan", **D_OPTS),
+    )
+    assert info["status"] == ReturnStatus.LOWFAILURE
+    fails = _failures(info)
+    assert fails and "non-finite" in fails[-1]["failure"]
+    for s, m in enumerate(unstack_mesh(st)):
+        rep = check_mesh(m, check_boundary=False)
+        assert rep.ok, f"shard {s}: {rep}"
+    merged = merge_adapted(st, comm)
+    assert check_mesh(merged, check_boundary=False).ok
+    medit.save_mesh_distributed(st, comm, str(tmp_path / "out.mesh"))
+    assert os.path.exists(str(tmp_path / "out.0.mesh"))
+
+
+def test_fault_overflow_distributed_migrate(tmp_path):
+    """Injected slot-capacity undershoot at the migrate boundary drives
+    the REAL CapacityError raise site in parallel/migrate.py and the
+    real grow-and-retry consumer in the driver."""
+    st, comm, info = adapt_distributed(
+        unit_cube_mesh(3),
+        DistOptions(faults="it0:migrate:overflow", **D_OPTS),
+    )
+    assert info["status"] == ReturnStatus.SUCCESS
+    fails = _failures(info)
+    assert fails and fails[0].get("error") == "CapacityError"
+    assert fails[0].get("recovered")
+    for m in unstack_mesh(st):
+        assert check_mesh(m, check_boundary=False).ok
+
+
+def test_kill_and_resume_distributed(tmp_path, ref_distributed):
+    """Preemption at the it0 boundary of the distributed driver +
+    resume from DistOptions.checkpoint_dir reproduces the uninterrupted
+    run (the module's reference fixture)."""
+    ref = ref_distributed
+    ck = str(tmp_path / "ck")
+    plan = failsafe.FaultPlan.parse("it0:post:kill", kill_mode="raise")
+    with pytest.raises(failsafe.PreemptionError):
+        adapt_distributed(
+            unit_cube_mesh(3),
+            DistOptions(faults=plan, checkpoint_dir=ck, **D_OPTS),
+        )
+    assert any(f.endswith(".json") for f in os.listdir(ck))
+    st, comm, info = adapt_distributed(
+        unit_cube_mesh(3), DistOptions(checkpoint_dir=ck, **D_OPTS)
+    )
+    assert info["status"] == ReturnStatus.SUCCESS
+    assert _key(st, info) == ref
+
+
+# --- retrace faults LAST: their recovery clears the in-process compile
+# cache, so every adapt after them would recompile from scratch --------
+
+
+def test_fault_retrace_centralized(tmp_path):
+    out, info = adapt(
+        unit_cube_mesh(3),
+        AdaptOptions(faults="it1:remesh:retrace", **C_OPTS),
+    )
+    assert info["status"] == ReturnStatus.SUCCESS
+    assert any(r.get("error") == "RetraceError"
+               for r in _failures(info))
+    assert check_mesh(out, check_boundary=False).ok
+    medit.save_mesh(out, str(tmp_path / "out.mesh"))
+
+
+def test_fault_retrace_distributed():
+    """Injected transient-XLA error: recovered by clear-caches + retry."""
+    st, comm, info = adapt_distributed(
+        unit_cube_mesh(3),
+        DistOptions(faults="it0:remesh:retrace", **D_OPTS),
+    )
+    assert info["status"] == ReturnStatus.SUCCESS
+    assert any(r.get("error") == "RetraceError"
+               for r in _failures(info))
+    for m in unstack_mesh(st):
+        assert check_mesh(m, check_boundary=False).ok

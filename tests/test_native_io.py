@@ -6,16 +6,17 @@ import pytest
 
 from parmmg_tpu.io import native_io
 
-CUBE = "/root/reference/libexamples/adaptation_example0/cube.mesh"
-
-
-def test_native_tokenizer_parity():
+def test_native_tokenizer_parity(cube_mesh_path):
+    # conftest fixture: the reference cube when /root/reference is
+    # mounted, else the synthesized equivalent — the hardcoded
+    # reference path made this fail (not skip) on hermetic machines
+    # once the native tokenizer auto-built
     if not native_io.available():
         pytest.skip("native tokenizer not built (no g++?)")
-    with open(CUBE) as f:
+    with open(cube_mesh_path) as f:
         text = f.read()
     py = re.compile(r"#.*").sub(" ", text).split()
-    assert native_io.tokenize(CUBE) == py
+    assert native_io.tokenize(cube_mesh_path) == py
 
 
 def test_capi_adapt_file(tmp_path):

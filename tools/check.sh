@@ -1,8 +1,10 @@
 #!/bin/bash
 # CI gate: static analysis first (fails fast, pure stdlib — no
-# accelerator touch), then the tier-1 test command from ROADMAP.md.
+# accelerator touch), then the fault-injection smoke (one NaN + one
+# overflow + one kill/resume scenario on the small fixture, through the
+# public drivers), then the tier-1 test command from ROADMAP.md.
 #
-#   tools/check.sh            # lint + tier-1 tests
+#   tools/check.sh            # lint + fault smoke + tier-1 tests
 #   tools/check.sh --lint-only
 #
 # The linter must exit 0 on the committed tree: every finding is either
@@ -16,6 +18,11 @@ rc=$?
 echo "## lint rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 [ "${1:-}" = "--lint-only" ] && exit 0
+
+timeout -k 10 1800 env JAX_PLATFORMS=cpu python tools/fault_smoke.py
+rc=$?
+echo "## fault-smoke rc=$rc"
+[ $rc -ne 0 ] && exit $rc
 
 set -o pipefail
 rm -f /tmp/_t1.log

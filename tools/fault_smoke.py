@@ -1,0 +1,128 @@
+"""Fault-injection smoke for the CI gate (tools/check.sh).
+
+Exercises one scenario per recovery family on the small synthetic
+fixture, end to end through the public drivers:
+
+1. NaN poisoning (``it1:remesh:nan``) — the phase-boundary validator
+   must catch it and the run must degrade to LOWFAILURE with a
+   conformal, saveable mesh and a ``failure`` history entry;
+2. capacity overflow (``it0:remesh:overflow``) — the bounded
+   grow-and-retry loop must absorb it and still return SUCCESS;
+3. kill/resume — a subprocess (this script with ``--worker``) is killed
+   by an injected preemption (os._exit) at an iteration boundary; the
+   parent resumes from the atomic checkpoint and must reproduce the
+   uninterrupted run's mesh counts and quality histogram.
+
+Run hermetically on CPU: ``python tools/fault_smoke.py``. Exit 0 =
+every scenario behaved; any unhandled exception or mismatch fails the
+gate.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+for _accel in ("axon", "tpu", "cuda", "rocm"):
+    _xb._backend_factories.pop(_accel, None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+from parmmg_tpu import failsafe  # noqa: E402
+from parmmg_tpu.core.tags import ReturnStatus  # noqa: E402
+from parmmg_tpu.io import medit  # noqa: E402
+from parmmg_tpu.models.adapt import AdaptOptions, adapt  # noqa: E402
+from parmmg_tpu.utils.conformity import check_mesh  # noqa: E402
+from parmmg_tpu.utils.gen import unit_cube_mesh  # noqa: E402
+
+OPTS = dict(hsiz=0.35, niter=2, max_sweeps=4, hgrad=None,
+            polish_sweeps=0)
+
+
+def _key(mesh, info):
+    h = info["qual_out"]
+    return (
+        int(mesh.npoin), int(mesh.ntet),
+        tuple(int(x) for x in np.asarray(jax.device_get(h.counts))),
+    )
+
+
+def worker(ckdir: str) -> None:
+    """Child mode: run with checkpointing; PARMMG_FAULTS (set by the
+    parent) kills this process at the scheduled boundary."""
+    adapt(unit_cube_mesh(3), AdaptOptions(**OPTS), checkpoint_dir=ckdir)
+    print("worker finished without being killed", flush=True)
+    sys.exit(3)
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="parmmg_fault_smoke_")
+    try:
+        # --- scenario 1: NaN -> LOWFAILURE + conformal + saveable -----
+        out, info = adapt(
+            unit_cube_mesh(3),
+            AdaptOptions(faults="it1:remesh:nan", **OPTS),
+        )
+        assert info["status"] == ReturnStatus.LOWFAILURE, info["status"]
+        assert any("failure" in r for r in info["history"])
+        assert check_mesh(out, check_boundary=False).ok
+        medit.save_mesh(out, os.path.join(tmp, "nan.mesh"))
+        print("[fault-smoke] nan: LOWFAILURE + conformal + saved OK")
+
+        # --- scenario 2: overflow -> grow-and-retry SUCCESS -----------
+        out, info = adapt(
+            unit_cube_mesh(3),
+            AdaptOptions(faults="it0:remesh:overflow", **OPTS),
+        )
+        assert info["status"] == ReturnStatus.SUCCESS, info["status"]
+        assert any("failure" in r for r in info["history"])
+        print("[fault-smoke] overflow: recovered to SUCCESS")
+
+        # --- scenario 3: kill + resume --------------------------------
+        ref, ref_info = adapt(unit_cube_mesh(3), AdaptOptions(**OPTS))
+        ckdir = os.path.join(tmp, "ckpt")
+        env = dict(os.environ, PARMMG_FAULTS="it0:post:kill")
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", ckdir],
+            env=env, capture_output=True, text=True, timeout=1500,
+        )
+        assert p.returncode == failsafe.KILL_EXIT_CODE, (
+            p.returncode, p.stdout[-2000:], p.stderr[-2000:],
+        )
+        assert not [f for f in os.listdir(ckdir) if ".tmp." in f], (
+            "atomic write left temp files behind"
+        )
+        res, res_info = adapt(
+            unit_cube_mesh(3), AdaptOptions(**OPTS), checkpoint_dir=ckdir
+        )
+        assert _key(res, res_info) == _key(ref, ref_info), (
+            _key(res, res_info), _key(ref, ref_info),
+        )
+        print("[fault-smoke] kill/resume: resumed run matches "
+              "uninterrupted run")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker(sys.argv[2])
+    sys.exit(main())

@@ -10,16 +10,40 @@
 # scale_run's 2700 s stall is far below the cold prep compile budget
 # (10800 s), so running half-warm just burns its 4 retries mid-compile
 # and caches nothing (ADVICE r5).
+#
+# Each stage also runs under an OUTER per-stage timeout watchdog (PR 3):
+# the python tools' --stall watchdogs only fire while their monitor
+# thread is alive — a wedged process (stuck compile, dead watchdog
+# thread, hung device) would otherwise hang the whole ladder. The outer
+# `timeout` records the stage as failed (rc 124/137) and aborts instead
+# of hanging; budgets are the stage's own stall cap plus slack for
+# retries and process startup.
 set -u
 cd /root/repo || exit 1
-python tools/warm_ops.py 16 0.02 --tight 1 --stall 10800 --attempts 1 --ops prep
-rc=$?
-echo "## stage prep rc=$rc"
-[ $rc -ne 0 ] && exit $rc
-python tools/warm_ops.py 16 0.02 --tight 1 --stall 5400 --ops compact,unique_edges,split,collapse,swap32,build_adjacency,swap23,smooth,histogram,polish
-rc=$?
-echo "## stage rest rc=$rc"
-[ $rc -ne 0 ] && exit $rc
+
+run_stage() {
+    # run_stage <name> <timeout_s> <cmd...>: stage under a watchdog;
+    # echoes the rc line the ladder logs key off and returns the rc.
+    local name=$1 tmo=$2 rc
+    shift 2
+    timeout -k 30 "$tmo" "$@"
+    rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        echo "## stage $name rc=$rc (watchdog timeout after ${tmo}s)"
+    else
+        echo "## stage $name rc=$rc"
+    fi
+    return "$rc"
+}
+
+# prep stall 10800 s + 900 s slack (startup, device init, teardown)
+run_stage prep 11700 \
+    python tools/warm_ops.py 16 0.02 --tight 1 --stall 10800 --attempts 1 --ops prep \
+    || exit $?
+# rest stall 5400 s x default 2 attempts + slack
+run_stage rest 11700 \
+    python tools/warm_ops.py 16 0.02 --tight 1 --stall 5400 --ops compact,unique_edges,split,collapse,swap32,build_adjacency,swap23,smooth,histogram,polish \
+    || exit $?
 # measured stage runs on the disk cache the warm stages just filled.
 # NOTE the budget is an EXPLOSION guard, not 0: jax logs "Compiling"
 # before the persistent-cache lookup, so even a fully warmed run traces
@@ -29,8 +53,9 @@ echo "## stage rest rc=$rc"
 # the n=16 run executes ~20 sweeps over ~15 distinct programs, so >64
 # sweep-phase compiles means something retraces per sweep — fail loudly
 # via lint.contracts.run_adapt_with_budget instead of recording a
-# silently-livelocked number
-PARMMG_RETRACE_BUDGETS="sweeps=64" python tools/scale_run.py 16 0.02 --tight 1 --stall 2700 --retries 4
-rc=$?
-echo "## stage run rc=$rc"
-exit $rc
+# silently-livelocked number.
+# watchdog: 2700 s stall x (1 + 4 retries) + slack
+run_stage run 15300 \
+    env PARMMG_RETRACE_BUDGETS="sweeps=64" \
+    python tools/scale_run.py 16 0.02 --tight 1 --stall 2700 --retries 4
+exit $?
