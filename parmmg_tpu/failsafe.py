@@ -25,6 +25,25 @@ worker resumes instead of restarting. Four pieces:
   ``PARMMG_FAULTS="it1:remesh:nan,it2:migrate:overflow,it1:post:kill"``
   with hooks at every phase boundary in both drivers, so every recovery
   path above has a test that actually exercises it.
+
+Multi-host awareness (the reference survives node-scale runs because
+every MPI rank owns its sub-mesh and can be restarted from per-rank
+state — Cirrottola & Froehly, RR-9307 §restart): under a
+`jax.distributed` world the checkpointer shards — each process
+atomically writes ``ckpt_<it>.proc<rank>.npz`` for its shard rows and
+rank 0 publishes a manifest (world size, per-rank digests) only after a
+``multihost.barrier()``, so a kill at ANY point leaves either the old
+or the new checkpoint complete; resume refuses loudly on a
+world-size/fingerprint mismatch. Validation on the SPMD sweep path is
+device-resident (`stacked_status`: psum-reduced
+finiteness/orientation/connectivity inside the shard_map, Omega_h-style
+— only a [D,4] status table crosses to host, never the mesh).
+Preemption is handled by a SIGTERM → checkpoint-then-
+:class:`PreemptionError` handler armed by the harness, and silent peer
+loss by the collective watchdog (`multihost.run_with_watchdog`) which
+raises :class:`PeerLostError` instead of hanging. Faults can be
+rank-targeted (``it1:remesh:kill@rank1``) so every multi-host path is
+deterministically testable with 2+ CPU processes.
 """
 
 from __future__ import annotations
@@ -33,6 +52,9 @@ import dataclasses
 import hashlib
 import json
 import os
+import signal
+import threading
+from functools import lru_cache
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -45,6 +67,14 @@ from .core.mesh import Mesh, tet_volumes
 # exit code of an injected ``kill`` fault (simulated preemption) — the
 # test harness and tools/check.sh smoke stage assert on it
 KILL_EXIT_CODE = 86
+# exit code a multi-host worker uses after converting a PeerLostError
+# into a checkpoint-backed exit (tools/fault_smoke.py --multihost and
+# the m10 subprocess tests assert on it)
+PEER_LOST_EXIT_CODE = 87
+# exit code a worker uses when resume REFUSED (world-size/fingerprint
+# mismatch, CheckpointMismatchError) — distinct so tests can tell a
+# loud refusal from a crash
+MISMATCH_EXIT_CODE = 88
 
 CHECKPOINT_FORMAT = 1
 
@@ -103,8 +133,21 @@ class RetraceError(AdaptError):
 
 
 class CheckpointMismatchError(AdaptError):
-    """A checkpoint exists but was written under incompatible options —
-    resuming would silently change the trajectory, so refuse loudly."""
+    """A checkpoint exists but was written under incompatible options,
+    or under a different world size than the resuming run — resuming
+    would silently change the trajectory (or deadlock the shard
+    exchange), so refuse loudly."""
+
+
+class PeerLostError(AdaptError):
+    """A collective (checkpoint barrier / phase heartbeat) timed out:
+    a peer process died or hung, so the SPMD world is broken. NOT
+    recoverable in-process — both drivers re-raise it through every
+    recovery path (rollback cannot resurrect a peer); the cure is
+    checkpoint-backed restart. Raised by
+    `parallel.multihost.run_with_watchdog` when
+    ``watchdog_timeout`` is configured, instead of hanging forever the
+    way a bare collective on a lost TCP peer does."""
 
 
 class PreemptionError(BaseException):
@@ -138,13 +181,21 @@ def snapshot(state):
 # ---------------------------------------------------------------------------
 
 
+# human-readable labels of the _sanity_counts / stacked_status columns
+STATUS_COLS = (
+    "nonfinite_verts", "nonfinite_met", "nonpositive_tets", "conn_oob",
+)
+
+
 # parmmg-lint: disable=PML005 -- pure query; the driver keeps the mesh for rollback
 @jax.jit
 def _sanity_counts(mesh: Mesh) -> jax.Array:
-    """[3] int32: (non-finite vertices, non-finite metric rows,
-    non-positive tets) over the live entities — the cheap device half of
-    the validator (finiteness + positive orientation), one fused reduce
-    like the reference's per-phase ``MPI_Allreduce(ier, MIN)``."""
+    """[4] int32: (non-finite vertices, non-finite metric rows,
+    non-positive tets, tets with out-of-range/dead connectivity) over
+    the live entities — the cheap device half of the validator
+    (finiteness + positive orientation + capacity/overflow poisoning),
+    one fused reduce like the reference's per-phase
+    ``MPI_Allreduce(ier, MIN)``."""
     bad_v = jnp.sum(
         (mesh.vmask & ~jnp.all(jnp.isfinite(mesh.vert), axis=-1))
         .astype(jnp.int32)
@@ -155,7 +206,49 @@ def _sanity_counts(mesh: Mesh) -> jax.Array:
     )
     vol = tet_volumes(mesh)
     n_inv = jnp.sum((mesh.tmask & ~(vol > 0)).astype(jnp.int32))
-    return jnp.stack([bad_v, bad_m, n_inv]).astype(jnp.int32)
+    # connectivity poisoning: a live tet indexing out of the vertex
+    # table (per-shard slot overflow truncation) or a dead vertex
+    pcap = mesh.vert.shape[0]
+    in_rng = (mesh.tet >= 0) & (mesh.tet < pcap)
+    live = mesh.vmask[jnp.clip(mesh.tet, 0, pcap - 1)]
+    n_oob = jnp.sum(
+        (mesh.tmask & ~jnp.all(in_rng & live, axis=1)).astype(jnp.int32)
+    )
+    return jnp.stack([bad_v, bad_m, n_inv, n_oob]).astype(jnp.int32)
+
+
+@lru_cache(maxsize=8)
+def _stacked_status_fn(dmesh):
+    """Memoized jit(shard_map) status reducer per device mesh
+    (rebuilding it per call would retrace every validation —
+    parmmg-lint PML004). Each shard computes its own [4] counters and
+    the replicated [D, 4] table is assembled with one psum
+    (`comm.status_allgather`) — the whole check stays on device; only
+    the table crosses to host, never a mesh array."""
+    from jax.sharding import PartitionSpec as P
+
+    from .parallel.comm import status_allgather
+    from .parallel.shard import AXIS, _squeeze
+
+    def body(blk):
+        st = _sanity_counts(_squeeze(blk))
+        return status_allgather(st, AXIS)
+
+    return jax.jit(jax.shard_map(
+        body, mesh=dmesh, in_specs=(P(AXIS),), out_specs=P()
+    ))
+
+
+def stacked_status(stacked: Mesh, dmesh) -> jax.Array:
+    """Device-resident per-shard status table of a stacked [D,...] mesh
+    laid over `dmesh`: replicated [D, 4] int32 of
+    :data:`STATUS_COLS` counters (all-zero iff every shard is sane).
+    The Omega_h-style device reduction replacing the
+    `multihost.gather_stacked` round trip for ``validate="basic"`` on
+    the SPMD path — works identically single-process and across a
+    multi-controller world (the psum rides ICI/DCN; the result is
+    replicated so every process reads it locally)."""
+    return _stacked_status_fn(dmesh)(stacked)
 
 
 @dataclasses.dataclass
@@ -206,7 +299,8 @@ class PhaseValidator:
                 f"phase-boundary validation failed after {phase} "
                 f"(it {it}): {int(tot[0])} non-finite vertices, "
                 f"{int(tot[1])} non-finite metric rows, "
-                f"{int(tot[2])} non-positive tets"
+                f"{int(tot[2])} non-positive tets, "
+                f"{int(tot[3])} tets with out-of-range connectivity"
             )
         if self.level != "full":
             return
@@ -243,13 +337,39 @@ class PhaseValidator:
                     f"conformity check failed after {phase} (it {it}): {r}"
                 )
 
+    def check_sharded(self, state: Mesh, dmesh, it: int, *,
+                      phase: str = "sweep", force: bool = False) -> None:
+        """Device-resident basic validation for the SPMD sweep path.
+
+        The per-shard finiteness/orientation/connectivity counters are
+        reduced INSIDE the shard_map (`stacked_status`) and only the
+        replicated [D, 4] table is fetched — zero host gathers of mesh
+        arrays, so validation adds one tiny device reduce per sweep
+        instead of a cross-process allgather of the whole stacked
+        state. Raises :class:`NumericalError` with per-shard
+        attribution. The ``full``-level host work (conformity,
+        chkcomm) intentionally stays on the gathered iteration-boundary
+        path — this method only ever runs the basic device half."""
+        if not self.active or not (force or self.due(it)):
+            return
+        rep = np.asarray(jax.device_get(stacked_status(state, dmesh)))
+        if rep.any():
+            bad = {
+                s: dict(zip(STATUS_COLS, (int(x) for x in row)))
+                for s, row in enumerate(rep) if row.any()
+            }
+            raise NumericalError(
+                f"device-resident validation failed after {phase} "
+                f"(it {it}); per-shard counters: {bad}"
+            )
+
 
 # ---------------------------------------------------------------------------
 # deterministic fault injection
 # ---------------------------------------------------------------------------
 
 FAULT_PHASES = ("analysis", "metric", "remesh", "interp", "migrate", "post")
-FAULT_KINDS = ("nan", "overflow", "retrace", "kill")
+FAULT_KINDS = ("nan", "overflow", "retrace", "kill", "sigterm")
 
 
 @dataclasses.dataclass
@@ -257,7 +377,16 @@ class Fault:
     it: int
     phase: str
     kind: str
+    rank: Optional[int] = None   # None = every process; else that rank only
     fired: bool = False
+
+    @property
+    def mine(self) -> bool:
+        """Does this fault target the current process? Rank-targeted
+        faults (``kill@rank1``) fire only on the named
+        `jax.process_index()` — how a 2-process CPU test kills exactly
+        one worker mid-iteration."""
+        return self.rank is None or self.rank == jax.process_index()
 
 
 class FaultPlan:
@@ -294,10 +423,19 @@ class FaultPlan:
             if len(parts) != 3 or not parts[0].startswith("it"):
                 raise ValueError(
                     f"bad PARMMG_FAULTS token {tok!r} "
-                    "(want it<k>:<phase>:<kind>)"
+                    "(want it<k>:<phase>:<kind>[@rank<r>])"
                 )
             it = int(parts[0][2:])
             phase, kind = parts[1], parts[2]
+            rank = None
+            if "@" in kind:
+                kind, _, rk = kind.partition("@")
+                if not rk.startswith("rank") or not rk[4:].isdigit():
+                    raise ValueError(
+                        f"bad fault rank suffix {rk!r} in {tok!r} "
+                        "(want @rank<r>, r a 0-based process index)"
+                    )
+                rank = int(rk[4:])
             if phase not in FAULT_PHASES:
                 raise ValueError(
                     f"unknown fault phase {phase!r} (one of {FAULT_PHASES})"
@@ -306,7 +444,7 @@ class FaultPlan:
                 raise ValueError(
                     f"unknown fault kind {kind!r} (one of {FAULT_KINDS})"
                 )
-            faults.append(Fault(it, phase, kind))
+            faults.append(Fault(it, phase, kind, rank=rank))
         return cls(faults, kill_mode=kill_mode)
 
     @classmethod
@@ -335,7 +473,7 @@ class FaultPlan:
         first opportunity at or after its iteration."""
         for f in self.faults:
             if not f.fired and f.it <= it and f.phase == phase \
-                    and f.kind == kind:
+                    and f.kind == kind and f.mine:
                 f.fired = True
                 return True
         return False
@@ -344,7 +482,7 @@ class FaultPlan:
         """Apply every pending fault for this (it, phase) boundary.
         Returns the (possibly poisoned) state; may raise or exit."""
         for f in self.faults:
-            if f.fired or f.it != it or f.phase != phase:
+            if f.fired or f.it != it or f.phase != phase or not f.mine:
                 continue
             if f.phase == "migrate" and f.kind == "overflow":
                 # realized by the driver via take(): it undershoots the
@@ -352,7 +490,9 @@ class FaultPlan:
                 # path runs, not a synthetic stand-in
                 continue
             f.fired = True
-            where = f"it{it}:{phase}"
+            where = f"it{it}:{phase}" + (
+                f"@rank{f.rank}" if f.rank is not None else ""
+            )
             if f.kind == "nan":
                 idx = (0,) * (state.vert.ndim - 1)
                 state = state.replace(
@@ -368,6 +508,17 @@ class FaultPlan:
                     f"injected transient retrace/XLA error at {where} "
                     "(fault plan)"
                 )
+            elif f.kind == "sigterm":
+                # real preemption notice: the platform's SIGTERM, aimed
+                # at ourselves — exercises the harness's checkpoint-
+                # then-exit handler end to end (handler sets the flag;
+                # the driver commits a checkpoint at the iteration
+                # boundary and raises PreemptionError)
+                print(
+                    f"[failsafe] injected SIGTERM at {where} (fault "
+                    "plan)", flush=True,
+                )
+                os.kill(os.getpid(), signal.SIGTERM)
             elif f.kind == "kill":
                 if self.kill_mode == "raise":
                     raise PreemptionError(
@@ -397,6 +548,7 @@ class FaultPlan:
 _FINGERPRINT_EXCLUDE = frozenset({
     "verbose", "niter", "checkpoint_dir", "checkpoint_every", "faults",
     "mem_budget_mb", "validate", "validate_every", "recovery_attempts",
+    "checkpoint_keep", "watchdog_timeout",
 })
 
 _MESH_DATA_FIELDS = tuple(
@@ -470,23 +622,68 @@ class ResumeState:
         return self.meshes["mesh"]
 
 
+def _digest_arrays(arrs: Dict[str, np.ndarray]) -> str:
+    """Deterministic content digest of a checkpoint array dict (name +
+    dtype + shape + bytes, sorted keys) — what the rank-0 manifest
+    records per rank and what resume re-verifies."""
+    h = hashlib.sha256()
+    for k in sorted(arrs):
+        a = np.ascontiguousarray(np.asarray(arrs[k]))
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _rank_rows(nrows: int, world: int, rank: int) -> Tuple[int, int]:
+    """Contiguous shard-row range process `rank` checkpoints (shards
+    are laid over `jax.devices()` in process order, so contiguous
+    chunks follow device ownership)."""
+    return rank * nrows // world, (rank + 1) * nrows // world
+
+
 class Checkpointer:
     """Per-iteration atomic checkpoints under one directory.
 
-    Layout: ``ckpt_<it:05d>.npz`` (exact mesh arrays, full capacity —
-    restoring reproduces the running state bit for bit, capacities
-    included) + ``ckpt_<it:05d>.json`` (iteration, options fingerprint,
-    sweep state, history, auxiliary metadata). Both are written to a
-    temp file and published with ``os.replace`` (via
+    Single-process layout: ``ckpt_<it:05d>.npz`` (exact mesh arrays,
+    full capacity — restoring reproduces the running state bit for bit,
+    capacities included) + ``ckpt_<it:05d>.json`` (iteration, options
+    fingerprint, sweep state, history, auxiliary metadata). Both are
+    written to a temp file and published with ``os.replace`` (via
     `io.medit.atomic_replace`), json LAST — the json is the commit
     record, so a kill can never leave a readable-but-truncated
-    checkpoint. The latest two checkpoints are kept.
+    checkpoint.
+
+    Multi-process (``world > 1``, the per-rank restart state of the
+    reference's node-scale runs): each process writes only its shard
+    rows as ``ckpt_<it:05d>.proc<rank>.npz``; after a coordination
+    ``barrier`` confirms every rank's data file is published, rank 0
+    writes the json manifest (world size, per-rank content digests,
+    which mesh keys are sharded) and a second barrier releases the
+    world — a kill at ANY point therefore leaves either the old or the
+    new checkpoint complete, never a torn one. `load` refuses loudly
+    (:class:`CheckpointMismatchError`) when the manifest's world size
+    or options fingerprint differs from the resuming run, and falls
+    back to the previous checkpoint when a data file is unreadable or
+    fails its digest.
+
+    The newest `keep` checkpoints are retained; older ones are pruned
+    after each successful commit (`AdaptOptions.checkpoint_keep`).
     """
 
-    def __init__(self, dirpath: str, opts, driver: str, every: int = 1):
+    def __init__(self, dirpath: str, opts, driver: str, every: int = 1,
+                 keep: int = 2, rank: Optional[int] = None,
+                 world: Optional[int] = None, barrier=None):
         self.dir = dirpath
         self.driver = driver
         self.every = max(int(every), 1)
+        self.keep = max(int(keep), 1)
+        self.rank = jax.process_index() if rank is None else int(rank)
+        self.world = jax.process_count() if world is None else int(world)
+        self._barrier = barrier if barrier is not None else (
+            lambda tag: None
+        )
         self.fingerprint, self.fields = options_fingerprint(opts)
 
     # -- naming ----------------------------------------------------------
@@ -505,6 +702,20 @@ class Checkpointer:
                     pass
         return sorted(its)
 
+    def _prune(self) -> None:
+        """Retain only the newest `keep` committed checkpoints: every
+        file of an older iteration (json, npz, per-rank proc npz) is
+        unlinked. Runs after the commit barrier — a kill mid-prune can
+        only lose already-superseded state, which `load` skips."""
+        for old in self._known()[:-self.keep]:
+            prefix = f"ckpt_{old:05d}."
+            for name in os.listdir(self.dir):
+                if name.startswith(prefix):
+                    try:
+                        os.unlink(os.path.join(self.dir, name))
+                    except OSError:
+                        pass
+
     # -- save ------------------------------------------------------------
     def due(self, it: int) -> bool:
         return (it + 1) % self.every == 0
@@ -512,20 +723,12 @@ class Checkpointer:
     def save(self, it: int, meshes: Dict[str, Mesh], *, history, emult,
              meta: Optional[dict] = None,
              aux_arrays: Optional[Dict[str, np.ndarray]] = None) -> None:
-        from .io.medit import atomic_replace
+        from .io.medit import atomic_replace, fsync_dir
 
         os.makedirs(self.dir, exist_ok=True)
-        arrs: Dict[str, np.ndarray] = {}
-        statics = {}
-        for key, m in meshes.items():
-            arrs.update(_mesh_arrays(m, key + "/"))
-            statics[key] = _mesh_static(m)
-        aux = dict(aux_arrays or {})
-        for k, v in aux.items():
-            arrs["aux/" + k] = np.asarray(jax.device_get(v))
         base = self._base(it)
-        with atomic_replace(base + ".npz", "wb") as f:
-            np.savez(f, **arrs)
+        statics = {key: _mesh_static(m) for key, m in meshes.items()}
+        aux = dict(aux_arrays or {})
         doc = dict(
             format=CHECKPOINT_FORMAT,
             driver=self.driver,
@@ -537,23 +740,85 @@ class Checkpointer:
             meshes=statics,
             aux=sorted(aux),
             meta=meta or {},
+            world=self.world,
         )
-        with atomic_replace(base + ".json", "w") as f:
-            json.dump(doc, f, default=str)
-        for old in self._known()[:-2]:
-            for ext in (".json", ".npz"):
-                try:
-                    os.unlink(self._base(old) + ext)
-                except OSError:
-                    pass
+        if self.world == 1:
+            arrs: Dict[str, np.ndarray] = {}
+            for key, m in meshes.items():
+                arrs.update(_mesh_arrays(m, key + "/"))
+            for k, v in aux.items():
+                arrs["aux/" + k] = np.asarray(jax.device_get(v))
+            with atomic_replace(base + ".npz", "wb") as f:
+                np.savez(f, **arrs)
+            with atomic_replace(base + ".json", "w") as f:
+                json.dump(doc, f, default=str)
+            fsync_dir(self.dir)
+            self._prune()
+            return
+        self._save_sharded(it, base, meshes, aux, doc)
+
+    def _save_sharded(self, it: int, base: str, meshes, aux, doc) -> None:
+        """Two-phase commit of a multi-process checkpoint: per-rank data
+        files -> data barrier -> rank-0 manifest (the commit record) ->
+        commit barrier -> GC. The host state is replicated-deterministic
+        across processes (`models/distributed` contract), so rank 0 can
+        compute every rank's slice digest locally for the manifest."""
+        from .io.medit import atomic_replace, fsync_dir
+
+        sharded = sorted(
+            key for key, m in meshes.items() if m.vert.ndim == 3
+        )
+        doc["sharded"] = sharded
+
+        def rank_arrays(r: int) -> Dict[str, np.ndarray]:
+            arrs: Dict[str, np.ndarray] = {}
+            for key, m in meshes.items():
+                full = _mesh_arrays(m, key + "/")
+                if key in sharded:
+                    nrows = m.vert.shape[0]
+                    lo, hi = _rank_rows(nrows, self.world, r)
+                    arrs.update(
+                        {k: v[lo:hi] for k, v in full.items()}
+                    )
+                elif r == 0:
+                    # replicated (non-stacked) state rides with rank 0
+                    arrs.update(full)
+            if r == 0:
+                for k, v in aux.items():
+                    arrs["aux/" + k] = np.asarray(jax.device_get(v))
+            return arrs
+
+        own = rank_arrays(self.rank)
+        with atomic_replace(f"{base}.proc{self.rank}.npz", "wb") as f:
+            np.savez(f, **own)
+        fsync_dir(self.dir)
+        # every rank's data file is durable before the commit record
+        # exists — the manifest can never name a missing shard file
+        self._barrier(f"ckpt-data-{it}")
+        if self.rank == 0:
+            doc["digests"] = {
+                str(r): _digest_arrays(
+                    own if r == self.rank else rank_arrays(r)
+                )
+                for r in range(self.world)
+            }
+            with atomic_replace(base + ".json", "w") as f:
+                json.dump(doc, f, default=str)
+            fsync_dir(self.dir)
+        # no rank proceeds (and possibly dies mid-next-iteration) until
+        # the manifest is published: old and new are both complete here
+        self._barrier(f"ckpt-commit-{it}")
+        if self.rank == 0:
+            self._prune()
 
     # -- load ------------------------------------------------------------
     def load(self) -> Optional[ResumeState]:
         """Most recent compatible checkpoint, or None when the directory
-        holds none. A checkpoint written under different options RAISES
-        :class:`CheckpointMismatchError` (silent restart would discard
-        the operator's intent); an unreadable newest checkpoint falls
-        back to the previous one."""
+        holds none. A checkpoint written under different options OR a
+        different world size RAISES :class:`CheckpointMismatchError`
+        (silent restart would discard the operator's intent / deadlock
+        the shard exchange); an unreadable or digest-failing newest
+        checkpoint falls back to the previous one."""
         last_err = None
         for it in reversed(self._known()):
             base = self._base(it)
@@ -566,6 +831,15 @@ class Checkpointer:
             if doc.get("format") != CHECKPOINT_FORMAT \
                     or doc.get("driver") != self.driver:
                 continue
+            ck_world = int(doc.get("world", 1))
+            if ck_world != self.world:
+                raise CheckpointMismatchError(
+                    f"checkpoint {base}.json was written by a "
+                    f"{ck_world}-process world but this run has "
+                    f"{self.world} processes; refusing to resume — "
+                    "relaunch with the original world size or delete "
+                    "the checkpoint directory"
+                )
             if doc["fingerprint"] != self.fingerprint:
                 diff = sorted(
                     k for k in set(doc.get("options", {})) | set(self.fields)
@@ -578,9 +852,8 @@ class Checkpointer:
                     "directory or restore the original options"
                 )
             try:
-                with np.load(base + ".npz") as z:
-                    arrs = {k: z[k] for k in z.files}
-            except (OSError, ValueError) as e:
+                arrs = self._load_arrays(base, doc)
+            except (OSError, ValueError, KeyError) as e:
                 last_err = e
                 continue
             meshes = {
@@ -608,6 +881,46 @@ class Checkpointer:
             )
         return None
 
+    def _load_arrays(self, base: str, doc: dict) -> Dict[str, np.ndarray]:
+        """The full array dict of one committed checkpoint: the single
+        npz (world 1) or every rank's shard file digest-verified and
+        re-concatenated in rank order (== the original replicated host
+        state). Every process reads every file — resume restores the
+        replicated-deterministic host picture the drivers require."""
+        if int(doc.get("world", 1)) == 1:
+            with np.load(base + ".npz") as z:
+                return {k: z[k] for k in z.files}
+        per_rank: List[Dict[str, np.ndarray]] = []
+        digests = doc.get("digests", {})
+        for r in range(self.world):
+            with np.load(f"{base}.proc{r}.npz") as z:
+                arrs = {k: z[k] for k in z.files}
+            want = digests.get(str(r))
+            if want is not None and _digest_arrays(arrs) != want:
+                raise ValueError(
+                    f"checkpoint shard {base}.proc{r}.npz fails its "
+                    "manifest digest (corrupt or torn write)"
+                )
+            per_rank.append(arrs)
+        sharded = set(doc.get("sharded", ()))
+        out: Dict[str, np.ndarray] = {}
+        for key in doc["meshes"]:
+            prefix = key + "/"
+            if key in sharded:
+                for name in _MESH_DATA_FIELDS:
+                    out[prefix + name] = np.concatenate(
+                        [per_rank[r][prefix + name]
+                         for r in range(self.world)], axis=0,
+                    )
+            else:
+                out.update({
+                    k: v for k, v in per_rank[0].items()
+                    if k.startswith(prefix)
+                })
+        for k in doc.get("aux", ()):
+            out["aux/" + k] = per_rank[0]["aux/" + k]
+        return out
+
 
 # ---------------------------------------------------------------------------
 # the harness the drivers hold
@@ -616,9 +929,11 @@ class Checkpointer:
 
 class FailsafeHarness:
     """One driver run's failsafe state: validator + fault plan +
-    checkpointer + the bounded-recovery budget. Built by
-    :func:`harness`; every hook is a no-op when the corresponding
-    feature is off, so the drivers call unconditionally."""
+    checkpointer + the bounded-recovery budget + the multi-host
+    liveness machinery (heartbeat watchdog, SIGTERM checkpoint-then-
+    exit). Built by :func:`harness`; every hook is a no-op when the
+    corresponding feature is off, so the drivers call
+    unconditionally."""
 
     def __init__(self, opts, driver: str,
                  checkpoint_dir: Optional[str] = None):
@@ -628,14 +943,65 @@ class FailsafeHarness:
         )
         self.faults = FaultPlan.resolve(opts)
         self.attempts = int(getattr(opts, "recovery_attempts", 0) or 0)
+        self.watchdog = getattr(opts, "watchdog_timeout", None)
+        self.preempt_requested = False
+        self._armed = False
+        self._prev_sigterm = None
         ckdir = checkpoint_dir or getattr(opts, "checkpoint_dir", None)
         self.ckpt = (
             Checkpointer(
                 ckdir, opts, driver,
                 every=getattr(opts, "checkpoint_every", 1),
+                keep=getattr(opts, "checkpoint_keep", 2) or 2,
+                barrier=self._barrier,
             )
             if ckdir else None
         )
+
+    # -- multi-host liveness --------------------------------------------
+    def _barrier(self, tag: str) -> None:
+        from .parallel import multihost
+
+        multihost.barrier(tag, timeout=self.watchdog)
+
+    def heartbeat(self, it: int, phase: str = "iteration") -> None:
+        """Collective liveness check at a phase boundary: all processes
+        must arrive within ``opts.watchdog_timeout`` seconds or the
+        wait raises :class:`PeerLostError` — a killed peer becomes a
+        typed failure instead of an indefinite hang in the next
+        collective. No-op single-process or with no timeout configured
+        (an unbounded barrier would reintroduce the hang)."""
+        if self.watchdog is None:
+            return
+        from .parallel import multihost
+
+        multihost.barrier(f"hb:{phase}:{it}", timeout=self.watchdog)
+
+    # -- preemption (SIGTERM -> checkpoint-then-exit) -------------------
+    def arm_preemption(self) -> None:
+        """Install the SIGTERM handler (main thread only, and only when
+        checkpointing is configured — without a checkpoint there is
+        nothing to commit, so the platform default stays). The handler
+        only sets a flag; the driver loop commits a checkpoint at the
+        next iteration boundary and raises :class:`PreemptionError`,
+        mirroring the injected ``kill`` fault's semantics but with the
+        grace window real preemption notices give."""
+        if self.ckpt is None or self._armed:
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return
+        self._prev_sigterm = signal.signal(
+            signal.SIGTERM, self._on_sigterm
+        )
+        self._armed = True
+
+    def _on_sigterm(self, signum, frame) -> None:
+        self.preempt_requested = True
+
+    def disarm_preemption(self) -> None:
+        if self._armed:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._armed = False
 
     @property
     def rollback_enabled(self) -> bool:
@@ -650,6 +1016,12 @@ class FailsafeHarness:
     def validate(self, state, it: int, *, comm=None,
                  phase: str = "iteration") -> None:
         self.validator.check(state, it, comm=comm, phase=phase)
+
+    def validate_sharded(self, state, dmesh, it: int, *,
+                         phase: str = "sweep") -> None:
+        """Device-resident basic validation of a sharded stacked state
+        (the SPMD sweep path) — see `PhaseValidator.check_sharded`."""
+        self.validator.check_sharded(state, dmesh, it, phase=phase)
 
     def fire(self, it: int, phase: str, state):
         """Fire pending faults at this boundary; when one poisoned the
@@ -667,8 +1039,12 @@ class FailsafeHarness:
         return self.ckpt.load() if self.ckpt is not None else None
 
     def save(self, it: int, meshes: Dict[str, Mesh], *, history, emult,
-             meta=None, aux_arrays=None) -> None:
-        if self.ckpt is None or not self.ckpt.due(it):
+             meta=None, aux_arrays=None, force: bool = False) -> None:
+        """Checkpoint when due — or unconditionally with ``force``
+        (the preemption path commits out of cadence: the SIGTERM grace
+        window must not be spent waiting for the next due
+        iteration)."""
+        if self.ckpt is None or not (force or self.ckpt.due(it)):
             return
         self.ckpt.save(it, meshes, history=history, emult=emult,
                        meta=meta, aux_arrays=aux_arrays)

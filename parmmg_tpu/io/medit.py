@@ -30,6 +30,26 @@ from ..core.mesh import Mesh
 _COMMENT_RE = re.compile(r"#[^\n]*")
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so just-published renames (`atomic_replace`)
+    are durable, not merely visible: the checkpoint commit protocol
+    must not let a barrier release other ranks while this rank's
+    rename still sits in the page cache of a host about to lose power.
+    Best-effort — platforms that refuse O_RDONLY on directories are
+    silently skipped (rename ordering still gives crash atomicity,
+    just not power-loss durability)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 @contextlib.contextmanager
 def atomic_replace(path: str, mode: str = "w"):
     """Write-then-rename file publication: the payload goes to a

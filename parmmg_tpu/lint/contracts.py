@@ -72,6 +72,20 @@ def budget_exempt(label: str = "failure-recovery"):
         yield
 
 
+@contextmanager
+def no_host_transfers():
+    """Forbid IMPLICIT device->host transfers inside the block (JAX's
+    transfer guard) — the runtime twin of the static PML001 host-sync
+    rule. The device-resident validator contract (``validate="basic"``
+    on the SPMD path must never gather mesh arrays to host,
+    `failsafe.stacked_status`) is asserted by running it under this
+    guard: any implicit transfer raises immediately, while the one
+    EXPLICIT `jax.device_get` of the tiny status table remains
+    allowed — exactly the distinction the contract draws."""
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
 # ---------------------------------------------------------------------------
 # mesh invariants (jit-compatible)
 # ---------------------------------------------------------------------------

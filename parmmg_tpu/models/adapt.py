@@ -120,6 +120,17 @@ class AdaptOptions:
     # fingerprint refuses with CheckpointMismatchError)
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1   # checkpoint cadence in outer iterations
+    # checkpoint GC: retain only the last K committed checkpoints per
+    # run, pruning older ckpt_* files after each successful commit (a
+    # long run would otherwise accumulate every iteration's full mesh
+    # on disk)
+    checkpoint_keep: int = 2
+    # collective watchdog (multi-process runs): seconds a phase-boundary
+    # heartbeat / checkpoint barrier may block before a silent peer loss
+    # is converted into a typed failsafe.PeerLostError instead of
+    # hanging the survivors forever. None = no watchdog (single-process
+    # runs need none; the barrier is then unbounded).
+    watchdog_timeout: Optional[float] = None
     # deterministic fault injection: a failsafe.FaultPlan (or spec
     # string "it1:remesh:nan,..."); None reads the PARMMG_FAULTS env var
     faults: Optional[object] = None
@@ -1308,85 +1319,111 @@ def adapt(
     last_good = fs.snapshot(mesh)
     it = start_it
     attempts = 0
-    while it < opts.niter:
+    fs.arm_preemption()
+    try:
+        while it < opts.niter:
+            if fs.preempt_requested:
+                raise failsafe.PreemptionError(
+                    f"SIGTERM received before iteration {it} — the "
+                    "last committed checkpoint stands; resume to "
+                    "continue"
+                )
 
-        def _iteration(m):
-            m = run_batched_sweep_loop(m, opts, emult, history, it, hausd)
-            m = fs.fire(it, "remesh", m)
-            fs.validate(m, it, phase="remesh")
-            return m
+            def _iteration(m):
+                m = run_batched_sweep_loop(
+                    m, opts, emult, history, it, hausd
+                )
+                m = fs.fire(it, "remesh", m)
+                fs.validate(m, it, phase="remesh")
+                return m
 
-        try:
-            if attempts:
-                # recovery re-entry: its recompiles (grown shapes /
-                # cleared caches) are accounted to a recovery phase,
-                # not charged against the steady budgets
-                with contracts.budget_exempt("iteration-retry"):
+            try:
+                if attempts:
+                    # recovery re-entry: its recompiles (grown shapes /
+                    # cleared caches) are accounted to a recovery
+                    # phase, not charged against the steady budgets
+                    with contracts.budget_exempt("iteration-retry"):
+                        mesh = _iteration(mesh)
+                else:
                     mesh = _iteration(mesh)
-            else:
-                mesh = _iteration(mesh)
-        except failsafe.MemoryBudgetError:
-            raise
-        except failsafe.CapacityError as e:
-            history.append(dict(iter=it, phase="remesh", failure=str(e),
-                                error=type(e).__name__))
-            if last_good is None:
+            except failsafe.MemoryBudgetError:
                 raise
-            mesh = failsafe.snapshot(last_good)
-            if attempts < fs.attempts:
-                attempts += 1
-                try:
-                    mesh = _grow_for_recovery(mesh, opts)
-                except failsafe.MemoryBudgetError as e2:
-                    history.append(dict(iter=it, failure=str(e2),
-                                        error=type(e2).__name__))
-                    status = tags.ReturnStatus.LOWFAILURE
-                    break
-                continue
-            status = tags.ReturnStatus.LOWFAILURE
-            break
-        except failsafe.RetraceError as e:
-            history.append(dict(iter=it, phase="remesh", failure=str(e),
-                                error=type(e).__name__))
-            if last_good is None:
-                raise
-            mesh = failsafe.snapshot(last_good)
-            if attempts < fs.attempts:
-                attempts += 1
-                jax.clear_caches()
-                continue
-            status = tags.ReturnStatus.LOWFAILURE
-            break
-        except (failsafe.NumericalError, FloatingPointError) as e:
-            # deterministic numerical poisoning: a re-run reproduces it,
-            # so the recovery is rollback + graded degradation, not
-            # retry (the reference's failed_handling ladder)
-            history.append(dict(iter=it, phase="remesh", failure=str(e),
-                                error=type(e).__name__))
-            if last_good is None:
-                raise
-            mesh = failsafe.snapshot(last_good)
-            status = tags.ReturnStatus.LOWFAILURE
-            break
-        attempts = 0
-        last_good = fs.snapshot(mesh)
-        if fs.ckpt is not None and fs.ckpt.due(it):
-            meshes = {"mesh": mesh}
-            if old_snapshot is not None:
-                meshes["old"] = old_snapshot
-            meta = dict(
-                qual_in=failsafe._histo_to_json(h0),
-                presize_skipped=presize_skipped,
-            )
-            aux = {}
-            if isinstance(hausd, (int, float)):
-                meta["hausd"] = float(hausd)
-            else:
-                aux["hausd"] = hausd
-            fs.save(it, meshes, history=history, emult=emult[0],
-                    meta=meta, aux_arrays=aux)
-        mesh = fs.post_iteration(it, mesh, history)
-        it += 1
+            except failsafe.CapacityError as e:
+                history.append(dict(iter=it, phase="remesh",
+                                    failure=str(e),
+                                    error=type(e).__name__))
+                if last_good is None:
+                    raise
+                mesh = failsafe.snapshot(last_good)
+                if attempts < fs.attempts:
+                    attempts += 1
+                    try:
+                        mesh = _grow_for_recovery(mesh, opts)
+                    except failsafe.MemoryBudgetError as e2:
+                        history.append(dict(iter=it, failure=str(e2),
+                                            error=type(e2).__name__))
+                        status = tags.ReturnStatus.LOWFAILURE
+                        break
+                    continue
+                status = tags.ReturnStatus.LOWFAILURE
+                break
+            except failsafe.RetraceError as e:
+                history.append(dict(iter=it, phase="remesh",
+                                    failure=str(e),
+                                    error=type(e).__name__))
+                if last_good is None:
+                    raise
+                mesh = failsafe.snapshot(last_good)
+                if attempts < fs.attempts:
+                    attempts += 1
+                    jax.clear_caches()
+                    continue
+                status = tags.ReturnStatus.LOWFAILURE
+                break
+            except (failsafe.NumericalError, FloatingPointError) as e:
+                # deterministic numerical poisoning: a re-run
+                # reproduces it, so the recovery is rollback + graded
+                # degradation, not retry (the reference's
+                # failed_handling ladder)
+                history.append(dict(iter=it, phase="remesh",
+                                    failure=str(e),
+                                    error=type(e).__name__))
+                if last_good is None:
+                    raise
+                mesh = failsafe.snapshot(last_good)
+                status = tags.ReturnStatus.LOWFAILURE
+                break
+            attempts = 0
+            last_good = fs.snapshot(mesh)
+            if fs.ckpt is not None and (
+                fs.ckpt.due(it) or fs.preempt_requested
+            ):
+                meshes = {"mesh": mesh}
+                if old_snapshot is not None:
+                    meshes["old"] = old_snapshot
+                meta = dict(
+                    qual_in=failsafe._histo_to_json(h0),
+                    presize_skipped=presize_skipped,
+                )
+                aux = {}
+                if isinstance(hausd, (int, float)):
+                    meta["hausd"] = float(hausd)
+                else:
+                    aux["hausd"] = hausd
+                fs.save(it, meshes, history=history, emult=emult[0],
+                        meta=meta, aux_arrays=aux, force=True)
+            if fs.preempt_requested:
+                # the grace window of a real preemption notice: the
+                # iteration's checkpoint is committed, so exit through
+                # the same unabsorbable path the injected kill takes
+                raise failsafe.PreemptionError(
+                    f"SIGTERM received: iteration {it} checkpointed — "
+                    "exiting for preemption; resume to continue"
+                )
+            mesh = fs.post_iteration(it, mesh, history)
+            it += 1
+    finally:
+        fs.disarm_preemption()
 
     # once, after the final iteration — polishing between iterations is
     # wasted work (the next iteration's insertion sweeps disturb it)

@@ -225,7 +225,7 @@ def _spmd_sweep_fn(dmesh, ecap, noinsert, noswap, nomove, nosurf):
 
 def _remesh_phase_global(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
-    it: int, hausd,
+    it: int, hausd, fs=None,
 ) -> Mesh:
     """Multi-process remesh phase: each sweep is ONE SPMD program over
     the global device mesh — with 2 processes owning 4 devices each, the
@@ -262,8 +262,16 @@ def _remesh_phase_global(
             dmesh, ecap, opts.noinsert, opts.noswap, opts.nomove,
             opts.nosurf,
         )(sg, hausd)
-        s2 = multihost.gather_stacked(out)
-        stats = multihost.gather_stacked(stats)
+        if fs is not None:
+            # device-resident validation (psum status inside the
+            # shard_map): a poisoned shard is caught HERE, before its
+            # NaNs ride the cross-process allgather below — and
+            # validate="basic" costs one tiny device reduce, zero host
+            # gathers of mesh arrays
+            fs.validate_sharded(out, dmesh, it, phase="sweep")
+        wd = fs.watchdog if fs is not None else None
+        s2 = multihost.gather_stacked(out, timeout=wd)
+        stats = multihost.gather_stacked(stats, timeout=wd)
         rec = dict(
             nsplit=int(np.sum(stats.nsplit)),
             ncollapse=int(np.sum(stats.ncollapse)),
@@ -286,14 +294,17 @@ def _remesh_phase_global(
 
 def remesh_phase(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
-    it: int, hausd: float = 0.01,
+    it: int, hausd: float = 0.01, fs=None,
 ) -> Mesh:
     """Operator sweeps to convergence on every shard at once (vmapped) —
     the batched analog of the per-group `MMG5_mmg3d1_delone` calls in the
     reference loop body (`src/libparmmg1.c:662-800`). Control flow is the
-    shared `run_sweep_loop` engine with cross-shard-aggregated stats."""
+    shared `run_sweep_loop` engine with cross-shard-aggregated stats.
+    `fs` (a FailsafeHarness) arms the device-resident per-sweep
+    validation on the SPMD path."""
     if _use_spmd_sweeps():
-        return _remesh_phase_global(st, opts, emult, history, it, hausd)
+        return _remesh_phase_global(st, opts, emult, history, it, hausd,
+                                    fs=fs)
     return _remesh_phase_local(st, opts, emult, history, it, hausd)
 
 
@@ -547,87 +558,127 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
     last_good = fs.snapshot(stacked)
     it = start_it
     attempts = 0
-    while it < opts.niter:
+    fs.arm_preemption()
+    try:
+        while it < opts.niter:
+            if fs.preempt_requested:
+                raise failsafe.PreemptionError(
+                    f"SIGTERM received before iteration {it} — the "
+                    "last committed checkpoint stands; resume to "
+                    "continue"
+                )
+            # phase-boundary heartbeat: all processes must arrive
+            # within the watchdog window or a silent peer loss becomes
+            # a typed PeerLostError instead of a hang in the first
+            # collective of the iteration (no-op single-process)
+            fs.heartbeat(it)
 
-        def _iteration(st, cm, ic):
-            st, cm, ic = _one_iteration(
-                st, opts, hausd, history, it, cm, ic, emult, nparts,
-                fs=fs,
-            )
-            fs.validate(st, it, comm=cm, phase="iteration")
-            return st, cm, ic
+            def _iteration(st, cm, ic):
+                st, cm, ic = _one_iteration(
+                    st, opts, hausd, history, it, cm, ic, emult, nparts,
+                    fs=fs,
+                )
+                fs.validate(st, it, comm=cm, phase="iteration")
+                return st, cm, ic
 
-        try:
-            if attempts:
-                # recovery re-entry: recompiles (grown shapes / cleared
-                # caches) land in a recovery phase, exempt from the
-                # steady retrace budgets
-                with contracts.budget_exempt("iteration-retry"):
+            try:
+                if attempts:
+                    # recovery re-entry: recompiles (grown shapes /
+                    # cleared caches) land in a recovery phase, exempt
+                    # from the steady retrace budgets
+                    with contracts.budget_exempt("iteration-retry"):
+                        stacked, comm, icap = _iteration(
+                            stacked, comm, icap
+                        )
+                else:
                     stacked, comm, icap = _iteration(stacked, comm, icap)
-            else:
-                stacked, comm, icap = _iteration(stacked, comm, icap)
-        except failsafe.CapacityError as e:
-            history.append(dict(iter=it, phase="iteration",
-                                failure=str(e), error=type(e).__name__))
-            if last_good is None:
+            except failsafe.CapacityError as e:
+                history.append(dict(iter=it, phase="iteration",
+                                    failure=str(e),
+                                    error=type(e).__name__))
+                if last_good is None:
+                    raise
+                stacked = failsafe.snapshot(last_good)
+                comm = None
+                icap = None
+                if attempts < fs.attempts:
+                    attempts += 1
+                    try:
+                        stacked = _grow_stacked_for_recovery(
+                            stacked, opts
+                        )
+                    except failsafe.MemoryBudgetError as e2:
+                        history.append(dict(iter=it, failure=str(e2),
+                                            error=type(e2).__name__))
+                        status = tags.ReturnStatus.LOWFAILURE
+                        break
+                    continue
+                status = tags.ReturnStatus.LOWFAILURE
+                break
+            except failsafe.RetraceError as e:
+                history.append(dict(iter=it, phase="iteration",
+                                    failure=str(e),
+                                    error=type(e).__name__))
+                if last_good is None:
+                    raise
+                stacked = failsafe.snapshot(last_good)
+                comm = None
+                icap = None
+                if attempts < fs.attempts:
+                    attempts += 1
+                    jax.clear_caches()
+                    continue
+                status = tags.ReturnStatus.LOWFAILURE
+                break
+            except failsafe.PeerLostError:
+                # a dead peer cannot be rolled back around: the SPMD
+                # world is broken, every further collective would hang.
+                # Re-raise through the graded-degradation ladder — the
+                # cure is checkpoint-backed restart, not LOWFAILURE
+                # (which would run the post-loop collectives below)
                 raise
-            stacked = failsafe.snapshot(last_good)
-            comm = None
-            icap = None
-            if attempts < fs.attempts:
-                attempts += 1
-                try:
-                    stacked = _grow_stacked_for_recovery(stacked, opts)
-                except failsafe.MemoryBudgetError as e2:
-                    history.append(dict(iter=it, failure=str(e2),
-                                        error=type(e2).__name__))
-                    status = tags.ReturnStatus.LOWFAILURE
-                    break
-                continue
-            status = tags.ReturnStatus.LOWFAILURE
-            break
-        except failsafe.RetraceError as e:
-            history.append(dict(iter=it, phase="iteration",
-                                failure=str(e), error=type(e).__name__))
-            if last_good is None:
-                raise
-            stacked = failsafe.snapshot(last_good)
-            comm = None
-            icap = None
-            if attempts < fs.attempts:
-                attempts += 1
-                jax.clear_caches()
-                continue
-            status = tags.ReturnStatus.LOWFAILURE
-            break
-        except (FloatingPointError, ValueError, RuntimeError,
-                OverflowError) as e:
-            # numeric/capacity/budget failures degrade gracefully;
-            # programming errors (TypeError, trace errors, ...)
-            # propagate — hiding them as LOWFAILURE would mask defects
-            history.append(dict(iter=it, failure=str(e),
-                                error=type(e).__name__))
-            if last_good is None:
-                raise
-            stacked = failsafe.snapshot(last_good)
-            status = tags.ReturnStatus.LOWFAILURE
-            comm = None
-            icap = None
-            break
-        attempts = 0
-        last_good = fs.snapshot(stacked)
-        if fs.ckpt is not None and fs.ckpt.due(it):
-            meta = dict(ckpt_meta or {})
-            meta["icap"] = int(icap) if icap is not None else None
-            aux = {}
-            if isinstance(hausd, (int, float)):
-                meta["hausd"] = float(hausd)
-            else:
-                aux["hausd"] = hausd
-            fs.save(it, {"mesh": stacked}, history=history,
-                    emult=emult[0], meta=meta, aux_arrays=aux)
-        stacked = fs.post_iteration(it, stacked, history)
-        it += 1
+            except (FloatingPointError, ValueError, RuntimeError,
+                    OverflowError) as e:
+                # numeric/capacity/budget failures degrade gracefully;
+                # programming errors (TypeError, trace errors, ...)
+                # propagate — hiding them as LOWFAILURE would mask
+                # defects
+                history.append(dict(iter=it, failure=str(e),
+                                    error=type(e).__name__))
+                if last_good is None:
+                    raise
+                stacked = failsafe.snapshot(last_good)
+                status = tags.ReturnStatus.LOWFAILURE
+                comm = None
+                icap = None
+                break
+            attempts = 0
+            last_good = fs.snapshot(stacked)
+            if fs.ckpt is not None and (
+                fs.ckpt.due(it) or fs.preempt_requested
+            ):
+                meta = dict(ckpt_meta or {})
+                meta["icap"] = int(icap) if icap is not None else None
+                aux = {}
+                if isinstance(hausd, (int, float)):
+                    meta["hausd"] = float(hausd)
+                else:
+                    aux["hausd"] = hausd
+                fs.save(it, {"mesh": stacked}, history=history,
+                        emult=emult[0], meta=meta, aux_arrays=aux,
+                        force=True)
+            if fs.preempt_requested:
+                # preemption grace window: the iteration's (sharded,
+                # barrier-committed) checkpoint is in place — exit via
+                # the unabsorbable path, like the injected kill
+                raise failsafe.PreemptionError(
+                    f"SIGTERM received: iteration {it} checkpointed — "
+                    "exiting for preemption; resume to continue"
+                )
+            stacked = fs.post_iteration(it, stacked, history)
+            it += 1
+    finally:
+        fs.disarm_preemption()
 
     stacked = assign_global_ids(stacked)
     comm = rebuild_comm(stacked, icap)
@@ -644,7 +695,8 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
     # src/grpsplit_pmmg.c:1224) — needs fresh adjacency for the walk
     old = jax.vmap(adjacency.build_adjacency)(stacked)
 
-    stacked = remesh_phase(stacked, opts, emult, history, it, hausd)
+    stacked = remesh_phase(stacked, opts, emult, history, it, hausd,
+                           fs=fs)
     stacked = jax.vmap(compact)(stacked)
     stacked = fs.fire(it, "remesh", stacked)
 

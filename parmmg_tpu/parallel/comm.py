@@ -16,6 +16,23 @@ import jax
 import jax.numpy as jnp
 
 
+def status_allgather(
+    vec: jax.Array, axis_name: str = "shards"
+) -> jax.Array:
+    """Replicated [D, n] table of every shard's status vector.
+
+    One psum of a one-hot row scatter: each shard contributes its [n]
+    vector at its own row index, and the sum is identical (replicated)
+    on every shard — the role of the reference's per-phase
+    `MPI_Allgather` of the `ier` agreement, used by the device-resident
+    phase validator (`failsafe.stacked_status`) so only this tiny table
+    ever crosses to host."""
+    d = jax.lax.psum(1, axis_name)  # static axis size
+    row = jax.lax.axis_index(axis_name)
+    full = jnp.zeros((d,) + vec.shape, vec.dtype).at[row].set(vec)
+    return jax.lax.psum(full, axis_name)
+
+
 def halo_exchange(
     vals: jax.Array, comm_idx: jax.Array, axis_name: str = "shards"
 ) -> jax.Array:

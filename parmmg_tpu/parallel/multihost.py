@@ -24,14 +24,31 @@ Env contract (mirrors `mpirun`'s rank/world interface):
 On TPU pods with the standard runtime metadata (GCE/Cloud TPU), plain
 `jax.distributed.initialize()` auto-discovers all three — set
 PMMGTPU_COORDINATOR=auto to use that path.
-"""
+
+Failure surface: `barrier()` is the coordination point the sharded
+checkpointer commits through (the role of the reference's
+`MPI_Barrier` around its per-rank I/O), and `run_with_watchdog()`
+bounds every such collective so a silently dead peer becomes a typed
+`failsafe.PeerLostError` instead of an indefinite hang — the MPI
+analog is a communicator error handler, which plain collectives on a
+lost TCP peer never deliver."""
 
 from __future__ import annotations
 
 import os
+import threading
 
 import jax
 import numpy as np
+
+
+class MultihostConfigError(RuntimeError):
+    """The PMMGTPU_* multi-host env contract is malformed (non-integer
+    or out-of-range rank/world). Raised BEFORE
+    `jax.distributed.initialize`, which would otherwise block forever
+    waiting for a world that can never assemble (a rank >= world size
+    means some expected rank never dials in)."""
+
 
 _INITIALIZED = False
 
@@ -40,7 +57,9 @@ def init_from_env() -> bool:
     """Initialize the multi-controller runtime from the env contract.
 
     Returns True when running multi-process (after initialization),
-    False for plain single-process runs. Idempotent."""
+    False for plain single-process runs. Idempotent. A malformed
+    rank/world raises :class:`MultihostConfigError` up front instead of
+    letting the coordination handshake hang."""
     global _INITIALIZED
     if _INITIALIZED:
         return True
@@ -48,28 +67,276 @@ def init_from_env() -> bool:
     if not coord:
         return False
     if coord == "auto":
+        _arm_cpu_collectives()
         jax.distributed.initialize()
     else:
         nprocs = os.environ.get("PMMGTPU_NUM_PROCS")
         pid = os.environ.get("PMMGTPU_PROC_ID")
         if nprocs is None or pid is None:
-            raise RuntimeError(
+            raise MultihostConfigError(
                 "multi-host env contract incomplete: "
                 f"PMMGTPU_COORDINATOR={coord!r} requires "
                 "PMMGTPU_NUM_PROCS (world size) and PMMGTPU_PROC_ID "
                 "(0-based rank) to be set as well"
             )
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(nprocs),
-            process_id=int(pid),
-        )
+        try:
+            world = int(nprocs)
+            rank = int(pid)
+        except ValueError as e:
+            raise MultihostConfigError(
+                f"PMMGTPU_NUM_PROCS={nprocs!r} / PMMGTPU_PROC_ID={pid!r} "
+                "must be integers"
+            ) from e
+        if world <= 0:
+            raise MultihostConfigError(
+                f"PMMGTPU_NUM_PROCS={world} must be positive"
+            )
+        if not 0 <= rank < world:
+            raise MultihostConfigError(
+                f"PMMGTPU_PROC_ID={rank} out of range for "
+                f"PMMGTPU_NUM_PROCS={world} (want 0 <= rank < world; "
+                "jax.distributed.initialize would hang on this)"
+            )
+        _arm_cpu_collectives()
+        _initialize_resilient(coord, world, rank)
     _INITIALIZED = True
     return True
 
 
+def _arm_cpu_collectives() -> None:
+    """A multi-process world that lands on the CPU backend (the
+    2-process CI harness, host fallbacks) needs a cross-process
+    collectives implementation: the default CPU client rejects every
+    multiprocess computation outright ("Multiprocess computations
+    aren't implemented on the CPU backend"). Must run before the
+    backend exists — `init_from_env` is pre-backend by contract
+    (package __init__ hook). Harmless for TPU/GPU runs (the flag only
+    affects CPU client construction)."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if _xb.CPU_COLLECTIVES_IMPLEMENTATION.value == "none":
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
+    except (ImportError, AttributeError):
+        pass  # jax without the flag: nothing to arm
+
+
+# set by the distributed client's missed-heartbeat callback: a peer
+# stopped responding (or the coordination service reported a dead
+# task). `barrier()` checks it so a peer loss surfaces as a typed
+# PeerLostError at the next phase boundary instead of the default
+# behavior — jaxlib's callback LOG(QFATAL)s the SURVIVING process,
+# which would turn one preempted worker into a whole-job crash with no
+# chance to run the checkpoint-backed exit path.
+_PEER_LOSS = threading.Event()
+_PEER_LOSS_STATUS: list = []
+
+
+def peer_loss_detected() -> bool:
+    return _PEER_LOSS.is_set()
+
+
+def _on_peer_loss(status) -> None:
+    # called from a runtime thread: only record; raising here would be
+    # lost (and must not run Python teardown on a foreign thread)
+    _PEER_LOSS_STATUS.append(str(status))
+    _PEER_LOSS.set()
+
+
+def _initialize_resilient(coord: str, world: int, rank: int) -> None:
+    """`jax.distributed.initialize` with a survivable peer-loss path.
+
+    Identical to the stock initialization (service on rank 0, client
+    everywhere, preemption sync manager) except the client's
+    ``missed_heartbeat_callback`` records the failure instead of the
+    default LOG(QFATAL) process termination — the failsafe layer, not
+    the runtime, decides how a survivor dies (checkpoint-backed
+    PeerLostError exit). Falls back to the stock path on jax builds
+    whose client factory lacks the callback parameter."""
+    from jax._src import distributed as jdist
+
+    try:
+        from jax._src.lib import xla_extension as xe
+    except ImportError:  # pragma: no cover - very old/new layouts
+        xe = None
+    state = jdist.global_state
+    if state.client is not None:  # already initialized elsewhere
+        return
+    try:
+        if xe is None:
+            raise TypeError("no xla_extension")
+        if rank == 0:
+            bind = "[::]:" + coord.rsplit(":", 1)[1]
+            state.service = xe.get_distributed_runtime_service(
+                bind, world,
+            )
+        client = xe.get_distributed_runtime_client(
+            coord, rank,
+            init_timeout=300,
+            missed_heartbeat_callback=_on_peer_loss,
+            shutdown_on_destruction=True,
+            use_compression=True,
+        )
+        client.connect()
+        state.client = client
+        state.process_id = rank
+        state.num_processes = world
+        state.coordinator_address = coord
+        try:
+            state.initialize_preemption_sync_manager()
+        except Exception:
+            pass  # optional (TPU preemption notices); not load-bearing
+    except TypeError:
+        # client factory without the callback kwarg: stock init (peer
+        # loss then terminates the survivor — documented degradation)
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=world,
+            process_id=rank,
+        )
+
+
 def is_multiprocess() -> bool:
     return jax.process_count() > 1
+
+
+def run_with_watchdog(fn, tag: str = "collective",
+                      timeout: float | None = None):
+    """Run `fn` (a blocking collective) under a liveness watchdog.
+
+    `timeout=None` runs `fn` inline (no thread, no overhead). With a
+    timeout, `fn` runs in a daemon worker thread; if it has not
+    completed within `timeout` seconds, a `failsafe.PeerLostError` is
+    raised in the caller — converting the silent hang of a collective
+    whose peer died (killed worker, preempted pod slice) into a typed,
+    catchable failure. The stuck worker thread cannot be cancelled; the
+    expected reaction to PeerLostError is checkpoint-backed process
+    exit, which reaps it."""
+    if timeout is None:
+        return fn()
+    import time
+
+    box: dict = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # re-raised on the waiting side
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(
+        target=_run, name=f"parmmg-watchdog:{tag}", daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + timeout
+    while True:
+        if done.wait(min(1.0, max(deadline - time.monotonic(), 0.01))):
+            break
+        from ..failsafe import PeerLostError
+
+        if _PEER_LOSS.is_set():
+            # the runtime's heartbeat tracking confirmed the loss —
+            # no point waiting out the rest of the window
+            raise PeerLostError(
+                f"collective '{tag}' abandoned: the coordination "
+                "service reports a dead peer "
+                f"({_PEER_LOSS_STATUS[-1] if _PEER_LOSS_STATUS else ''})"
+            )
+        if time.monotonic() >= deadline:
+            raise PeerLostError(
+                f"collective '{tag}' did not complete within "
+                f"{timeout:.1f}s (world size {jax.process_count()}, "
+                f"rank {jax.process_index()}) — a peer process is "
+                "unreachable; restart and resume from the last "
+                "checkpoint"
+            )
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+def _barrier_fn():
+    """One compiled psum-of-ones over ALL global devices — the barrier
+    collective. Built lazily and memoized on first use (rebuilding
+    jit(shard_map) per barrier would retrace every call, parmmg-lint
+    PML004). A psum via shard_map is the ONE collective path every
+    backend this repo runs on supports (`multihost_utils`'
+    pmap-based sync is rejected by the multi-process CPU runtime the
+    2-process tests use)."""
+    global _BARRIER
+    if _BARRIER is not None:
+        return _BARRIER
+    import jax.numpy as jnp
+    from jax.sharding import (
+        Mesh as DeviceMesh, NamedSharding, PartitionSpec as P,
+    )
+
+    devs = jax.devices()
+    dmesh = DeviceMesh(np.array(devs), ("procs",))
+    sh = NamedSharding(dmesh, P("procs"))
+    ones = np.ones(len(devs), np.int32)
+    x = jax.make_array_from_callback(
+        (len(devs),), sh, lambda idx: ones[idx]
+    )
+
+    def body(blk):
+        return jax.lax.psum(jnp.sum(blk), "procs")
+
+    # parmmg-lint: disable=PML004 -- built once, memoized in _BARRIER
+    fn = jax.jit(jax.shard_map(
+        body, mesh=dmesh, in_specs=(P("procs"),), out_specs=P()
+    ))
+    _BARRIER = (fn, x, len(devs))
+    return _BARRIER
+
+
+_BARRIER = None
+
+
+def barrier(tag: str = "parmmg-barrier",
+            timeout: float | None = None) -> None:
+    """Coordination barrier across all processes (no-op single-process).
+
+    A psum-of-ones over the global device mesh: the program cannot
+    complete until every process has dispatched it, and its replicated
+    result is fetched locally — so returning from here means every peer
+    reached this point (the `MPI_Barrier` role around the reference's
+    per-rank I/O). The sharded checkpointer brackets its two-phase
+    commit with this (data barrier before the rank-0 manifest, commit
+    barrier after), and the drivers use it as the phase-boundary
+    heartbeat. `timeout` arms the :func:`run_with_watchdog` conversion
+    of a lost peer into `failsafe.PeerLostError`; collective failures
+    the coordination service surfaces on its own (peer disconnect RPC
+    errors) are mapped to the same type."""
+    if not is_multiprocess():
+        return
+    from ..failsafe import PeerLostError
+
+    def _sync():
+        fn, x, ndev = _barrier_fn()
+        got = int(jax.device_get(fn(x)))
+        if got != ndev:
+            raise RuntimeError(
+                f"barrier psum returned {got}, want {ndev}"
+            )
+
+    try:
+        run_with_watchdog(_sync, tag=tag, timeout=timeout)
+    except PeerLostError:
+        raise
+    except Exception as e:
+        # the coordination service noticed the dead peer before the
+        # watchdog did (heartbeat/RPC errors surface as runtime
+        # errors): same meaning, same typed failure
+        raise PeerLostError(
+            f"collective '{tag}' failed "
+            f"(rank {jax.process_index()}): {e}"
+        ) from e
 
 
 def put_sharded_global(tree, dmesh):
@@ -98,24 +365,73 @@ def put_sharded_global(tree, dmesh):
     return jax.tree_util.tree_map(put, tree)
 
 
-def gather_stacked(tree):
+# replicate-identity programs keyed by device assignment (jit caches
+# per leaf structure/shapes underneath); a dict, not lru_cache, because
+# device tuples are the key and there is realistically one entry
+_REPLICATE_FNS: dict = {}
+
+
+def _identity(tree):
+    return tree
+
+
+def _replicate_fn(device_assignment):
+    fn = _REPLICATE_FNS.get(device_assignment)
+    if fn is None:
+        from jax.sharding import (
+            Mesh as DeviceMesh, NamedSharding, PartitionSpec as P,
+        )
+
+        sh = NamedSharding(
+            DeviceMesh(np.array(device_assignment), ("d",)), P()
+        )
+        # parmmg-lint: disable=PML004 -- memoized in _REPLICATE_FNS
+        fn = jax.jit(_identity, out_shardings=sh)
+        _REPLICATE_FNS[device_assignment] = fn
+    return fn
+
+
+def gather_stacked(tree, timeout: float | None = None):
     """Fetch a (possibly cross-process) stacked pytree to host numpy on
     every process — the allgather that feeds the replicated host phases
     (retag/analysis exchanges). Within one process this is a plain
-    device_get."""
+    device_get.
+
+    All non-addressable leaves ride ONE jitted replicate-identity
+    program (out_shardings=replicated) instead of one collective per
+    leaf: a ~20-leaf mesh pytree per sweep meant ~20 sequential
+    collective dispatch/rendezvous rounds, which is both slower and —
+    observed on the 2-process CPU runtime — a hang surface (two ranks
+    wedged mid-sequence in `process_allgather`, one dispatching leaf k
+    while the other waits on it; see the stall tripwire in
+    tests/multihost_worker.py). `timeout` puts the whole gather
+    (dispatch + wait) under `run_with_watchdog`, so a residual wedge
+    becomes a typed `failsafe.PeerLostError` instead of an indefinite
+    hang."""
     if not is_multiprocess():
         return jax.device_get(tree)
-    from jax.experimental import multihost_utils
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    idx = [
+        i for i, a in enumerate(leaves)
+        if isinstance(a, jax.Array) and not a.is_fully_addressable
+    ]
+    if idx:
+        sub = [leaves[i] for i in idx]
+        dev = sub[0].sharding._device_assignment
 
-    def fetch(a):
-        if isinstance(a, jax.Array) and not a.is_fully_addressable:
-            # replicates the global value on every process
-            return np.asarray(
-                multihost_utils.process_allgather(a, tiled=True)
-            )
-        # host numpy / fully-addressable leaves are already whole;
-        # process_allgather would CONCATENATE the per-process copies
-        # (doubling dim 0) instead of replicating
-        return np.asarray(jax.device_get(a))
+        def _gather():
+            rep = _replicate_fn(dev)(sub)
+            return [np.asarray(r.addressable_data(0)) for r in rep]
 
-    return jax.tree_util.tree_map(fetch, tree)
+        vals = run_with_watchdog(
+            _gather, tag="gather_stacked", timeout=timeout
+        )
+        for i, v in zip(idx, vals):
+            leaves[i] = v
+    # host numpy / fully-addressable leaves are already whole on every
+    # process (replicated host phases) — a plain device_get suffices
+    out = [
+        a if isinstance(a, np.ndarray) else np.asarray(jax.device_get(a))
+        for a in leaves
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -63,9 +63,90 @@ def adapt_main():
     )
 
 
+def failsafe_main():
+    """Multi-host fail-safe workload for test_m10's kill/resume tests.
+
+    Runs `adapt_stacked_input` (8 shards over however many processes
+    the PMMGTPU_* env describes) with a sharded, barrier-committed
+    checkpoint directory (PMMGTPU_CKPT_DIR) and the collective watchdog
+    armed (PMMGTPU_WATCHDOG seconds). Rank-targeted PARMMG_FAULTS kill
+    exactly one worker mid-run; the survivor's next heartbeat converts
+    the silent loss into PeerLostError and this worker exits with
+    failsafe.PEER_LOST_EXIT_CODE (a resume-refusal exits with
+    MISMATCH_EXIT_CODE). A clean run prints ADAPT_DIGEST exactly like
+    `adapt_main`, so kill+resume can be compared bit for bit against an
+    uninterrupted run."""
+    import hashlib
+    import os
+
+    from parmmg_tpu.parallel import multihost
+
+    multi = multihost.init_from_env()
+
+    import jax
+    import numpy as np
+
+    from parmmg_tpu import failsafe
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_stacked_input, merge_adapted,
+    )
+    from parmmg_tpu.ops import quality
+    from parmmg_tpu.parallel.distribute import split_mesh
+    from parmmg_tpu.parallel.partition import sfc_partition
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    ckdir = os.environ.get("PMMGTPU_CKPT_DIR") or None
+    watchdog = float(os.environ.get("PMMGTPU_WATCHDOG", "60"))
+    stall = os.environ.get("PMMGTPU_STALL_DUMP")
+    if stall:
+        # whole-run stall tripwire: dump every thread's Python stack
+        # and exit if the run wedges — the collective watchdog bounds
+        # the COORDINATION collectives, but a desync inside the mesh
+        # collectives themselves can only be diagnosed post-hoc
+        import faulthandler
+
+        faulthandler.dump_traceback_later(float(stall), exit=True)
+
+    # identical replicated host prep on every process
+    mesh = unit_cube_mesh(3)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 8)))
+    st, comm = split_mesh(mesh, part, 8)
+    opts = DistOptions(
+        hsiz=0.32, niter=2, max_sweeps=4, nparts=8, min_shard_elts=8,
+        hgrad=None, polish_sweeps=0, checkpoint_dir=ckdir,
+        watchdog_timeout=watchdog if multi else None,
+    )
+    try:
+        out, comm2, info = adapt_stacked_input(st, comm, opts)
+    except failsafe.PeerLostError as e:
+        print(f"PEER_LOST rank={jax.process_index()}: {e}", flush=True)
+        # the stuck watchdog thread cannot be joined; a clean interpreter
+        # shutdown would hang on it — exit hard, the checkpoint survives
+        os._exit(failsafe.PEER_LOST_EXIT_CODE)
+    except failsafe.CheckpointMismatchError as e:
+        print(f"CKPT_MISMATCH rank={jax.process_index()}: {e}",
+              flush=True)
+        os._exit(failsafe.MISMATCH_EXIT_CODE)
+    merged = merge_adapted(out, comm2)
+    d = jax.device_get(merged)
+    h = hashlib.sha256()
+    for name in ("vert", "vmask", "tet", "tmask", "tria", "trmask",
+                 "tref", "trref", "vtag", "trtag"):
+        h.update(np.ascontiguousarray(np.asarray(getattr(d, name))).tobytes())
+    qh = quality.quality_histogram(merged)
+    print(
+        f"ADAPT_DIGEST {h.hexdigest()} ne={int(qh.ne)} "
+        f"qmin={float(qh.qmin):.9f} qavg={float(qh.qavg):.9f} "
+        f"status={int(info['status'])}",
+        flush=True,
+    )
+
+
 def main():
     if "--adapt" in sys.argv:
         return adapt_main()
+    if "--failsafe" in sys.argv:
+        return failsafe_main()
     # the package __init__ auto-initializes the multi-controller
     # runtime from the PMMGTPU_* env (before any backend touch) — the
     # same path `python -m parmmg_tpu` takes under a process launcher

@@ -16,6 +16,40 @@ import sys
 import pytest
 
 
+def test_init_from_env_validates_rank_and_world(monkeypatch):
+    """Bugfix coverage: a malformed PMMGTPU_PROC_ID / NUM_PROCS must
+    raise a typed MultihostConfigError BEFORE touching
+    jax.distributed.initialize (which would block forever waiting for
+    a rank that can never dial in)."""
+    from parmmg_tpu.parallel import multihost
+
+    monkeypatch.setattr(multihost, "_INITIALIZED", False)
+    monkeypatch.setenv("PMMGTPU_COORDINATOR", "127.0.0.1:1")
+    monkeypatch.setenv("PMMGTPU_NUM_PROCS", "2")
+    monkeypatch.setenv("PMMGTPU_PROC_ID", "2")
+    with pytest.raises(multihost.MultihostConfigError,
+                       match="out of range"):
+        multihost.init_from_env()
+    monkeypatch.setenv("PMMGTPU_PROC_ID", "-1")
+    with pytest.raises(multihost.MultihostConfigError,
+                       match="out of range"):
+        multihost.init_from_env()
+    monkeypatch.setenv("PMMGTPU_NUM_PROCS", "zebra")
+    with pytest.raises(multihost.MultihostConfigError,
+                       match="integers"):
+        multihost.init_from_env()
+    monkeypatch.setenv("PMMGTPU_NUM_PROCS", "0")
+    monkeypatch.setenv("PMMGTPU_PROC_ID", "0")
+    with pytest.raises(multihost.MultihostConfigError,
+                       match="positive"):
+        multihost.init_from_env()
+    monkeypatch.delenv("PMMGTPU_PROC_ID")
+    with pytest.raises(multihost.MultihostConfigError,
+                       match="incomplete"):
+        multihost.init_from_env()
+    assert not multihost._INITIALIZED
+
+
 @pytest.mark.slow
 def test_two_process_collectives(tmp_path):
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -154,3 +188,126 @@ def test_two_process_adaptation_matches_single_process(tmp_path):
             f"proc {pid} diverged:\n  2-proc: {ok[0]}\n"
             f"  1-proc: {ref_line[0]}"
         )
+
+
+def _run_failsafe_pair(tmp_path, tag, extra_env, timeout=1200):
+    """Two coordinated `multihost_worker.py --failsafe` processes (4
+    CPU devices each); returns (exit codes, log texts)."""
+    import socket
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "multihost_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs, logs = [], []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PYTHONPATH=root,
+            PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
+            PMMGTPU_NUM_PROCS="2",
+            PMMGTPU_PROC_ID=str(pid),
+        )
+        env.update(extra_env)
+        lp = tmp_path / f"{tag}{pid}.log"
+        logs.append(lp)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, "--failsafe"], env=env,
+            stdout=open(lp, "w"), stderr=subprocess.STDOUT, cwd=root,
+        ))
+    try:
+        rcs = [p.wait(timeout=timeout) for p in procs]
+    finally:
+        for p in procs:
+            p.kill()
+    return rcs, [lp.read_text() for lp in logs]
+
+
+def _digests(text):
+    return [ln for ln in text.splitlines()
+            if ln.startswith("ADAPT_DIGEST")]
+
+
+@pytest.mark.slow
+def test_two_process_kill_resume_sharded_checkpoint(tmp_path):
+    """The multi-host fail-safe acceptance path, subprocess-real:
+
+    1. an uninterrupted 2-process run fixes the reference digest;
+    2. the same run with ``it0:post:kill@rank1`` and a checkpoint dir:
+       rank 1 must die with KILL_EXIT_CODE only AFTER the sharded
+       checkpoint's barrier-committed manifest (layout + digests
+       verified here), and rank 0's collective watchdog must convert
+       the silent peer loss into PeerLostError
+       (PEER_LOST_EXIT_CODE) instead of hanging;
+    3. a single-process resume attempt against the 2-process
+       checkpoint refuses loudly (MISMATCH_EXIT_CODE);
+    4. a 2-process resume completes bit-identically to (1).
+
+    The reference analog: per-rank restart state + MPI_Barrier'd
+    checkpoint I/O in the node-scale runs of RR-9307."""
+    import json
+
+    from parmmg_tpu import failsafe
+
+    rcs, logs = _run_failsafe_pair(
+        tmp_path, "ref", {"PMMGTPU_WATCHDOG": "300"}
+    )
+    assert rcs == [0, 0], logs[0][-2000:] + logs[1][-2000:]
+    ref = _digests(logs[0])
+    assert ref and _digests(logs[1]) == ref
+
+    ck = tmp_path / "ck"
+    rcs, logs = _run_failsafe_pair(tmp_path, "kill", {
+        "PMMGTPU_CKPT_DIR": str(ck),
+        "PMMGTPU_WATCHDOG": "60",
+        "PARMMG_FAULTS": "it0:post:kill@rank1",
+    })
+    assert rcs[1] == failsafe.KILL_EXIT_CODE, (rcs, logs[1][-2000:])
+    assert rcs[0] == failsafe.PEER_LOST_EXIT_CODE, (rcs, logs[0][-2000:])
+    assert "PEER_LOST" in logs[0]
+    # barrier-committed sharded layout: manifest + one data file per
+    # rank, no temp litter, digests verifying
+    names = sorted(os.listdir(ck))
+    assert names == ["ckpt_00000.json", "ckpt_00000.proc0.npz",
+                     "ckpt_00000.proc1.npz"], names
+    with open(ck / "ckpt_00000.json") as f:
+        doc = json.load(f)
+    assert doc["world"] == 2 and doc["sharded"] == ["mesh"]
+    import numpy as np
+
+    for r in (0, 1):
+        with np.load(ck / f"ckpt_00000.proc{r}.npz") as z:
+            arrs = {k: z[k] for k in z.files}
+        assert failsafe._digest_arrays(arrs) == doc["digests"][str(r)]
+
+    # world-size mismatch: a 1-process run refuses to resume
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PYTHONPATH=root, PMMGTPU_CKPT_DIR=str(ck),
+    )
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "tests", "multihost_worker.py"),
+         "--failsafe"],
+        env=env, capture_output=True, text=True, timeout=1200, cwd=root,
+    )
+    assert p.returncode == failsafe.MISMATCH_EXIT_CODE, (
+        p.returncode, p.stdout[-2000:], p.stderr[-2000:],
+    )
+    assert "CKPT_MISMATCH" in p.stdout
+
+    rcs, logs = _run_failsafe_pair(tmp_path, "resume", {
+        "PMMGTPU_CKPT_DIR": str(ck), "PMMGTPU_WATCHDOG": "300",
+    })
+    assert rcs == [0, 0], logs[0][-2000:] + logs[1][-2000:]
+    assert _digests(logs[0]) == ref and _digests(logs[1]) == ref, (
+        _digests(logs[0]), ref,
+    )

@@ -1,0 +1,309 @@
+"""M14: multi-host fail-safe — the in-process half.
+
+Unit coverage for the subsystems the 2-process harness
+(test_m10_multihost.py, tools/fault_smoke.py --multihost) exercises end
+to end, kept subprocess-free so tier-1 can afford them:
+
+- device-resident validation (`failsafe.stacked_status` /
+  `PhaseValidator.check_sharded`): equivalence with the gathered
+  vmapped validator on the same corrupted meshes, and the zero-host-
+  gather contract (no `multihost.gather_stacked`, only the tiny status
+  table fetched, computation clean under the
+  `lint.contracts.no_host_transfers` guard);
+- the sharded checkpointer's layout, digests, rank-slice round trip
+  and world-size refusal (two in-process Checkpointer instances
+  standing in for two ranks — the commit barrier is injected);
+- checkpoint GC (`AdaptOptions.checkpoint_keep`);
+- rank-targeted fault grammar (``kill@rank1``) and the ``sigterm``
+  fault kind's checkpoint-then-PreemptionError path, resumed to a
+  bit-identical result;
+- the collective watchdog (`multihost.run_with_watchdog`) converting a
+  hang into `PeerLostError` while passing values and real errors
+  through.
+"""
+
+import os
+import signal
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parmmg_tpu import failsafe
+from parmmg_tpu.core.tags import ReturnStatus
+from parmmg_tpu.lint import contracts
+from parmmg_tpu.models.adapt import AdaptOptions, adapt
+from parmmg_tpu.parallel import multihost
+from parmmg_tpu.parallel.distribute import split_mesh
+from parmmg_tpu.parallel.partition import sfc_partition
+from parmmg_tpu.parallel.shard import device_mesh, put_sharded
+from parmmg_tpu.utils.gen import unit_cube_mesh
+
+C_OPTS = dict(hsiz=0.45, niter=3, max_sweeps=3, hgrad=None,
+              polish_sweeps=0)
+
+
+@pytest.fixture(scope="module")
+def stacked8():
+    mesh = unit_cube_mesh(2)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 8)))
+    st, comm = split_mesh(mesh, part, 8)
+    return st
+
+
+def _corruptions(st):
+    """(name, corrupted stacked mesh, expected nonzero status column)
+    triples — NaN coords, inverted tet, out-of-range connectivity (the
+    per-shard overflow-truncation signature)."""
+    nan = st.replace(vert=st.vert.at[2, 0].set(jnp.nan))
+    # swapping two vertices of a live tet flips its orientation
+    t0 = st.tet[5, 0]
+    inv = st.replace(
+        tet=st.tet.at[5, 0].set(t0[jnp.asarray([1, 0, 2, 3])])
+    )
+    oob = st.replace(tet=st.tet.at[3, 0, 0].set(10 ** 6))
+    return [("nan", nan, 0), ("inverted", inv, 2), ("oob", oob, 3)]
+
+
+# ---------------------------------------------------------------------------
+# device-resident validator
+# ---------------------------------------------------------------------------
+
+
+def test_stacked_status_equals_gathered_validator(stacked8):
+    """The psum-reduced device status must agree, per shard and per
+    counter, with the gathered vmapped validator on the same corrupted
+    meshes — and both validators must agree on raise/pass."""
+    dm = device_mesh(8)
+    clean = np.asarray(
+        jax.device_get(failsafe.stacked_status(put_sharded(stacked8, dm),
+                                               dm))
+    )
+    assert clean.shape == (8, len(failsafe.STATUS_COLS))
+    assert not clean.any()
+    v = failsafe.PhaseValidator(level="basic", every=1)
+    v.check(stacked8, 0)
+    v.check_sharded(put_sharded(stacked8, dm), dm, 0)
+    for name, bad, col in _corruptions(stacked8):
+        dev = np.asarray(
+            jax.device_get(failsafe.stacked_status(put_sharded(bad, dm),
+                                                   dm))
+        )
+        host = np.asarray(jax.device_get(
+            jax.vmap(failsafe._sanity_counts)(bad)
+        ))
+        np.testing.assert_array_equal(dev, host, err_msg=name)
+        assert dev[:, col].sum() >= 1, (name, dev)
+        with pytest.raises(failsafe.NumericalError):
+            v.check(bad, 0)
+        with pytest.raises(failsafe.NumericalError, match="per-shard"):
+            v.check_sharded(put_sharded(bad, dm), dm, 0)
+
+
+def test_basic_sharded_validation_no_host_gather(stacked8, monkeypatch):
+    """``validate="basic"`` on the SPMD path performs ZERO host gathers:
+    `multihost.gather_stacked` is never called, the only explicit fetch
+    is the [D, 4] status table, and the computation runs clean under
+    the runtime transfer guard (`lint.contracts.no_host_transfers` —
+    load-bearing on accelerator backends, where an implicit D2H sync
+    raises; the CPU backend's arrays are host-resident so only the
+    structural assertions bite here)."""
+    dm = device_mesh(8)
+    stg = put_sharded(stacked8, dm)
+
+    def no_gather(tree):
+        raise AssertionError(
+            "validate='basic' must not gather the mesh to host"
+        )
+
+    monkeypatch.setattr(multihost, "gather_stacked", no_gather)
+    fetched = []
+    real_get = jax.device_get
+
+    def counting_get(x):
+        fetched.append(np.asarray(real_get(x)).size)
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    v = failsafe.PhaseValidator(level="basic", every=1)
+    with contracts.no_host_transfers():
+        v.check_sharded(stg, dm, 0)
+    assert fetched, "the status table must be fetched"
+    assert max(fetched) <= 8 * len(failsafe.STATUS_COLS), fetched
+    # cadence / level gates hold for the sharded path too
+    failsafe.PhaseValidator(level="off").check_sharded(stg, dm, 0)
+    failsafe.PhaseValidator(level="basic", every=2).check_sharded(
+        stg, dm, 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpointer (two in-process "ranks")
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_checkpoint_roundtrip_and_refusals(tmp_path, stacked8):
+    opts = AdaptOptions(hsiz=0.35, niter=2)
+    ck = str(tmp_path / "ck")
+    barriers = []
+    ranks = [
+        failsafe.Checkpointer(ck, opts, "distributed", rank=r, world=2,
+                              barrier=barriers.append)
+        for r in (0, 1)
+    ]
+    aux = {"hausd": np.asarray([0.01, 0.02])}
+    # rank 1 commits first: the manifest must still come from rank 0
+    for c in (ranks[1], ranks[0]):
+        c.save(0, {"mesh": stacked8}, history=[{"iter": 0}], emult=1.7,
+               meta={"icap": 4}, aux_arrays=aux)
+    assert sorted(os.listdir(ck)) == [
+        "ckpt_00000.json", "ckpt_00000.proc0.npz", "ckpt_00000.proc1.npz",
+    ]
+    # two-phase commit: each rank passes the data + commit barriers
+    assert barriers == ["ckpt-data-0", "ckpt-commit-0"] * 2
+    import json
+
+    with open(os.path.join(ck, "ckpt_00000.json")) as f:
+        doc = json.load(f)
+    assert doc["world"] == 2 and sorted(doc["digests"]) == ["0", "1"]
+    # per-rank digests verify against the published shard files
+    for r in (0, 1):
+        with np.load(os.path.join(ck, f"ckpt_00000.proc{r}.npz")) as z:
+            arrs = {k: z[k] for k in z.files}
+        assert failsafe._digest_arrays(arrs) == doc["digests"][str(r)]
+    rs = ranks[0].load()
+    assert rs is not None and rs.it == 0 and rs.emult == 1.7
+    for name in ("vert", "tet", "vmask", "tmask", "vglob", "met"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rs.mesh, name)),
+            np.asarray(jax.device_get(getattr(stacked8, name))),
+            err_msg=name,
+        )
+    np.testing.assert_array_equal(
+        rs.meta["aux_arrays"]["hausd"], aux["hausd"]
+    )
+    # a 1-process resume of a 2-process checkpoint refuses loudly
+    single = failsafe.Checkpointer(ck, opts, "distributed", rank=0,
+                                   world=1, barrier=lambda t: None)
+    with pytest.raises(failsafe.CheckpointMismatchError,
+                       match="2-process"):
+        single.load()
+    # as does a same-world resume under different trajectory options
+    other = failsafe.Checkpointer(
+        ck, AdaptOptions(hsiz=0.2, niter=2), "distributed", rank=0,
+        world=2, barrier=lambda t: None,
+    )
+    with pytest.raises(failsafe.CheckpointMismatchError, match="hsiz"):
+        other.load()
+
+
+def test_checkpoint_gc_keep(tmp_path, stacked8):
+    opts = AdaptOptions(hsiz=0.35)
+    for keep, want in ((2, [1, 2]), (1, [2])):
+        ck = str(tmp_path / f"keep{keep}")
+        c = failsafe.Checkpointer(ck, opts, "distributed", keep=keep,
+                                  rank=0, world=1)
+        for it in range(3):
+            c.save(it, {"mesh": stacked8}, history=[], emult=1.6)
+        assert c._known() == want, (keep, sorted(os.listdir(ck)))
+        # no orphan npz survives its pruned manifest
+        npz = sorted(f for f in os.listdir(ck) if f.endswith(".npz"))
+        assert npz == [f"ckpt_{i:05d}.npz" for i in want]
+    # the harness wires AdaptOptions.checkpoint_keep through
+    fs = failsafe.harness(
+        AdaptOptions(checkpoint_keep=5,
+                     checkpoint_dir=str(tmp_path / "h")),
+        driver="centralized",
+    )
+    assert fs.ckpt.keep == 5
+
+
+# ---------------------------------------------------------------------------
+# rank-targeted faults + sigterm preemption
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_rank_grammar():
+    plan = failsafe.FaultPlan.parse(
+        "it1:remesh:kill@rank1,it0:post:sigterm"
+    )
+    assert [(f.it, f.phase, f.kind, f.rank) for f in plan.faults] == [
+        (1, "remesh", "kill", 1), (0, "post", "sigterm", None),
+    ]
+    # this test process is jax process 0: a rank-1 fault is not ours
+    assert not plan.faults[0].mine and plan.faults[1].mine
+    # firing the rank-1 kill at its boundary is a no-op here
+    state = unit_cube_mesh(2)
+    out = plan.fire(1, "remesh", state)
+    assert out is state and not plan.faults[0].fired
+    assert not plan.take(1, "remesh", "kill")
+    # a rank-0 kill IS ours (kill_mode=raise so the test survives)
+    mine = failsafe.FaultPlan.parse("it0:remesh:kill@rank0",
+                                    kill_mode="raise")
+    with pytest.raises(failsafe.PreemptionError):
+        mine.fire(0, "remesh", state)
+    for bad in ("it0:remesh:kill@r1", "it0:remesh:kill@rankx",
+                "it0:remesh:kill@"):
+        with pytest.raises(ValueError):
+            failsafe.FaultPlan.parse(bad)
+
+
+def test_sigterm_checkpoints_then_exits_and_resumes(tmp_path):
+    """The preemption path end to end, in process: an injected SIGTERM
+    mid-iteration sets the harness flag, the driver commits the
+    iteration's checkpoint and raises PreemptionError; resuming
+    reproduces the uninterrupted run; the previous SIGTERM disposition
+    is restored."""
+    prev = signal.getsignal(signal.SIGTERM)
+    ref, ref_info = adapt(unit_cube_mesh(2), AdaptOptions(**C_OPTS))
+
+    def key(m, info):
+        h = info["qual_out"]
+        return (
+            int(np.asarray(jax.device_get(m.vmask)).sum()),
+            int(np.asarray(jax.device_get(m.tmask)).sum()),
+            tuple(int(x) for x in np.asarray(jax.device_get(h.counts))),
+        )
+
+    ck = str(tmp_path / "ck")
+    with pytest.raises(failsafe.PreemptionError, match="checkpointed"):
+        adapt(unit_cube_mesh(2),
+              AdaptOptions(faults="it1:remesh:sigterm", **C_OPTS),
+              checkpoint_dir=ck)
+    assert signal.getsignal(signal.SIGTERM) == prev
+    assert any(f.endswith(".json") for f in os.listdir(ck))
+    assert not [f for f in os.listdir(ck) if ".tmp." in f]
+    res, res_info = adapt(unit_cube_mesh(2), AdaptOptions(**C_OPTS),
+                          checkpoint_dir=ck)
+    assert res_info["status"] == ReturnStatus.SUCCESS
+    assert key(res, res_info) == key(ref, ref_info)
+
+
+# ---------------------------------------------------------------------------
+# collective watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_converts_hang_to_peer_lost():
+    with pytest.raises(failsafe.PeerLostError, match="did not complete"):
+        multihost.run_with_watchdog(
+            lambda: threading.Event().wait(), tag="hung", timeout=0.3,
+        )
+    # values and real errors pass through un-wrapped
+    assert multihost.run_with_watchdog(lambda: 42, timeout=5.0) == 42
+    assert multihost.run_with_watchdog(lambda: 43) == 43  # no thread
+    with pytest.raises(ValueError, match="boom"):
+        multihost.run_with_watchdog(
+            lambda: (_ for _ in ()).throw(ValueError("boom")),
+            timeout=5.0,
+        )
+
+
+def test_heartbeat_noop_without_world_or_timeout(tmp_path):
+    # single process: barrier and heartbeat return immediately
+    multihost.barrier("t", timeout=0.1)
+    fs = failsafe.harness(AdaptOptions(), driver="centralized")
+    assert fs.watchdog is None
+    fs.heartbeat(0)  # no timeout configured -> no collective

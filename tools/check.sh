@@ -24,6 +24,14 @@ rc=$?
 echo "## fault-smoke rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
+# 2-process multi-host stage: rank-targeted kill after a sharded,
+# barrier-committed checkpoint; the survivor's watchdog must raise a
+# typed PeerLostError and a 2-process resume must be bit-identical
+timeout -k 10 1800 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --multihost
+rc=$?
+echo "## fault-smoke-multihost rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

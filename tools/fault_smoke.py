@@ -13,6 +13,18 @@ fixture, end to end through the public drivers:
    parent resumes from the atomic checkpoint and must reproduce the
    uninterrupted run's mesh counts and quality histogram.
 
+``--multihost`` runs the 2-process stage instead (its own check.sh
+gate, between this smoke and tier-1): three phases of
+``tests/multihost_worker.py --failsafe`` under the PMMGTPU_* env —
+(A) an uninterrupted 2-process run for the reference digest; (B) the
+same run with a rank-targeted ``it0:post:kill@rank1`` fault and a
+sharded checkpoint directory: rank 1 must exit with KILL_EXIT_CODE
+after the barrier-committed checkpoint and rank 0's collective
+watchdog must convert the silent peer loss into PeerLostError
+(PEER_LOST_EXIT_CODE) instead of hanging; (C) a 2-process resume from
+the sharded checkpoint, which must reproduce phase A's merged-mesh
+digest bit for bit.
+
 Run hermetically on CPU: ``python tools/fault_smoke.py``. Exit 0 =
 every scenario behaved; any unhandled exception or mismatch fails the
 gate.
@@ -122,7 +134,102 @@ def main() -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _run_pair(worker, tmp, tag, extra_env, timeout=900):
+    """Launch 2 coordinated worker processes (4 CPU devices each) and
+    wait; returns (exit codes, log texts)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs, logs = [], []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PYTHONPATH=root,
+            PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
+            PMMGTPU_NUM_PROCS="2",
+            PMMGTPU_PROC_ID=str(pid),
+            # a wedged worker can be SIGABRT'ed for a Python stack
+            PYTHONFAULTHANDLER="1",
+        )
+        env.update(extra_env)
+        lp = os.path.join(tmp, f"{tag}{pid}.log")
+        logs.append(lp)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, "--failsafe"], env=env,
+            stdout=open(lp, "w"), stderr=subprocess.STDOUT, cwd=root,
+        ))
+    try:
+        rcs = [p.wait(timeout=timeout) for p in procs]
+    finally:
+        for p in procs:
+            p.kill()
+    return rcs, [open(lp).read() for lp in logs]
+
+
+def _digest_lines(text):
+    return [ln for ln in text.splitlines()
+            if ln.startswith("ADAPT_DIGEST")]
+
+
+def main_multihost() -> int:
+    """The 2-process kill/peer-lost/resume stage (see module
+    docstring). Uses the same worker as tests/test_m10_multihost.py so
+    the gate and the slow tests exercise one code path."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "multihost_worker.py")
+    tmp = tempfile.mkdtemp(prefix="parmmg_mh_smoke_")
+    ck = os.path.join(tmp, "ck")
+    try:
+        rcs, logs = _run_pair(
+            worker, tmp, "ref", {"PMMGTPU_WATCHDOG": "300"}
+        )
+        assert rcs == [0, 0], (rcs, logs[0][-2000:], logs[1][-2000:])
+        ref = _digest_lines(logs[0])
+        assert ref and _digest_lines(logs[1]) == ref, logs[0][-2000:]
+        print(f"[mh-smoke] reference run: {ref[0]}")
+
+        rcs, logs = _run_pair(worker, tmp, "kill", {
+            "PMMGTPU_CKPT_DIR": ck,
+            "PMMGTPU_WATCHDOG": "60",
+            "PARMMG_FAULTS": "it0:post:kill@rank1",
+        })
+        assert rcs[1] == failsafe.KILL_EXIT_CODE, (
+            rcs, logs[1][-2000:],
+        )
+        assert rcs[0] == failsafe.PEER_LOST_EXIT_CODE, (
+            rcs, logs[0][-2000:],
+        )
+        names = sorted(os.listdir(ck))
+        assert names == ["ckpt_00000.json", "ckpt_00000.proc0.npz",
+                         "ckpt_00000.proc1.npz"], names
+        assert not [f for f in names if ".tmp." in f]
+        print("[mh-smoke] kill@rank1: rank1 exited "
+              f"{failsafe.KILL_EXIT_CODE} after the barrier-committed "
+              f"checkpoint; rank0 converted the silent peer loss into "
+              f"PeerLostError (exit {failsafe.PEER_LOST_EXIT_CODE})")
+
+        rcs, logs = _run_pair(worker, tmp, "resume", {
+            "PMMGTPU_CKPT_DIR": ck, "PMMGTPU_WATCHDOG": "300",
+        })
+        assert rcs == [0, 0], (rcs, logs[0][-2000:], logs[1][-2000:])
+        got = _digest_lines(logs[0])
+        assert got == ref and _digest_lines(logs[1]) == ref, (got, ref)
+        print("[mh-smoke] 2-process resume from the sharded checkpoint "
+              "matches the uninterrupted run bit for bit")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2])
+    if len(sys.argv) > 1 and sys.argv[1] == "--multihost":
+        sys.exit(main_multihost())
     sys.exit(main())
