@@ -144,6 +144,22 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
     _enable_compile_cache()
 
     opts = AdaptOptions(niter=niter, hsiz=hsiz, max_sweeps=max_sweeps, hgrad=None)
+    # PARMMG_BENCH_CKPT=1: checkpoint the TIMED run (fresh dir — the
+    # warmup must not leave a checkpoint the timed run would resume
+    # from) through the async staging path, so the record carries a
+    # real ckpt_overlap_s — how much checkpoint wall time hid behind
+    # compute. Off by default: the headline throughput row stays
+    # I/O-free (the key then records 0.0).
+    steady_opts = opts
+    _ckpt_tmp = None
+    if os.environ.get("PARMMG_BENCH_CKPT"):
+        import dataclasses
+        import tempfile
+
+        _ckpt_tmp = tempfile.mkdtemp(prefix="parmmg_bench_ckpt_")
+        steady_opts = dataclasses.replace(
+            opts, checkpoint_dir=_ckpt_tmp, checkpoint_async=True,
+        )
 
     # retrace accounting (lint.contracts): the warmup run is EXPECTED
     # to compile; the timed run must hit the in-process executable
@@ -159,10 +175,14 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         mesh = _workload(n, hsiz, tight)
         counter.enter_phase("steady")
         t0 = time.perf_counter()
-        out, info = adapt(mesh, opts,
+        out, info = adapt(mesh, steady_opts,
                           phase_hook=lambda p: counter.enter_phase(
                               f"steady:{p}"))
         wall = time.perf_counter() - t0
+    if _ckpt_tmp is not None:
+        import shutil
+
+        shutil.rmtree(_ckpt_tmp, ignore_errors=True)
 
     ne = int(out.ntet)
     h = quality.quality_histogram(out)
@@ -191,6 +211,10 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         "recompiles": dict(counter.counts),
         "steady_recompiles": steady_misses,
         "sweep_active_fraction": saf,
+        # checkpoint wall time hidden behind compute by the async
+        # staging writer (0.0 when the run checkpoints synchronously or
+        # not at all — see PARMMG_BENCH_CKPT above)
+        "ckpt_overlap_s": float(info.get("ckpt_overlap_s", 0.0)),
     }
 
 

@@ -100,6 +100,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="input is per-shard name.<rank>.mesh files")
     p.add_argument("-ls", type=float, nargs="?", const=0.0, default=None,
                    help="level-set discretization at the given isovalue")
+    p.add_argument("-ckpt", dest="ckpt", default=None,
+                   help="checkpoint directory or store spec "
+                        "(mem://bucket, file://dir); a compatible "
+                        "checkpoint found there RESUMES the run — "
+                        "elastically across world sizes")
+    p.add_argument("-ckpt-every", dest="ckpt_every", type=int, default=1,
+                   help="checkpoint cadence in outer iterations")
+    p.add_argument("-ckpt-async", dest="ckpt_async", action="store_true",
+                   help="stage checkpoints on a background writer "
+                        "(blocks only on the previous epoch's commit)")
     return p
 
 
@@ -187,6 +197,16 @@ def main(argv=None) -> int:
         ifc_layers=args.ifc_layers,
         grps_ratio=args.grps_ratio,
     )
+    if args.ckpt:
+        # durable checkpoint/resume (failsafe layer): a path selects
+        # the POSIX store, mem://&friends an object store spec
+        if "://" in args.ckpt and not args.ckpt.startswith("file://"):
+            opts.checkpoint_store = args.ckpt
+        else:
+            opts.checkpoint_dir = args.ckpt[7:] \
+                if args.ckpt.startswith("file://") else args.ckpt
+        opts.checkpoint_every = args.ckpt_every
+        opts.checkpoint_async = args.ckpt_async
     if args.mesh_size:
         # the reference's remesher target size (-mesh-size,
         # PMMG_REMESHER_TARGET_MESH_SIZE role): per-shard growth floor

@@ -388,6 +388,30 @@ class ParMesh:
     def get_dparameter(self, param: Param) -> float:
         return self.dparam.get(Param(param), 0.0)
 
+    # --- checkpoint / elastic-resume plumbing -----------------------------
+    def set_checkpoint(self, dirpath: Optional[str] = None, *,
+                       store=None, every: int = 1, keep: int = 2,
+                       async_staging: bool = False):
+        """Arm durable checkpoint/resume for the next `parmmglib_*`
+        run (the failsafe layer's `checkpoint_dir`/`checkpoint_store`
+        options; no `PMMG_Param` analog exists — the reference restarts
+        from its per-rank mesh files, RR-9307 §restart). `dirpath`
+        selects the POSIX `LocalFSStore`; `store` a
+        `io.ckpt_store.CheckpointStore` instance or spec string
+        (``mem://bucket``, ``file:///path``) with GCS-style object
+        semantics. `async_staging` stages the device→host snapshot to
+        a background writer so the adapt loop only blocks on the
+        previous epoch's commit. A compatible checkpoint found at entry
+        RESUMES the run — including elastically across world sizes
+        (see README "Failure handling & checkpointing")."""
+        o = self.opts
+        o.checkpoint_dir = dirpath
+        o.checkpoint_store = store
+        o.checkpoint_every = int(every)
+        o.checkpoint_keep = int(keep)
+        o.checkpoint_async = bool(async_staging)
+        return ReturnStatus.SUCCESS
+
     # --- distributed-API communicator setters -----------------------------
     def set_number_of_node_communicators(self, n: int):
         self._node_comms = [None] * n

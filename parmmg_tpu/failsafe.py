@@ -44,6 +44,33 @@ loss by the collective watchdog (`multihost.run_with_watchdog`) which
 raises :class:`PeerLostError` instead of hanging. Faults can be
 rank-targeted (``it1:remesh:kill@rank1``) so every multi-host path is
 deterministically testable with 2+ CPU processes.
+
+Elasticity + durability (the last three ROADMAP gaps of the fail-safe
+story):
+
+- **elastic resume**: a manifest written by an N-process world loads
+  under an M-process world — every process digest-verifies all N
+  source shard files and re-concatenates the replicated host state
+  (the host picture is replicated-deterministic, so world size is a
+  resource layout, not a trajectory option). The hard refusal stays
+  ONLY for an options-fingerprint mismatch. When the checkpoint's
+  shard count no longer matches the device layout, the drivers re-cut
+  the merged state through the ordinary `parallel/distribute` +
+  `partition` path (owner ranks and comm tables rebuilt from vglob).
+- **pluggable durable storage** (`io.ckpt_store`): all checkpoint I/O
+  goes through a :class:`~parmmg_tpu.io.ckpt_store.CheckpointStore`
+  (`LocalFSStore` — the POSIX tmp+rename layout; `ObjectStore` — GCS
+  semantics, single-object atomic put + manifest-last commit), every
+  operation under bounded retry with exponential backoff +
+  deterministic jitter and a per-op timeout; `ioerror`/`slowio` faults
+  at the ``ckpt`` fault phase drive each retry/abort path in tests.
+- **async snapshot staging** (`AdaptOptions.checkpoint_async` /
+  ``PMMGTPU_ASYNC_CKPT``): the device→host snapshot is taken at the
+  iteration boundary (double-buffered — each staged epoch owns its
+  host arrays), but serialization + store puts run on a background
+  writer thread; the adapt loop blocks only at the commit barrier of
+  the PREVIOUS checkpoint, and the SIGTERM/preemption path drains the
+  queue before exiting (`FailsafeHarness.finish`).
 """
 
 from __future__ import annotations
@@ -54,6 +81,7 @@ import json
 import os
 import signal
 import threading
+import time
 from functools import lru_cache
 from typing import Dict, List, Optional, Tuple
 
@@ -63,6 +91,7 @@ import numpy as np
 
 from .core import tags
 from .core.mesh import Mesh, tet_volumes
+from .io.ckpt_store import CheckpointIOError  # noqa: F401  (re-export)
 
 # exit code of an injected ``kill`` fault (simulated preemption) — the
 # test harness and tools/check.sh smoke stage assert on it
@@ -71,10 +100,14 @@ KILL_EXIT_CODE = 86
 # into a checkpoint-backed exit (tools/fault_smoke.py --multihost and
 # the m10 subprocess tests assert on it)
 PEER_LOST_EXIT_CODE = 87
-# exit code a worker uses when resume REFUSED (world-size/fingerprint
+# exit code a worker uses when resume REFUSED (an options-fingerprint
 # mismatch, CheckpointMismatchError) — distinct so tests can tell a
 # loud refusal from a crash
 MISMATCH_EXIT_CODE = 88
+# exit code a worker uses when checkpoint I/O failed past its bounded
+# retries (io.ckpt_store.CheckpointIOError) — the chaos harness and
+# smoke stages assert the family {0, 86, 87, 88, 89} and nothing else
+CKPT_IO_EXIT_CODE = 89
 
 CHECKPOINT_FORMAT = 1
 
@@ -368,8 +401,17 @@ class PhaseValidator:
 # deterministic fault injection
 # ---------------------------------------------------------------------------
 
-FAULT_PHASES = ("analysis", "metric", "remesh", "interp", "migrate", "post")
-FAULT_KINDS = ("nan", "overflow", "retrace", "kill", "sigterm")
+FAULT_PHASES = (
+    "analysis", "metric", "remesh", "interp", "migrate", "post", "ckpt",
+)
+FAULT_KINDS = (
+    "nan", "overflow", "retrace", "kill", "sigterm", "ioerror", "slowio",
+    "preempt-notice",
+)
+# kinds that live at the ``ckpt`` phase: they fire inside the
+# checkpoint STORE (consumed per store operation via
+# `FaultPlan.io_fault`, not at a driver phase boundary)
+_IO_FAULT_KINDS = ("ioerror", "slowio")
 
 
 @dataclasses.dataclass
@@ -405,7 +447,15 @@ class FaultPlan:
     - ``retrace``: raises :class:`RetraceError` (the transient-XLA
       class) — recovered by clear-caches + retry;
     - ``kill``: simulated preemption — the process exits with
-      :data:`KILL_EXIT_CODE` (checkpoint/resume covers it).
+      :data:`KILL_EXIT_CODE` (checkpoint/resume covers it);
+    - ``preempt-notice``: a maintenance-event notice
+      (`parallel.multihost.request_preemption_notice`) — the drivers
+      force an out-of-cadence checkpoint at the next iteration boundary
+      and keep running (the proactive half of preemption handling);
+    - ``ioerror`` / ``slowio`` (``ckpt`` phase only): checkpoint-store
+      I/O faults, consumed per STORE OPERATION via :meth:`io_fault` —
+      for these the ``it<k>`` field indexes store ops (0-based, per
+      process), not iterations, so "fail the 3rd put" is expressible.
     """
 
     def __init__(self, faults: Optional[List[Fault]] = None,
@@ -414,6 +464,7 @@ class FaultPlan:
         if kill_mode not in ("exit", "raise"):
             raise ValueError(f"kill_mode {kill_mode!r} not in (exit, raise)")
         self.kill_mode = kill_mode
+        self._ckpt_ops = 0   # store-operation ordinal (io_fault clock)
 
     @classmethod
     def parse(cls, spec: str, kill_mode: str = "exit") -> "FaultPlan":
@@ -443,6 +494,12 @@ class FaultPlan:
             if kind not in FAULT_KINDS:
                 raise ValueError(
                     f"unknown fault kind {kind!r} (one of {FAULT_KINDS})"
+                )
+            if (phase == "ckpt") != (kind in _IO_FAULT_KINDS):
+                raise ValueError(
+                    f"fault token {tok!r}: kinds {_IO_FAULT_KINDS} pair "
+                    "exclusively with the 'ckpt' phase (store-operation "
+                    "faults), other kinds with the driver phases"
                 )
             faults.append(Fault(it, phase, kind, rank=rank))
         return cls(faults, kill_mode=kill_mode)
@@ -478,6 +535,33 @@ class FaultPlan:
                 return True
         return False
 
+    def io_fault(self, op: str, name: str,
+                 timeout: Optional[float] = None) -> None:
+        """Checkpoint-store fault hook (`CheckpointStore.fault_cb`),
+        invoked before every raw store attempt. Consumes pending
+        ``ckpt``-phase faults: the ``it<k>`` field is the 0-based STORE
+        OPERATION ordinal (per process) at/after which the fault arms;
+        each fault fires exactly once, in schedule order. ``ioerror``
+        raises OSError — the store's bounded retry absorbs isolated
+        ones; schedule at least `attempts` of them to force the typed
+        :class:`~parmmg_tpu.io.ckpt_store.CheckpointIOError` abort.
+        ``slowio`` outsleeps the store's per-op timeout (a no-op when
+        no timeout is configured), driving the timeout→retry path."""
+        k = self._ckpt_ops
+        self._ckpt_ops += 1
+        for f in self.faults:
+            if f.fired or f.phase != "ckpt" or not f.mine or f.it > k:
+                continue
+            f.fired = True
+            if f.kind == "ioerror":
+                raise OSError(
+                    f"injected checkpoint ioerror at store op {k} "
+                    f"({op} {name!r}) (fault plan)"
+                )
+            if f.kind == "slowio" and timeout is not None:
+                time.sleep(timeout + 0.25)
+            return
+
     def fire(self, it: int, phase: str, state):
         """Apply every pending fault for this (it, phase) boundary.
         Returns the (possibly poisoned) state; may raise or exit."""
@@ -507,6 +591,19 @@ class FaultPlan:
                 raise RetraceError(
                     f"injected transient retrace/XLA error at {where} "
                     "(fault plan)"
+                )
+            elif f.kind == "preempt-notice":
+                # proactive maintenance-event notice: the harness polls
+                # it between iterations and checkpoints out of cadence
+                # BEFORE any SIGTERM arrives — the run itself continues
+                from .parallel import multihost
+
+                print(
+                    f"[failsafe] injected preemption notice at {where} "
+                    "(fault plan)", flush=True,
+                )
+                multihost.request_preemption_notice(
+                    f"injected at {where} (fault plan)"
                 )
             elif f.kind == "sigterm":
                 # real preemption notice: the platform's SIGTERM, aimed
@@ -548,7 +645,14 @@ class FaultPlan:
 _FINGERPRINT_EXCLUDE = frozenset({
     "verbose", "niter", "checkpoint_dir", "checkpoint_every", "faults",
     "mem_budget_mb", "validate", "validate_every", "recovery_attempts",
-    "checkpoint_keep", "watchdog_timeout",
+    "checkpoint_keep", "watchdog_timeout", "checkpoint_store",
+    "checkpoint_async",
+    # nparts is a RESOURCE layout, not a trajectory option, under
+    # elastic resume: a checkpoint taken at one shard count may be
+    # re-cut onto another (the drivers merge + re-partition through
+    # parallel/distribute when the counts differ), exactly like the
+    # world size it used to travel with
+    "nparts",
 })
 
 _MESH_DATA_FIELDS = tuple(
@@ -616,6 +720,10 @@ class ResumeState:
     history: List[dict]
     emult: float
     meta: dict                   # hausd, qual_in, icap, presize_skipped...
+    # how many processes wrote the loaded checkpoint — != the current
+    # world size marks an ELASTIC resume (the state was re-concatenated
+    # from the source world's shard files)
+    source_world: int = 1
 
     @property
     def mesh(self) -> Mesh:
@@ -643,38 +751,78 @@ def _rank_rows(nrows: int, world: int, rank: int) -> Tuple[int, int]:
     return rank * nrows // world, (rank + 1) * nrows // world
 
 
+def _proc_of(name: str) -> Optional[int]:
+    """Rank of a per-rank shard file name (``ckpt_*.proc<r>.npz``), or
+    None for the manifest / single-file npz."""
+    if not name.endswith(".npz"):
+        return None
+    stem = name[:-4]
+    i = stem.rfind(".proc")
+    if i < 0 or not stem[i + 5:].isdigit():
+        return None
+    return int(stem[i + 5:])
+
+
 class Checkpointer:
-    """Per-iteration atomic checkpoints under one directory.
+    """Per-iteration atomic checkpoints through a pluggable store.
+
+    All I/O goes through an `io.ckpt_store.CheckpointStore` (default:
+    `LocalFSStore` over ``checkpoint_dir`` — the original POSIX
+    tmp+rename layout; ``AdaptOptions.checkpoint_store`` selects an
+    object store with GCS put semantics instead). Every store op runs
+    under bounded retry + backoff + per-op timeout; what follows
+    describes the PROTOCOL, which is backend-independent because it
+    relies only on atomic whole-object puts and manifest-last ordering.
 
     Single-process layout: ``ckpt_<it:05d>.npz`` (exact mesh arrays,
     full capacity — restoring reproduces the running state bit for bit,
-    capacities included) + ``ckpt_<it:05d>.json`` (iteration, options
-    fingerprint, sweep state, history, auxiliary metadata). Both are
-    written to a temp file and published with ``os.replace`` (via
-    `io.medit.atomic_replace`), json LAST — the json is the commit
-    record, so a kill can never leave a readable-but-truncated
-    checkpoint.
+    capacities included) then ``ckpt_<it:05d>.json`` (iteration,
+    options fingerprint, sweep state, history, auxiliary metadata) as
+    the LAST object — the json is the commit token, so a kill can
+    never leave a readable-but-truncated checkpoint.
 
     Multi-process (``world > 1``, the per-rank restart state of the
     reference's node-scale runs): each process writes only its shard
     rows as ``ckpt_<it:05d>.proc<rank>.npz``; after a coordination
-    ``barrier`` confirms every rank's data file is published, rank 0
+    ``barrier`` confirms every rank's data object is published, rank 0
     writes the json manifest (world size, per-rank content digests,
     which mesh keys are sharded) and a second barrier releases the
     world — a kill at ANY point therefore leaves either the old or the
-    new checkpoint complete, never a torn one. `load` refuses loudly
-    (:class:`CheckpointMismatchError`) when the manifest's world size
-    or options fingerprint differs from the resuming run, and falls
-    back to the previous checkpoint when a data file is unreadable or
-    fails its digest.
+    new checkpoint complete, never a torn one.
 
-    The newest `keep` checkpoints are retained; older ones are pruned
-    after each successful commit (`AdaptOptions.checkpoint_keep`).
+    **Elastic resume**: `load` accepts a manifest written by ANY world
+    size — every process digest-verifies all source shard files and
+    re-concatenates the replicated host state (world size is a resource
+    layout, not a trajectory option; the drivers re-cut when the shard
+    count itself changed). The hard :class:`CheckpointMismatchError`
+    refusal remains ONLY for an options-fingerprint mismatch; an
+    unreadable or digest-failing newest checkpoint falls back to the
+    previous one.
+
+    **Async staging** (`stage` / `commit_pending` / `drain`, driven by
+    the harness under ``checkpoint_async``): the device→host snapshot
+    happens in `stage` on the caller's thread (each epoch owns its host
+    arrays — the double buffer), serialization + data-object puts run
+    on a background writer thread, and the caller blocks only in
+    `commit_pending` — i.e. at the NEXT checkpoint, on the previous
+    epoch's commit. `overlap_s` accumulates writer time hidden behind
+    compute (the ``ckpt_overlap_s`` BENCH series).
+
+    GC: the newest `keep` committed checkpoints are retained. Pruning
+    is RANK-SCOPED so concurrent GC on a shared FS cannot race another
+    rank's in-flight write: rank r removes only its own
+    ``ckpt_*.proc<r>.npz`` objects; rank 0 additionally removes
+    manifests, single-file npzs and stale proc files of ranks outside
+    the current world (elastic leftovers). Concurrent deletes are
+    tolerated (a missing object is success).
     """
 
-    def __init__(self, dirpath: str, opts, driver: str, every: int = 1,
-                 keep: int = 2, rank: Optional[int] = None,
-                 world: Optional[int] = None, barrier=None):
+    def __init__(self, dirpath: Optional[str], opts, driver: str,
+                 every: int = 1, keep: int = 2,
+                 rank: Optional[int] = None, world: Optional[int] = None,
+                 barrier=None, store=None, fault_cb=None):
+        from .io import ckpt_store
+
         self.dir = dirpath
         self.driver = driver
         self.every = max(int(every), 1)
@@ -685,16 +833,21 @@ class Checkpointer:
             lambda tag: None
         )
         self.fingerprint, self.fields = options_fingerprint(opts)
+        if store is None:
+            store = getattr(opts, "checkpoint_store", None)
+        self.store = ckpt_store.make_store(store, dirpath,
+                                           fault_cb=fault_cb)
+        # async staging state: at most ONE epoch in flight
+        self._staged = None          # (it, thread, box, commit_main)
+        self.overlap_s = 0.0
 
     # -- naming ----------------------------------------------------------
-    def _base(self, it: int) -> str:
-        return os.path.join(self.dir, f"ckpt_{it:05d}")
+    def _name(self, it: int) -> str:
+        return f"ckpt_{it:05d}"
 
     def _known(self) -> List[int]:
-        if not os.path.isdir(self.dir):
-            return []
         its = []
-        for name in os.listdir(self.dir):
+        for name in self.store.list():
             if name.startswith("ckpt_") and name.endswith(".json"):
                 try:
                     its.append(int(name[5:-5]))
@@ -702,33 +855,60 @@ class Checkpointer:
                     pass
         return sorted(its)
 
+    # -- GC ---------------------------------------------------------------
+    def _prunable(self, name: str) -> bool:
+        if name.endswith(f".proc{self.rank}.npz"):
+            return True
+        if self.rank != 0:
+            return False
+        r = _proc_of(name)
+        if r is None:
+            return True          # manifest or single-file npz: rank 0's
+        return r >= self.world   # stale rank of a previous (larger) world
+
     def _prune(self) -> None:
-        """Retain only the newest `keep` committed checkpoints: every
-        file of an older iteration (json, npz, per-rank proc npz) is
-        unlinked. Runs after the commit barrier — a kill mid-prune can
-        only lose already-superseded state, which `load` skips."""
-        for old in self._known()[:-self.keep]:
-            prefix = f"ckpt_{old:05d}."
-            for name in os.listdir(self.dir):
-                if name.startswith(prefix):
-                    try:
-                        os.unlink(os.path.join(self.dir, name))
-                    except OSError:
-                        pass
+        """Retain only the newest `keep` committed checkpoints. Runs
+        after the commit barrier — a kill mid-prune can only lose
+        already-superseded state, which `load` skips. Rank-scoped (see
+        class docstring) so no rank ever unlinks an object another live
+        rank may be re-publishing. Epochs are judged against the oldest
+        RETAINED committed epoch rather than by enumerating manifests:
+        once rank 0 deletes an old manifest, the other ranks must still
+        recognize that epoch's data files as superseded (epoch ids are
+        monotone, so anything older than the retained window is dead —
+        committed or orphaned — while anything newer is in flight and
+        protected)."""
+        known = self._known()
+        if len(known) < self.keep:
+            return
+        threshold = known[-self.keep]
+        for name in self.store.list():
+            if not (name.startswith("ckpt_") and self._prunable(name)):
+                continue
+            digits = name[5:].split(".", 1)[0]
+            if digits.isdigit() and int(digits) < threshold:
+                self.store.delete(name)
 
     # -- save ------------------------------------------------------------
     def due(self, it: int) -> bool:
         return (it + 1) % self.every == 0
 
-    def save(self, it: int, meshes: Dict[str, Mesh], *, history, emult,
-             meta: Optional[dict] = None,
-             aux_arrays: Optional[Dict[str, np.ndarray]] = None) -> None:
-        from .io.medit import atomic_replace, fsync_dir
-
-        os.makedirs(self.dir, exist_ok=True)
-        base = self._base(it)
-        statics = {key: _mesh_static(m) for key, m in meshes.items()}
-        aux = dict(aux_arrays or {})
+    def _prepare(self, it: int, meshes: Dict[str, Mesh], *, history,
+                 emult, meta, aux_arrays):
+        """Snapshot + plan one checkpoint epoch. Device→host transfer
+        happens HERE, on the caller's thread (the staged epoch owns its
+        host arrays); what returns is pure host work:
+        ``(objs, tail, commit)`` where `objs` is this rank's data
+        objects ([(name, array-dict)]), `tail` runs on the WRITER
+        thread after the puts (collective-free commit work: the
+        world-1 manifest + prune), and `commit` runs on the CALLER
+        thread (the multi-process barrier/manifest/barrier/prune
+        sequence — collectives must never run on a worker thread)."""
+        base = self._name(it)
+        aux = {
+            k: np.asarray(jax.device_get(v))
+            for k, v in (aux_arrays or {}).items()
+        }
         doc = dict(
             format=CHECKPOINT_FORMAT,
             driver=self.driver,
@@ -736,34 +916,34 @@ class Checkpointer:
             fingerprint=self.fingerprint,
             options=self.fields,
             emult=float(emult),
-            history=history,
-            meshes=statics,
+            history=list(history),
+            meshes={key: _mesh_static(m) for key, m in meshes.items()},
             aux=sorted(aux),
-            meta=meta or {},
+            meta=dict(meta or {}),
             world=self.world,
         )
+        full = {
+            key: _mesh_arrays(m, key + "/") for key, m in meshes.items()
+        }
+
+        def manifest_bytes() -> bytes:
+            return json.dumps(doc, default=str).encode()
+
         if self.world == 1:
             arrs: Dict[str, np.ndarray] = {}
-            for key, m in meshes.items():
-                arrs.update(_mesh_arrays(m, key + "/"))
+            for fa in full.values():
+                arrs.update(fa)
             for k, v in aux.items():
-                arrs["aux/" + k] = np.asarray(jax.device_get(v))
-            with atomic_replace(base + ".npz", "wb") as f:
-                np.savez(f, **arrs)
-            with atomic_replace(base + ".json", "w") as f:
-                json.dump(doc, f, default=str)
-            fsync_dir(self.dir)
-            self._prune()
-            return
-        self._save_sharded(it, base, meshes, aux, doc)
+                arrs["aux/" + k] = v
 
-    def _save_sharded(self, it: int, base: str, meshes, aux, doc) -> None:
-        """Two-phase commit of a multi-process checkpoint: per-rank data
-        files -> data barrier -> rank-0 manifest (the commit record) ->
-        commit barrier -> GC. The host state is replicated-deterministic
-        across processes (`models/distributed` contract), so rank 0 can
-        compute every rank's slice digest locally for the manifest."""
-        from .io.medit import atomic_replace, fsync_dir
+            def tail():
+                # no collectives in a 1-process world: the writer can
+                # publish the commit token and GC itself, so an async
+                # epoch is durable as soon as the writer finishes
+                self.store.publish(base + ".json", manifest_bytes())
+                self._prune()
+
+            return [(base + ".npz", arrs)], tail, (lambda: None)
 
         sharded = sorted(
             key for key, m in meshes.items() if m.vert.ndim == 3
@@ -772,74 +952,145 @@ class Checkpointer:
 
         def rank_arrays(r: int) -> Dict[str, np.ndarray]:
             arrs: Dict[str, np.ndarray] = {}
-            for key, m in meshes.items():
-                full = _mesh_arrays(m, key + "/")
+            for key, fa in full.items():
                 if key in sharded:
-                    nrows = m.vert.shape[0]
+                    nrows = fa[key + "/vert"].shape[0]
                     lo, hi = _rank_rows(nrows, self.world, r)
-                    arrs.update(
-                        {k: v[lo:hi] for k, v in full.items()}
-                    )
+                    arrs.update({k: v[lo:hi] for k, v in fa.items()})
                 elif r == 0:
                     # replicated (non-stacked) state rides with rank 0
-                    arrs.update(full)
+                    arrs.update(fa)
             if r == 0:
                 for k, v in aux.items():
-                    arrs["aux/" + k] = np.asarray(jax.device_get(v))
+                    arrs["aux/" + k] = v
             return arrs
 
         own = rank_arrays(self.rank)
-        with atomic_replace(f"{base}.proc{self.rank}.npz", "wb") as f:
-            np.savez(f, **own)
-        fsync_dir(self.dir)
-        # every rank's data file is durable before the commit record
-        # exists — the manifest can never name a missing shard file
-        self._barrier(f"ckpt-data-{it}")
-        if self.rank == 0:
-            doc["digests"] = {
-                str(r): _digest_arrays(
-                    own if r == self.rank else rank_arrays(r)
-                )
-                for r in range(self.world)
-            }
-            with atomic_replace(base + ".json", "w") as f:
-                json.dump(doc, f, default=str)
-            fsync_dir(self.dir)
-        # no rank proceeds (and possibly dies mid-next-iteration) until
-        # the manifest is published: old and new are both complete here
-        self._barrier(f"ckpt-commit-{it}")
-        if self.rank == 0:
+
+        def commit():
+            # every rank's data object is durable before the commit
+            # record exists — the manifest can never name a missing
+            # shard file. The host state is replicated-deterministic
+            # (`models/distributed` contract), so rank 0 computes every
+            # rank's slice digest locally.
+            self._barrier(f"ckpt-data-{it}")
+            if self.rank == 0:
+                doc["digests"] = {
+                    str(r): _digest_arrays(
+                        own if r == self.rank else rank_arrays(r)
+                    )
+                    for r in range(self.world)
+                }
+                self.store.publish(base + ".json", manifest_bytes())
+            # no rank proceeds (and possibly dies mid-next-iteration)
+            # until the manifest is published: old and new are both
+            # complete here
+            self._barrier(f"ckpt-commit-{it}")
             self._prune()
+
+        return (
+            [(f"{base}.proc{self.rank}.npz", own)], (lambda: None), commit
+        )
+
+    def save(self, it: int, meshes: Dict[str, Mesh], *, history, emult,
+             meta: Optional[dict] = None,
+             aux_arrays: Optional[Dict[str, np.ndarray]] = None) -> None:
+        """Synchronous save: snapshot, serialize, put, commit — the
+        caller returns only when the epoch is durable."""
+        from .io import ckpt_store
+
+        objs, tail, commit = self._prepare(
+            it, meshes, history=history, emult=emult, meta=meta,
+            aux_arrays=aux_arrays,
+        )
+        for name, arrs in objs:
+            self.store.put(name, ckpt_store.npz_bytes(arrs))
+        tail()
+        commit()
+
+    # -- async staging ----------------------------------------------------
+    def stage(self, it: int, meshes: Dict[str, Mesh], *, history, emult,
+              meta: Optional[dict] = None,
+              aux_arrays: Optional[Dict[str, np.ndarray]] = None) -> None:
+        """Asynchronous save: the device→host snapshot happens now (so
+        the adapt loop may mutate the live state immediately), but
+        serialization + data puts run on a background writer thread.
+        At most one epoch is in flight — staging a new epoch first
+        commits the previous one (the ONLY point the caller blocks)."""
+        from .io import ckpt_store
+
+        if self._staged is not None:
+            self.commit_pending()
+        objs, tail, commit = self._prepare(
+            it, meshes, history=history, emult=emult, meta=meta,
+            aux_arrays=aux_arrays,
+        )
+        box: dict = {}
+
+        def _write():
+            t0 = time.perf_counter()
+            try:
+                for name, arrs in objs:
+                    self.store.put(name, ckpt_store.npz_bytes(arrs))
+                tail()
+            except BaseException as e:
+                box["error"] = e
+            finally:
+                box["busy"] = time.perf_counter() - t0
+
+        t = threading.Thread(
+            target=_write, name=f"parmmg-ckpt-writer:{it}", daemon=True
+        )
+        t.start()
+        self._staged = (it, t, box, commit)
+
+    def commit_pending(self) -> None:
+        """Block until the staged epoch (if any) is fully committed.
+        Writer failures surface here as the typed store error
+        (`io.ckpt_store.CheckpointIOError`); the multi-process commit
+        (barriers + manifest) runs on THIS thread. Accumulates the
+        writer time hidden behind compute into `overlap_s`."""
+        st = self._staged
+        if st is None:
+            return
+        it, t, box, commit = st
+        t0 = time.perf_counter()
+        t.join()
+        waited = time.perf_counter() - t0
+        self._staged = None
+        self.overlap_s += max(0.0, box.get("busy", 0.0) - waited)
+        if "error" in box:
+            raise box["error"]
+        commit()
+
+    def drain(self) -> None:
+        """Flush the staging queue: after this, no checkpoint state is
+        in flight — the SIGTERM/preemption exit path and normal run
+        teardown both end through here."""
+        self.commit_pending()
 
     # -- load ------------------------------------------------------------
     def load(self) -> Optional[ResumeState]:
-        """Most recent compatible checkpoint, or None when the directory
-        holds none. A checkpoint written under different options OR a
-        different world size RAISES :class:`CheckpointMismatchError`
-        (silent restart would discard the operator's intent / deadlock
-        the shard exchange); an unreadable or digest-failing newest
-        checkpoint falls back to the previous one."""
+        """Most recent compatible checkpoint, or None when the store
+        holds none. A checkpoint written under different TRAJECTORY
+        options RAISES :class:`CheckpointMismatchError` (silent restart
+        would discard the operator's intent); a world-size difference
+        is an ELASTIC resume — all source shard files are read and
+        digest-verified and the replicated host state re-concatenated
+        (`ResumeState.source_world` records the origin). An unreadable
+        or digest-failing newest checkpoint falls back to the previous
+        one."""
         last_err = None
         for it in reversed(self._known()):
-            base = self._base(it)
+            base = self._name(it)
             try:
-                with open(base + ".json") as f:
-                    doc = json.load(f)
+                doc = json.loads(self.store.get(base + ".json").decode())
             except (OSError, ValueError) as e:
                 last_err = e
                 continue
             if doc.get("format") != CHECKPOINT_FORMAT \
                     or doc.get("driver") != self.driver:
                 continue
-            ck_world = int(doc.get("world", 1))
-            if ck_world != self.world:
-                raise CheckpointMismatchError(
-                    f"checkpoint {base}.json was written by a "
-                    f"{ck_world}-process world but this run has "
-                    f"{self.world} processes; refusing to resume — "
-                    "relaunch with the original world size or delete "
-                    "the checkpoint directory"
-                )
             if doc["fingerprint"] != self.fingerprint:
                 diff = sorted(
                     k for k in set(doc.get("options", {})) | set(self.fields)
@@ -851,6 +1102,7 @@ class Checkpointer:
                     "refusing to resume — delete the checkpoint "
                     "directory or restore the original options"
                 )
+            ck_world = int(doc.get("world", 1))
             try:
                 arrs = self._load_arrays(base, doc)
             except (OSError, ValueError, KeyError) as e:
@@ -870,12 +1122,13 @@ class Checkpointer:
                 history=list(doc["history"]),
                 emult=float(doc["emult"]),
                 meta=meta,
+                source_world=ck_world,
             )
         if last_err is not None:
             import warnings
 
             warnings.warn(
-                f"no readable checkpoint in {self.dir} "
+                f"no readable checkpoint in {self.dir or self.store} "
                 f"(last error: {last_err}); starting fresh",
                 stacklevel=2,
             )
@@ -883,18 +1136,23 @@ class Checkpointer:
 
     def _load_arrays(self, base: str, doc: dict) -> Dict[str, np.ndarray]:
         """The full array dict of one committed checkpoint: the single
-        npz (world 1) or every rank's shard file digest-verified and
-        re-concatenated in rank order (== the original replicated host
-        state). Every process reads every file — resume restores the
-        replicated-deterministic host picture the drivers require."""
-        if int(doc.get("world", 1)) == 1:
-            with np.load(base + ".npz") as z:
-                return {k: z[k] for k in z.files}
+        npz (source world 1) or every SOURCE rank's shard file
+        digest-verified and re-concatenated in rank order (== the
+        original replicated host state). Every process reads every
+        file — which is also exactly what elastic resume needs: the
+        re-concatenation is indifferent to how many processes are
+        reading now vs. how many wrote."""
+        from .io import ckpt_store
+
+        ck_world = int(doc.get("world", 1))
+        if ck_world == 1:
+            return ckpt_store.npz_arrays(self.store.get(base + ".npz"))
         per_rank: List[Dict[str, np.ndarray]] = []
         digests = doc.get("digests", {})
-        for r in range(self.world):
-            with np.load(f"{base}.proc{r}.npz") as z:
-                arrs = {k: z[k] for k in z.files}
+        for r in range(ck_world):
+            arrs = ckpt_store.npz_arrays(
+                self.store.get(f"{base}.proc{r}.npz")
+            )
             want = digests.get(str(r))
             if want is not None and _digest_arrays(arrs) != want:
                 raise ValueError(
@@ -910,7 +1168,7 @@ class Checkpointer:
                 for name in _MESH_DATA_FIELDS:
                     out[prefix + name] = np.concatenate(
                         [per_rank[r][prefix + name]
-                         for r in range(self.world)], axis=0,
+                         for r in range(ck_world)], axis=0,
                     )
             else:
                 out.update({
@@ -948,14 +1206,24 @@ class FailsafeHarness:
         self._armed = False
         self._prev_sigterm = None
         ckdir = checkpoint_dir or getattr(opts, "checkpoint_dir", None)
+        store = getattr(opts, "checkpoint_store", None)
+        # async snapshot staging: opt-in per options or environment —
+        # the env knob lets the smoke/chaos harnesses flip it without
+        # re-plumbing every entry point
+        self.async_staging = bool(
+            getattr(opts, "checkpoint_async", False)
+            or os.environ.get("PMMGTPU_ASYNC_CKPT")
+        )
         self.ckpt = (
             Checkpointer(
                 ckdir, opts, driver,
                 every=getattr(opts, "checkpoint_every", 1),
                 keep=getattr(opts, "checkpoint_keep", 2) or 2,
                 barrier=self._barrier,
+                store=store,
+                fault_cb=self.faults.io_fault,
             )
-            if ckdir else None
+            if (ckdir or store is not None) else None
         )
 
     # -- multi-host liveness --------------------------------------------
@@ -1038,16 +1306,55 @@ class FailsafeHarness:
     def resume(self) -> Optional[ResumeState]:
         return self.ckpt.load() if self.ckpt is not None else None
 
+    def preempt_notice(self) -> bool:
+        """A maintenance-event preemption NOTICE is pending (the
+        `parallel.multihost` file/callback hook, or the injected
+        ``preempt-notice`` fault): the drivers force an out-of-cadence
+        checkpoint at the next iteration boundary so the state is
+        durable BEFORE the SIGTERM lands. Unlike `preempt_requested`
+        this does not end the run — it makes the eventual kill cheap.
+        Polled only when checkpointing is configured (without a
+        checkpoint there is nothing to commit proactively)."""
+        if self.ckpt is None:
+            return False
+        from .parallel import multihost
+
+        return multihost.preemption_notice()
+
     def save(self, it: int, meshes: Dict[str, Mesh], *, history, emult,
              meta=None, aux_arrays=None, force: bool = False) -> None:
         """Checkpoint when due — or unconditionally with ``force``
         (the preemption path commits out of cadence: the SIGTERM grace
-        window must not be spent waiting for the next due
-        iteration)."""
+        window must not be spent waiting for the next due iteration).
+        Under async staging the snapshot is taken now but committed at
+        the NEXT save (or at `finish`) — except on the preemption path,
+        which drains immediately: an exit must leave a committed
+        checkpoint, not a staged one."""
         if self.ckpt is None or not (force or self.ckpt.due(it)):
+            return
+        if self.async_staging:
+            self.ckpt.stage(it, meshes, history=history, emult=emult,
+                            meta=meta, aux_arrays=aux_arrays)
+            if self.preempt_requested:
+                self.ckpt.drain()
             return
         self.ckpt.save(it, meshes, history=history, emult=emult,
                        meta=meta, aux_arrays=aux_arrays)
+
+    def finish(self) -> None:
+        """Drain the async staging queue: serialize, store and COMMIT
+        any staged epoch before control returns. The drivers call this
+        on every exit path (normal completion, typed failure,
+        preemption) — the SIGTERM contract is that the process never
+        exits with checkpoint state still in flight."""
+        if self.ckpt is not None:
+            self.ckpt.drain()
+
+    @property
+    def ckpt_overlap_s(self) -> float:
+        """Checkpoint wall time overlapped with compute so far (async
+        staging only; 0.0 otherwise) — recorded into BENCH JSON."""
+        return self.ckpt.overlap_s if self.ckpt is not None else 0.0
 
     def post_iteration(self, it: int, state, history: List[dict]):
         """Fire ``post``-phase faults after the checkpoint commit.

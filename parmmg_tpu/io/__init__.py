@@ -1,1 +1,1 @@
-from . import medit  # noqa: F401
+from . import ckpt_store, medit  # noqa: F401

@@ -1,0 +1,373 @@
+"""Pluggable, durable checkpoint storage backends.
+
+The failsafe `Checkpointer` used to call ``np.savez`` straight onto a
+shared POSIX filesystem — one hard-wired backend, no retry story, no
+way to exercise an I/O failure deterministically. This module splits
+the storage contract out into a small :class:`CheckpointStore`
+interface (put / get / list / delete + an **atomic publish token**)
+with two implementations:
+
+- :class:`LocalFSStore` — the previous behavior: same-directory temp
+  file + ``os.replace`` (`io.medit.atomic_replace`) + directory fsync,
+  so a reader sees old-complete or new-complete, never a torn file;
+- :class:`ObjectStore` — modeled on GCS object semantics: there is
+  **no rename**, but every single-object put is atomic (readers see
+  whole old or whole new object), so commit ordering comes entirely
+  from the *manifest-last* publish discipline the checkpointer already
+  follows — the manifest object IS the commit token. The backend is a
+  plain mutable mapping of ``name -> bytes`` (`memory_bucket` serves
+  shared in-process buckets via ``mem://<name>`` specs), so the GCS
+  failure surface — transient 5xx, slow writes, lost manifests — is
+  reproducible in tests without a cloud dependency.
+
+Every public operation is wrapped in bounded retry with exponential
+backoff + deterministic (seeded) jitter (`utils.retry.retry`) and an
+optional per-operation timeout (a daemon-thread watchdog — blocking
+POSIX I/O cannot be cancelled, only abandoned). Exhausted retries
+raise :class:`CheckpointIOError` (an ``OSError``, so pre-existing
+broad handlers keep working).
+
+Deterministic fault injection: stores accept a ``fault_cb(op, name,
+timeout)`` hook invoked before every raw attempt; the failsafe
+`FaultPlan` wires its ``ckpt``-phase faults (``ioerror`` raises,
+``slowio`` outsleeps the per-op timeout) through it, so each
+retry/abort path is testable byte for byte.
+
+Env contract (read by :func:`make_store` for the default store):
+
+  PMMGTPU_CKPT_ATTEMPTS  bounded retry attempts per op (default 4)
+  PMMGTPU_CKPT_BACKOFF   base backoff seconds (default 0.05, doubling)
+  PMMGTPU_CKPT_TIMEOUT   per-operation timeout seconds (default none)
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..utils.retry import retry
+
+
+class CheckpointIOError(OSError):
+    """A checkpoint-store operation failed after its bounded retries
+    (or timed out). Typed so the drivers/harness can map it onto the
+    graded-failure ladder (`failsafe.CKPT_IO_EXIT_CODE`) instead of an
+    untyped traceback."""
+
+
+class CheckpointTimeoutError(CheckpointIOError):
+    """A single store operation exceeded its per-op timeout."""
+
+
+def _call_with_timeout(fn, timeout: float, what: str):
+    """Run `fn` bounded by `timeout` seconds on a daemon thread.
+
+    Blocking filesystem/network I/O cannot be cancelled from Python;
+    on timeout the worker is abandoned (daemon) and
+    :class:`CheckpointTimeoutError` raised — the retry layer above then
+    re-attempts the operation fresh."""
+    box: dict = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(
+        target=_run, name=f"parmmg-ckpt-io:{what}", daemon=True
+    )
+    t.start()
+    if not done.wait(timeout):
+        raise CheckpointTimeoutError(
+            f"checkpoint op {what} exceeded its {timeout:.1f}s timeout"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+def _retryable(exc: BaseException) -> bool:
+    """Transient store failures worth re-attempting: timeouts and
+    OSErrors that are NOT a plain missing object (retrying a
+    FileNotFoundError cannot help and only delays the caller's
+    fallback-to-previous-checkpoint path)."""
+    if isinstance(exc, CheckpointTimeoutError):
+        return True
+    return isinstance(exc, OSError) and not isinstance(
+        exc, FileNotFoundError
+    )
+
+
+class CheckpointStore:
+    """Abstract durable key/value store for checkpoint artifacts.
+
+    Subclasses implement the raw primitives ``_put/_get/_list/_delete``
+    over flat names (no directories); this base class supplies the
+    retry/backoff/timeout/fault-injection envelope. The one semantic
+    every backend must honor: :meth:`put` (and therefore
+    :meth:`publish`) is atomic per object — a reader never observes a
+    partially written object. ``publish`` is put with COMMIT-TOKEN
+    meaning: the checkpoint protocol writes every data object first and
+    publishes the manifest last, so the manifest's existence is the
+    transaction's commit record on any backend, rename-capable or not.
+    """
+
+    def __init__(self, *, attempts: int = 4, backoff: float = 0.05,
+                 jitter: float = 0.5, seed: int = 0,
+                 timeout: Optional[float] = None,
+                 fault_cb: Optional[Callable] = None):
+        self.attempts = max(int(attempts), 1)
+        self.backoff = float(backoff)
+        self.jitter = float(jitter)
+        self.seed = seed
+        self.timeout = timeout
+        self.fault_cb = fault_cb
+
+    # -- raw primitives (subclass responsibility) -----------------------
+    def _put(self, name: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def _get(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    def _list(self) -> List[str]:
+        raise NotImplementedError
+
+    def _delete(self, name: str) -> None:
+        raise NotImplementedError
+
+    # -- retry/timeout/fault envelope -----------------------------------
+    def _op(self, op: str, name: str, fn):
+        what = f"{op}:{name}" if name else op
+
+        def raw():
+            # the fault hook runs INSIDE the timed region: a `slowio`
+            # fault must trip the per-op watchdog exactly like a
+            # genuinely stalled backend would
+            if self.fault_cb is not None:
+                self.fault_cb(op, name, self.timeout)
+            return fn()
+
+        def attempt():
+            if self.timeout is not None:
+                return _call_with_timeout(raw, self.timeout, what)
+            return raw()
+
+        try:
+            return retry(
+                attempt,
+                attempts=self.attempts,
+                backoff=self.backoff,
+                jitter=self.jitter,
+                seed=self.seed,
+                retry_on=_retryable,
+            )
+        except FileNotFoundError:
+            raise
+        except (OSError, CheckpointTimeoutError) as e:
+            raise CheckpointIOError(
+                f"checkpoint {what} failed after {self.attempts} "
+                f"attempts: {e}"
+            ) from e
+
+    # -- public surface --------------------------------------------------
+    def put(self, name: str, data: bytes) -> None:
+        """Atomically store `data` under `name` (whole-object put)."""
+        self._op("put", name, lambda: self._put(name, bytes(data)))
+
+    def publish(self, name: str, data: bytes) -> None:
+        """Atomic commit-token put — identical durability to
+        :meth:`put`; named separately because the checkpoint protocol's
+        correctness hangs on this object landing LAST."""
+        self._op("publish", name, lambda: self._put(name, bytes(data)))
+
+    def get(self, name: str) -> bytes:
+        return self._op("get", name, lambda: self._get(name))
+
+    def list(self) -> List[str]:
+        return self._op("list", "", self._list)
+
+    def delete(self, name: str) -> None:
+        """Best-effort delete. An already-missing object is success —
+        concurrent GC on a shared backend (another rank pruning, a
+        lifecycle rule) must not fail the caller."""
+
+        def _del():
+            try:
+                self._delete(name)
+            except FileNotFoundError:
+                pass
+
+        self._op("delete", name, _del)
+
+
+class LocalFSStore(CheckpointStore):
+    """POSIX-directory store — the original checkpoint layout.
+
+    Atomicity via same-directory temp + ``os.replace``
+    (`io.medit.atomic_replace`), durability via a directory fsync after
+    every publish (`io.medit.fsync_dir`): the commit record must not
+    sit in a dying host's page cache while the barrier releases the
+    other ranks."""
+
+    def __init__(self, dirpath: str, **kw):
+        super().__init__(**kw)
+        self.dir = dirpath
+
+    def _put(self, name: str, data: bytes) -> None:
+        from .medit import atomic_replace, fsync_dir
+
+        os.makedirs(self.dir, exist_ok=True)
+        with atomic_replace(os.path.join(self.dir, name), "wb") as f:
+            f.write(data)
+        fsync_dir(self.dir)
+
+    def _get(self, name: str) -> bytes:
+        with open(os.path.join(self.dir, name), "rb") as f:
+            return f.read()
+
+    def _list(self) -> List[str]:
+        try:
+            return sorted(os.listdir(self.dir))
+        except FileNotFoundError:
+            return []
+
+    def _delete(self, name: str) -> None:
+        os.unlink(os.path.join(self.dir, name))
+
+
+class ObjectStore(CheckpointStore):
+    """Object-store semantics (modeled on GCS): no rename exists, but a
+    single-object put is atomic — readers see the whole old object or
+    the whole new one. The manifest-last discipline of the checkpoint
+    protocol therefore carries the entire commit semantics, with no
+    filesystem tricks to lean on. The backing `bucket` is any mutable
+    ``name -> bytes`` mapping (an in-process dict from
+    :func:`memory_bucket`, or an adapter over a real object-store
+    client's blob API)."""
+
+    def __init__(self, bucket: Dict[str, bytes], **kw):
+        super().__init__(**kw)
+        self.bucket = bucket
+        # one lock per store: dict mutation is atomic under the GIL but
+        # real adapters may not be; the raw ops stay tiny so the lock
+        # cost is irrelevant next to serialization
+        self._lock = threading.Lock()
+
+    def _put(self, name: str, data: bytes) -> None:
+        with self._lock:
+            self.bucket[name] = bytes(data)
+
+    def _get(self, name: str) -> bytes:
+        with self._lock:
+            try:
+                return self.bucket[name]
+            except KeyError:
+                raise FileNotFoundError(name) from None
+
+    def _list(self) -> List[str]:
+        with self._lock:
+            return sorted(self.bucket)
+
+    def _delete(self, name: str) -> None:
+        with self._lock:
+            try:
+                del self.bucket[name]
+            except KeyError:
+                raise FileNotFoundError(name) from None
+
+
+# shared in-process object buckets, keyed by name — lets two in-process
+# "ranks" (tests) or a driver + a verifier share one simulated bucket
+_MEM_BUCKETS: Dict[str, Dict[str, bytes]] = {}
+_MEM_LOCK = threading.Lock()
+
+
+def memory_bucket(name: str) -> Dict[str, bytes]:
+    """The shared in-process bucket registered under `name` (created on
+    first use). Contents do NOT survive the process — ``mem://`` stores
+    exercise the object-store code paths and fault matrix, not real
+    durability."""
+    with _MEM_LOCK:
+        return _MEM_BUCKETS.setdefault(name, {})
+
+
+def _env_retry_kw() -> dict:
+    kw: dict = {}
+    att = os.environ.get("PMMGTPU_CKPT_ATTEMPTS")
+    if att:
+        kw["attempts"] = int(att)
+    back = os.environ.get("PMMGTPU_CKPT_BACKOFF")
+    if back:
+        kw["backoff"] = float(back)
+    tmo = os.environ.get("PMMGTPU_CKPT_TIMEOUT")
+    if tmo:
+        kw["timeout"] = float(tmo)
+    return kw
+
+
+def make_store(spec, dirpath: Optional[str] = None,
+               fault_cb: Optional[Callable] = None) -> CheckpointStore:
+    """Resolve a checkpoint store from an options spec.
+
+    - a :class:`CheckpointStore` instance passes through (its
+      `fault_cb` is armed when unset);
+    - ``"mem://<bucket>"`` — shared in-process :class:`ObjectStore`;
+    - ``"file://<dir>"`` or a plain path string — :class:`LocalFSStore`
+      rooted there;
+    - ``None`` — :class:`LocalFSStore` over `dirpath` (the
+      ``checkpoint_dir`` default).
+
+    Retry/backoff/timeout knobs come from the PMMGTPU_CKPT_* env
+    contract (module docstring)."""
+    if isinstance(spec, CheckpointStore):
+        if spec.fault_cb is None:
+            spec.fault_cb = fault_cb
+        return spec
+    kw = _env_retry_kw()
+    kw["fault_cb"] = fault_cb
+    if isinstance(spec, str):
+        if spec.startswith("mem://"):
+            return ObjectStore(memory_bucket(spec[6:]), **kw)
+        if spec.startswith("file://"):
+            return LocalFSStore(spec[7:], **kw)
+        return LocalFSStore(spec, **kw)
+    if spec is None and dirpath is not None:
+        return LocalFSStore(dirpath, **kw)
+    raise ValueError(
+        f"cannot resolve a checkpoint store from spec {spec!r} "
+        "(want a CheckpointStore, 'mem://<bucket>', 'file://<dir>', a "
+        "path, or a checkpoint_dir)"
+    )
+
+
+def npz_bytes(arrays: Dict) -> bytes:
+    """Serialize an array dict to npz bytes (the store-facing half of
+    the old direct-``np.savez``-to-file path)."""
+    import numpy as np
+
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def npz_arrays(data: bytes) -> Dict:
+    """Deserialize npz bytes back to an eager {name: ndarray} dict.
+    Corrupt payloads (zip CRC/structure failures) surface as ValueError
+    so the checkpoint loader's fall-back-to-previous path catches them
+    uniformly."""
+    import zipfile
+
+    import numpy as np
+
+    try:
+        with np.load(_io.BytesIO(data)) as z:
+            return {k: z[k] for k in z.files}
+    except zipfile.BadZipFile as e:
+        raise ValueError(f"corrupt npz payload: {e}") from e

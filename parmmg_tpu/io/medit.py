@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import itertools
 import os
 import re
 from typing import Dict, List, Sequence, Tuple
@@ -50,6 +51,13 @@ def fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+# unique per-call tmp suffix: a pid alone is not enough once the
+# checkpoint store retries a timed-out write — the abandoned attempt's
+# thread may still hold the old tmp file open, and two writers on one
+# tmp path would interleave into garbage that os.replace then publishes
+_TMP_SEQ = itertools.count()
+
+
 @contextlib.contextmanager
 def atomic_replace(path: str, mode: str = "w"):
     """Write-then-rename file publication: the payload goes to a
@@ -59,7 +67,7 @@ def atomic_replace(path: str, mode: str = "w"):
     sees either the old complete file or the new complete file. Every
     writer in this module (and the failsafe checkpointer) publishes
     through this."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_SEQ)}"
     f = open(tmp, mode)
     try:
         yield f

@@ -120,6 +120,18 @@ class AdaptOptions:
     # fingerprint refuses with CheckpointMismatchError)
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1   # checkpoint cadence in outer iterations
+    # pluggable checkpoint storage (io.ckpt_store): an explicit
+    # CheckpointStore instance, "mem://<bucket>" (in-process object
+    # store, GCS put semantics), "file://<dir>", or None = LocalFSStore
+    # over checkpoint_dir. Retry/backoff/timeout knobs ride the
+    # PMMGTPU_CKPT_* env contract.
+    checkpoint_store: Optional[object] = None
+    # async snapshot staging: device->host snapshot at the iteration
+    # boundary, serialize+put on a background writer thread — the loop
+    # blocks only at the commit of the PREVIOUS checkpoint, and the
+    # preemption/exit paths drain the queue (env PMMGTPU_ASYNC_CKPT=1
+    # flips it without re-plumbing)
+    checkpoint_async: bool = False
     # checkpoint GC: retain only the last K committed checkpoints per
     # run, pruning older ckpt_* files after each successful commit (a
     # long run would otherwise accumulate every iteration's full mesh
@@ -1397,6 +1409,9 @@ def adapt(
             last_good = fs.snapshot(mesh)
             if fs.ckpt is not None and (
                 fs.ckpt.due(it) or fs.preempt_requested
+                # a maintenance-event notice forces an out-of-cadence
+                # checkpoint NOW, before the platform's SIGTERM lands
+                or fs.preempt_notice()
             ):
                 meshes = {"mesh": mesh}
                 if old_snapshot is not None:
@@ -1424,6 +1439,10 @@ def adapt(
             it += 1
     finally:
         fs.disarm_preemption()
+        # async staging: any staged epoch is serialized, stored and
+        # COMMITTED before control leaves the loop — every exit path
+        # (completion, typed failure, preemption) ends drained
+        fs.finish()
 
     # once, after the final iteration — polishing between iterations is
     # wasted work (the next iteration's insertion sweeps disturb it)
@@ -1438,5 +1457,6 @@ def adapt(
     info = dict(history=history, qual_in=h0, qual_out=h1,
                 presize_skipped=presize_skipped,
                 mem_budget_mb=opts.mem_budget_mb,
+                ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),
                 status=status)
     return mesh, info

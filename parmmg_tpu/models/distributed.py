@@ -397,6 +397,49 @@ class DistOptions(AdaptOptions):
     min_shard_elts: int = 256
 
 
+def _elastic_recut(stacked: Mesh, opts: DistOptions) -> Mesh:
+    """Elastic-resume re-cut: a checkpoint whose shard count no longer
+    matches the current run's `opts.nparts` (the world resized past
+    what re-laying the same shards over the devices can absorb) is
+    merged back to one centralized mesh and re-partitioned through the
+    ordinary SFC path — owner ranks come back from `rebuild_comm` over
+    the persistent vglob ids, comm tables are rebuilt by the iteration
+    loop exactly as after any re-cut. The trajectory from here on is
+    that of the NEW layout (this is the operator's explicit choice;
+    bit-identical resume holds only when the shard count is
+    unchanged)."""
+    stacked = assign_global_ids(stacked)
+    merged = adjacency.build_adjacency(
+        merge_shards(stacked, rebuild_comm(stacked))
+    )
+    part = np.asarray(jax.device_get(sfc_partition(
+        merged, opts.nparts, partition_mod.metric_weights(merged)
+    )))
+    out, _comm = split_mesh(
+        merged, part, opts.nparts, assume_adjacency=True,
+        build_shard_adjacency=False,
+    )
+    return _presize_for_target(out, opts)
+
+
+def _resume_stacked(resume, opts: DistOptions):
+    """Common driver-side handling of a distributed ResumeState:
+    elastic re-cut when the checkpointed shard count differs from the
+    current layout (then the cached comm capacity is stale too)."""
+    stacked = resume.mesh
+    icap = resume.meta.get("icap")
+    if stacked.vert.shape[0] != opts.nparts:
+        if opts.verbose >= 1:
+            print(
+                f"  ## elastic resume: re-cutting {stacked.vert.shape[0]}"
+                f"-shard checkpoint onto {opts.nparts} shards",
+                flush=True,
+            )
+        stacked = _elastic_recut(stacked, opts)
+        icap = None
+    return stacked, icap
+
+
 def adapt_distributed(
     mesh: Mesh,
     opts: Optional[DistOptions] = None,
@@ -422,7 +465,7 @@ def adapt_distributed(
 
     resume = fs.resume()
     if resume is not None:
-        stacked = resume.mesh
+        stacked, icap0 = _resume_stacked(resume, opts)
         history: List[dict] = resume.history
         h_in = failsafe._histo_from_json(resume.meta.get("qual_in"))
         hausd = resume.meta.get("hausd")
@@ -437,7 +480,7 @@ def adapt_distributed(
             )
         stacked, comm, status = _iteration_loop(
             stacked, opts, hausd, history,
-            icap0=resume.meta.get("icap"), fs=fs,
+            icap0=icap0, fs=fs,
             start_it=resume.it + 1, emult0=resume.emult,
             ckpt_meta=dict(qual_in=resume.meta.get("qual_in")),
         )
@@ -445,6 +488,7 @@ def adapt_distributed(
             jax.vmap(quality.quality_histogram)(stacked)
         )
         info = dict(history=history, qual_in=h_in, qual_out=h_out,
+                    ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),
                     status=status)
         return stacked, comm, info
 
@@ -499,6 +543,7 @@ def adapt_distributed(
         jax.vmap(quality.quality_histogram)(stacked)
     )
     info = dict(history=history, qual_in=h_in, qual_out=h_out,
+                ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),
                 status=status)
     return stacked, comm, info
 
@@ -656,6 +701,9 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
             last_good = fs.snapshot(stacked)
             if fs.ckpt is not None and (
                 fs.ckpt.due(it) or fs.preempt_requested
+                # a maintenance-event notice forces an out-of-cadence
+                # checkpoint NOW, before the platform's SIGTERM lands
+                or fs.preempt_notice()
             ):
                 meta = dict(ckpt_meta or {})
                 meta["icap"] = int(icap) if icap is not None else None
@@ -679,6 +727,9 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
             it += 1
     finally:
         fs.disarm_preemption()
+        # async staging: commit any staged epoch before control leaves
+        # the loop — every exit path ends with the queue drained
+        fs.finish()
 
     stacked = assign_global_ids(stacked)
     comm = rebuild_comm(stacked, icap)
@@ -914,7 +965,7 @@ def adapt_stacked_input(
 
     resume = fs.resume()
     if resume is not None:
-        st = resume.mesh
+        st, icap0 = _resume_stacked(resume, opts)
         history: List[dict] = resume.history
         h_in = failsafe._histo_from_json(resume.meta.get("qual_in"))
         hausd = resume.meta.get("hausd")
@@ -923,7 +974,7 @@ def adapt_stacked_input(
                 resume.meta["aux_arrays"]["hausd"], st.vert.dtype
             )
         st, comm, status = _iteration_loop(
-            st, opts, hausd, history, icap0=resume.meta.get("icap"),
+            st, opts, hausd, history, icap0=icap0,
             fs=fs, start_it=resume.it + 1, emult0=resume.emult,
             ckpt_meta=dict(qual_in=resume.meta.get("qual_in")),
         )
@@ -931,7 +982,9 @@ def adapt_stacked_input(
             jax.vmap(quality.quality_histogram)(st)
         )
         return st, comm, dict(history=history, qual_in=h_in,
-                              qual_out=h_out, status=status)
+                              qual_out=h_out,
+                              ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),
+                              status=status)
 
     # per-shard preprocess: adjacency + analysis + metric, then the
     # cross-shard feature agreement pass for surface edges split by an
@@ -980,6 +1033,7 @@ def adapt_stacked_input(
         jax.vmap(quality.quality_histogram)(stacked)
     )
     info = dict(history=history, qual_in=h_in, qual_out=h_out,
+                ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),
                 status=status)
     return stacked, comm, info
 

@@ -1,18 +1,32 @@
-"""Clear-caches-and-retry for transient XLA/executable errors.
+"""Bounded retry with exponential backoff — shared by the transient-XLA
+sites and the checkpoint-store I/O.
 
-Promoted from `ops.analysis._jit_retry`: on this jaxlib (0.9.0-era CPU
-backend) a stale cached executable occasionally receives a misaligned
-argument list on re-invocation ("Executable expected parameter N of
-size X but got buffer with incompatible size Y" — sequence-dependent,
-observed only on the CPU backend). Clearing the executable cache and
-recompiling always recovers, so every host-side jitted entry point
-(analysis, distribute/migrate/chkcomm factories) funnels its first
-invocation through :func:`jit_retry` to keep long-running CLI/library
-sessions alive. The failsafe layer treats the same class as
-`failsafe.RetraceError` when it escapes anyway.
+Two layers:
+
+- :func:`retry` is the generic engine: bounded attempts, exponential
+  backoff with DETERMINISTIC jitter (seeded `random.Random`, so tests
+  replay the exact delay sequence), a `retry_on` filter (exception
+  types or a predicate) and an `on_retry` hook between attempts. It is
+  what the checkpoint stores (`io.ckpt_store`) wrap every put/get/list/
+  delete in, and what :func:`jit_retry` is now built on.
+
+- :func:`jit_retry` keeps its historical contract (promoted from
+  `ops.analysis._jit_retry`): on this jaxlib (0.9.0-era CPU backend) a
+  stale cached executable occasionally receives a misaligned argument
+  list on re-invocation ("Executable expected parameter N of size X but
+  got buffer with incompatible size Y" — sequence-dependent, observed
+  only on the CPU backend). Clearing the executable cache and
+  recompiling always recovers, so every host-side jitted entry point
+  (analysis, distribute/migrate/chkcomm factories) funnels its first
+  invocation through it. The failsafe layer treats the same class as
+  `failsafe.RetraceError` when it escapes anyway.
 """
 
 from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Sequence, Union
 
 import jax
 
@@ -29,14 +43,67 @@ def is_transient_xla_error(exc: BaseException) -> bool:
     )
 
 
+RetryPredicate = Union[
+    Callable[[BaseException], bool],
+    Sequence[type],
+    type,
+]
+
+
+def _should_retry(exc: BaseException, retry_on: RetryPredicate) -> bool:
+    if isinstance(retry_on, type):
+        return isinstance(exc, retry_on)
+    if callable(retry_on):
+        return bool(retry_on(exc))
+    return isinstance(exc, tuple(retry_on))
+
+
+def retry(
+    fn: Callable,
+    *,
+    attempts: int = 3,
+    backoff: float = 0.05,
+    jitter: float = 0.5,
+    retry_on: RetryPredicate = Exception,
+    seed: Optional[int] = 0,
+    on_retry: Optional[Callable[[BaseException, int], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Invoke `fn()` up to `attempts` times.
+
+    An exception matching `retry_on` (an exception type, a tuple of
+    types, or a predicate) triggers a retry after a delay of
+    ``backoff * 2**k * (1 + jitter * u)`` seconds, where ``u`` is drawn
+    from ``random.Random(seed)`` — a SEEDED stream, so the delay
+    schedule (and therefore every test that exercises a retry path) is
+    deterministic; pass ``seed=None`` for real entropy. The final
+    attempt's exception propagates unchanged. `on_retry(exc, attempt)`
+    runs between attempts (the clear-caches hook of :func:`jit_retry`);
+    `sleep` is injectable so tests need not wait out real delays.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts={attempts} must be >= 1")
+    rng = random.Random(seed)
+    for k in range(attempts):
+        try:
+            return fn()
+        except BaseException as e:
+            if k == attempts - 1 or not _should_retry(e, retry_on):
+                raise
+            if on_retry is not None:
+                on_retry(e, k)
+            if backoff > 0:
+                sleep(backoff * (2 ** k) * (1.0 + jitter * rng.random()))
+
+
 def jit_retry(fn, *args, **kwargs):
     """Invoke a jitted fn, retrying once after ``jax.clear_caches()``
     when the transient executable/buffer mismatch fires. Anything else
     propagates unchanged."""
-    try:
-        return fn(*args, **kwargs)
-    except ValueError as e:
-        if not is_transient_xla_error(e):
-            raise
-        jax.clear_caches()
-        return fn(*args, **kwargs)
+    return retry(
+        lambda: fn(*args, **kwargs),
+        attempts=2,
+        backoff=0.0,
+        retry_on=is_transient_xla_error,
+        on_retry=lambda e, k: jax.clear_caches(),
+    )

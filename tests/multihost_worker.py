@@ -127,6 +127,11 @@ def failsafe_main():
         print(f"CKPT_MISMATCH rank={jax.process_index()}: {e}",
               flush=True)
         os._exit(failsafe.MISMATCH_EXIT_CODE)
+    except failsafe.CheckpointIOError as e:
+        # store I/O failed past its bounded retries: typed exit so the
+        # harness can tell a durability problem from a crash
+        print(f"CKPT_IO rank={jax.process_index()}: {e}", flush=True)
+        os._exit(failsafe.CKPT_IO_EXIT_CODE)
     merged = merge_adapted(out, comm2)
     d = jax.device_get(merged)
     h = hashlib.sha256()

@@ -243,13 +243,15 @@ def test_two_process_kill_resume_sharded_checkpoint(tmp_path):
        verified here), and rank 0's collective watchdog must convert
        the silent peer loss into PeerLostError
        (PEER_LOST_EXIT_CODE) instead of hanging;
-    3. a single-process resume attempt against the 2-process
-       checkpoint refuses loudly (MISMATCH_EXIT_CODE);
+    3. an ELASTIC single-process resume of the 2-process checkpoint
+       (PMMGTPU_SPMD_SWEEPS=1 — the identical SPMD sweep programs on
+       one controller) completes bit-identically to (1);
     4. a 2-process resume completes bit-identically to (1).
 
     The reference analog: per-rank restart state + MPI_Barrier'd
     checkpoint I/O in the node-scale runs of RR-9307."""
     import json
+    import shutil
 
     from parmmg_tpu import failsafe
 
@@ -284,14 +286,20 @@ def test_two_process_kill_resume_sharded_checkpoint(tmp_path):
             arrs = {k: z[k] for k in z.files}
         assert failsafe._digest_arrays(arrs) == doc["digests"][str(r)]
 
-    # world-size mismatch: a 1-process run refuses to resume
+    # elastic resume: a 1-process run (all 8 devices on one
+    # controller, same SPMD sweep programs) re-concatenates the 2-rank
+    # shard files and continues to the SAME digest — against a COPY of
+    # the checkpoint so phase 4's 2-process resume sees the original
+    ck1 = tmp_path / "ck_elastic"
+    shutil.copytree(ck, ck1)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update(
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
-        PYTHONPATH=root, PMMGTPU_CKPT_DIR=str(ck),
+        PYTHONPATH=root, PMMGTPU_CKPT_DIR=str(ck1),
+        PMMGTPU_SPMD_SWEEPS="1",
     )
     p = subprocess.run(
         [sys.executable,
@@ -299,10 +307,10 @@ def test_two_process_kill_resume_sharded_checkpoint(tmp_path):
          "--failsafe"],
         env=env, capture_output=True, text=True, timeout=1200, cwd=root,
     )
-    assert p.returncode == failsafe.MISMATCH_EXIT_CODE, (
+    assert p.returncode == 0, (
         p.returncode, p.stdout[-2000:], p.stderr[-2000:],
     )
-    assert "CKPT_MISMATCH" in p.stdout
+    assert _digests(p.stdout) == ref, (_digests(p.stdout), ref)
 
     rcs, logs = _run_failsafe_pair(tmp_path, "resume", {
         "PMMGTPU_CKPT_DIR": str(ck), "PMMGTPU_WATCHDOG": "300",

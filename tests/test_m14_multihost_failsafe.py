@@ -184,13 +184,21 @@ def test_sharded_checkpoint_roundtrip_and_refusals(tmp_path, stacked8):
     np.testing.assert_array_equal(
         rs.meta["aux_arrays"]["hausd"], aux["hausd"]
     )
-    # a 1-process resume of a 2-process checkpoint refuses loudly
+    # ELASTIC resume: a 1-process load of the 2-process checkpoint
+    # digest-verifies both shard files and re-concatenates the
+    # replicated host state bit for bit (world size is a resource
+    # layout, not a trajectory option)
     single = failsafe.Checkpointer(ck, opts, "distributed", rank=0,
                                    world=1, barrier=lambda t: None)
-    with pytest.raises(failsafe.CheckpointMismatchError,
-                       match="2-process"):
-        single.load()
-    # as does a same-world resume under different trajectory options
+    el = single.load()
+    assert el is not None and el.source_world == 2
+    for name in ("vert", "tet", "vmask", "tmask", "vglob", "met"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(el.mesh, name)),
+            np.asarray(jax.device_get(getattr(stacked8, name))),
+            err_msg=f"elastic {name}",
+        )
+    # the hard refusal remains ONLY for a trajectory-options mismatch
     other = failsafe.Checkpointer(
         ck, AdaptOptions(hsiz=0.2, niter=2), "distributed", rank=0,
         world=2, barrier=lambda t: None,

@@ -1,0 +1,669 @@
+"""M15: elastic resume + durable checkpoint I/O — the in-process half.
+
+Unit coverage for what the chaos harness (tools/chaos_smoke.py) and the
+multihost smoke's elastic leg (tools/fault_smoke.py --multihost phase D,
+test_m10's slow subprocess matrix) exercise end to end:
+
+- `utils.retry.retry`: deterministic seeded jitter, retry_on filtering,
+  attempt exhaustion, the on_retry hook;
+- `io.ckpt_store`: spec resolution, the ObjectStore fault matrix
+  (ioerror on shard put / manifest publish / get — absorbed by bounded
+  retry, or escalated to the typed `CheckpointIOError` with the commit
+  token never published), per-op timeouts via the ``slowio`` fault;
+- elastic `Checkpointer.load`: an N-rank manifest re-concatenated under
+  world sizes 1/3/4 bit for bit, digest verification retained, the
+  fingerprint refusal retained (m14 keeps the same-world coverage);
+- rank-scoped GC: rank r prunes only its own proc files, rank 0 the
+  manifests + stale ranks; concurrent deletes tolerated;
+- async snapshot staging: stage returns before the epoch is committed,
+  the NEXT stage commits the previous epoch only, writer failures
+  surface typed at the commit point, `overlap_s` accounts hidden wall
+  time, and the preemption path drains synchronously;
+- the proactive preemption notice (file / callback / injected
+  ``preempt-notice`` fault) forcing an out-of-cadence checkpoint.
+"""
+
+import os
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from parmmg_tpu import failsafe
+from parmmg_tpu.core.tags import ReturnStatus
+from parmmg_tpu.io import ckpt_store
+from parmmg_tpu.io.ckpt_store import (
+    CheckpointIOError,
+    LocalFSStore,
+    ObjectStore,
+)
+from parmmg_tpu.models.adapt import AdaptOptions, adapt
+from parmmg_tpu.parallel import multihost
+from parmmg_tpu.parallel.distribute import split_mesh
+from parmmg_tpu.parallel.partition import sfc_partition
+from parmmg_tpu.utils.gen import unit_cube_mesh
+from parmmg_tpu.utils.retry import retry
+
+C_OPTS = dict(hsiz=0.45, niter=2, max_sweeps=2, hgrad=None,
+              polish_sweeps=0)
+
+
+@pytest.fixture(scope="module")
+def stacked8():
+    mesh = unit_cube_mesh(2)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 8)))
+    st, comm = split_mesh(mesh, part, 8)
+    return st
+
+
+@pytest.fixture(autouse=True)
+def _clear_preempt_notice():
+    yield
+    multihost.clear_preemption_notice()
+    multihost.set_preemption_callback(None)
+
+
+def _mesh_equal(got, want, names=("vert", "tet", "vmask", "tmask",
+                                  "vglob", "met")):
+    for name in names:
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(getattr(got, name))),
+            np.asarray(jax.device_get(getattr(want, name))),
+            err_msg=name,
+        )
+
+
+# ---------------------------------------------------------------------------
+# utils.retry.retry
+# ---------------------------------------------------------------------------
+
+
+def test_retry_deterministic_jitter_and_filtering():
+    def delays_for(seed):
+        delays = []
+
+        def boom():
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            retry(boom, attempts=4, backoff=0.01, jitter=0.5, seed=seed,
+                  retry_on=OSError, sleep=delays.append)
+        return delays
+
+    a, b = delays_for(7), delays_for(7)
+    assert a == b and len(a) == 3          # seeded stream replays
+    assert delays_for(8) != a              # and actually depends on it
+    # exponential envelope: base*2^k <= d < base*2^k*(1+jitter)
+    for k, d in enumerate(a):
+        assert 0.01 * 2 ** k <= d <= 0.01 * 2 ** k * 1.5
+
+    # non-matching exceptions propagate on the first attempt
+    calls = []
+
+    def typeerr():
+        calls.append(1)
+        raise TypeError("no")
+
+    with pytest.raises(TypeError):
+        retry(typeerr, attempts=5, backoff=0.0, retry_on=OSError)
+    assert len(calls) == 1
+    # success passes through; on_retry sees each failed attempt
+    seen = []
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise OSError("flake")
+        return "ok"
+
+    assert retry(flaky, attempts=4, backoff=0.0, retry_on=OSError,
+                 on_retry=lambda e, k: seen.append(k)) == "ok"
+    assert seen == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# store resolution + fault matrix
+# ---------------------------------------------------------------------------
+
+
+def test_make_store_specs(tmp_path):
+    s = ckpt_store.make_store(None, str(tmp_path / "a"))
+    assert isinstance(s, LocalFSStore) and s.dir.endswith("a")
+    s = ckpt_store.make_store("file://" + str(tmp_path / "b"), None)
+    assert isinstance(s, LocalFSStore) and s.dir.endswith("b")
+    s = ckpt_store.make_store(str(tmp_path / "c"), None)
+    assert isinstance(s, LocalFSStore)
+    m1 = ckpt_store.make_store("mem://m15-spec", None)
+    m2 = ckpt_store.make_store("mem://m15-spec", None)
+    assert isinstance(m1, ObjectStore) and m1.bucket is m2.bucket
+    inst = ObjectStore({})
+    assert ckpt_store.make_store(inst, None) is inst
+    with pytest.raises(ValueError, match="resolve"):
+        ckpt_store.make_store(None, None)
+
+
+def _two_ranks(opts, store_factory):
+    """Two in-process 'ranks' sharing one bucket (the m14 pattern)."""
+    return [
+        failsafe.Checkpointer(
+            None, opts, "distributed", rank=r, world=2,
+            barrier=lambda t: None, store=store_factory(r),
+        )
+        for r in (0, 1)
+    ]
+
+
+def test_objectstore_fault_matrix(stacked8):
+    opts = AdaptOptions(hsiz=0.35, niter=2)
+
+    # --- one ioerror on a shard put: absorbed by bounded retry --------
+    bucket: dict = {}
+    fails = {"put:ckpt_00000.proc1.npz": 1}
+
+    def cb(op, name, timeout):
+        key = f"{op}:{name}"
+        if fails.get(key, 0) > 0:
+            fails[key] -= 1
+            raise OSError(f"injected {key}")
+
+    ranks = _two_ranks(opts, lambda r: ObjectStore(
+        bucket, attempts=3, backoff=0.0, fault_cb=cb))
+    for c in ranks:
+        c.save(0, {"mesh": stacked8}, history=[], emult=1.6)
+    assert sorted(bucket) == [
+        "ckpt_00000.json", "ckpt_00000.proc0.npz", "ckpt_00000.proc1.npz",
+    ]
+    assert not fails["put:ckpt_00000.proc1.npz"]
+    rs = ranks[0].load()
+    assert rs is not None and rs.it == 0
+
+    # --- persistent shard-put failure: typed abort; the incomplete
+    # epoch is never resumable. (The in-process stand-in barrier is a
+    # no-op, so rank 0's manifest does land here — in a real world the
+    # data barrier holds it back; either way the missing shard file
+    # disqualifies the epoch at load time.)
+    bucket2: dict = {}
+
+    def cb2(op, name, timeout):
+        if op == "put" and name.endswith(".proc1.npz"):
+            raise OSError("store down")
+
+    ranks2 = _two_ranks(opts, lambda r: ObjectStore(
+        bucket2, attempts=2, backoff=0.0, fault_cb=cb2))
+    ranks2[0].save(0, {"mesh": stacked8}, history=[], emult=1.6)
+    with pytest.raises(CheckpointIOError, match="2 attempts"):
+        ranks2[1].save(0, {"mesh": stacked8}, history=[], emult=1.6)
+    assert "ckpt_00000.proc1.npz" not in bucket2
+    with pytest.warns(UserWarning, match="starting fresh"):
+        assert ranks2[0].load() is None
+
+    # --- persistent manifest-publish failure: data files are not a
+    # checkpoint without the commit token ------------------------------
+    bucket3: dict = {}
+
+    def cb3(op, name, timeout):
+        if op == "publish":
+            raise OSError("manifest rejected")
+
+    ranks3 = _two_ranks(opts, lambda r: ObjectStore(
+        bucket3, attempts=2, backoff=0.0, fault_cb=cb3))
+    ranks3[1].save(0, {"mesh": stacked8}, history=[], emult=1.6)
+    with pytest.raises(CheckpointIOError, match="publish"):
+        ranks3[0].save(0, {"mesh": stacked8}, history=[], emult=1.6)
+    assert sorted(bucket3) == [
+        "ckpt_00000.proc0.npz", "ckpt_00000.proc1.npz",
+    ]
+    assert ranks3[0].load() is None
+
+    # --- get failure on the newest checkpoint: fall back to previous -
+    bucket4: dict = {}
+    arm = {"on": False}
+
+    def cb4(op, name, timeout):
+        if arm["on"] and op == "get" and "00001" in name \
+                and name.endswith(".npz"):
+            raise OSError("flaky read")
+
+    ranks4 = _two_ranks(opts, lambda r: ObjectStore(
+        bucket4, attempts=2, backoff=0.0, fault_cb=cb4))
+    for it in (0, 1):
+        for c in ranks4:
+            c.save(it, {"mesh": stacked8}, history=[], emult=1.6)
+    arm["on"] = True
+    # newest epoch unreadable -> SILENT fallback to the previous
+    # committed one (keep=2 retains both); no refusal, no warning
+    rs = ranks4[0].load()
+    assert rs is not None and rs.it == 0
+    arm["on"] = False
+    assert ranks4[0].load().it == 1
+
+
+def test_slowio_trips_per_op_timeout(stacked8, tmp_path):
+    """A slowio fault outsleeping the per-op timeout converts into a
+    timeout -> retry; a persistent burst escalates to the typed
+    abort."""
+    opts = AdaptOptions(hsiz=0.35, niter=2)
+    plan = failsafe.FaultPlan.parse("it0:ckpt:slowio")
+    store = LocalFSStore(str(tmp_path / "ck"), attempts=2, backoff=0.0,
+                         timeout=0.2, fault_cb=plan.io_fault)
+    c = failsafe.Checkpointer(None, opts, "centralized", rank=0,
+                              world=1, store=store)
+    t0 = time.perf_counter()
+    c.save(0, {"mesh": unit_cube_mesh(2)}, history=[], emult=1.6)
+    # one timed-out attempt (~0.45 s sleep) + a clean retry
+    assert time.perf_counter() - t0 >= 0.2
+    assert c.load() is not None
+    # every op slow forever -> CheckpointIOError
+    plan2 = failsafe.FaultPlan(
+        [failsafe.Fault(it, "ckpt", "slowio") for it in range(20)]
+    )
+    store2 = LocalFSStore(str(tmp_path / "ck2"), attempts=2,
+                          backoff=0.0, timeout=0.2,
+                          fault_cb=plan2.io_fault)
+    c2 = failsafe.Checkpointer(None, opts, "centralized", rank=0,
+                               world=1, store=store2)
+    with pytest.raises(CheckpointIOError, match="timeout|attempts"):
+        c2.save(0, {"mesh": unit_cube_mesh(2)}, history=[], emult=1.6)
+
+
+# ---------------------------------------------------------------------------
+# elastic load
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_load_world_matrix(tmp_path, stacked8):
+    """A 2-rank manifest loads under world sizes 1, 3 and 4 with the
+    re-concatenated state bit-identical to the source, the source world
+    recorded, and digest verification still armed."""
+    opts = AdaptOptions(hsiz=0.35, niter=2)
+    ck = str(tmp_path / "ck")
+    writers = [
+        failsafe.Checkpointer(ck, opts, "distributed", rank=r, world=2,
+                              barrier=lambda t: None)
+        for r in (0, 1)
+    ]
+    aux = {"hausd": np.asarray([0.01, 0.02])}
+    for c in writers:
+        c.save(0, {"mesh": stacked8}, history=[{"iter": 0}], emult=1.7,
+               meta={"icap": 4}, aux_arrays=aux)
+    for world in (1, 3, 4):
+        rdr = failsafe.Checkpointer(ck, opts, "distributed", rank=0,
+                                    world=world, barrier=lambda t: None)
+        rs = rdr.load()
+        assert rs is not None and rs.source_world == 2, world
+        assert rs.it == 0 and rs.emult == 1.7
+        _mesh_equal(rs.mesh, stacked8)
+        np.testing.assert_array_equal(
+            rs.meta["aux_arrays"]["hausd"], aux["hausd"]
+        )
+    # digest verification retained on the elastic path: corrupt one
+    # source shard file -> the (only) checkpoint is rejected
+    path = os.path.join(ck, "ckpt_00000.proc1.npz")
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    single = failsafe.Checkpointer(ck, opts, "distributed", rank=0,
+                                   world=1, barrier=lambda t: None)
+    with pytest.warns(UserWarning, match="starting fresh"):
+        assert single.load() is None
+
+
+# ---------------------------------------------------------------------------
+# rank-scoped GC
+# ---------------------------------------------------------------------------
+
+
+def test_gc_prunes_only_own_rank_files(tmp_path, stacked8):
+    opts = AdaptOptions(hsiz=0.35, niter=4)
+    ck = str(tmp_path / "ck")
+    ranks = [
+        failsafe.Checkpointer(ck, opts, "distributed", rank=r, world=2,
+                              keep=1, barrier=lambda t: None)
+        for r in (0, 1)
+    ]
+    # two committed epochs, but only rank 1 runs its GC: rank 0's old
+    # files (manifest is rank 0's to prune) must survive
+    for it in (0, 1):
+        for c in ranks:
+            c.save(it, {"mesh": stacked8}, history=[], emult=1.6)
+        # undo the automatic prune of epoch `it` to re-drive it manually
+    names = sorted(os.listdir(ck))
+    # both ranks pruned after commit: only epoch 1 remains
+    assert names == ["ckpt_00001.json", "ckpt_00001.proc0.npz",
+                     "ckpt_00001.proc1.npz"], names
+    # re-create a stale epoch and prune from ONE rank only
+    for c in ranks:
+        c.save(2, {"mesh": stacked8}, history=[], emult=1.6)
+    stale = [
+        ("ckpt_00001.json", b"{}"), ("ckpt_00001.proc0.npz", b"x"),
+        ("ckpt_00001.proc1.npz", b"x"),
+        ("ckpt_00001.proc7.npz", b"x"),     # elastic leftover rank
+    ]
+    for name, data in stale:
+        with open(os.path.join(ck, name), "wb") as f:
+            f.write(data)
+    ranks[1]._prune()
+    names = set(os.listdir(ck))
+    assert "ckpt_00001.proc1.npz" not in names       # own file: pruned
+    assert {"ckpt_00001.json", "ckpt_00001.proc0.npz",
+            "ckpt_00001.proc7.npz"} <= names         # not rank 1's
+    ranks[0]._prune()
+    names = set(os.listdir(ck))
+    # rank 0 owns the manifest, its own proc file, and stale ranks
+    assert not any(n.startswith("ckpt_00001.") for n in names), names
+    # concurrent-delete tolerance: deleting a missing object succeeds
+    ranks[0].store.delete("ckpt_00001.proc0.npz")
+
+
+# ---------------------------------------------------------------------------
+# async staging
+# ---------------------------------------------------------------------------
+
+
+class _SlowStore(ObjectStore):
+    """ObjectStore whose npz puts stall (manifest publishes stay fast),
+    standing in for slow durable media under async staging."""
+
+    def __init__(self, bucket, delay):
+        super().__init__(bucket, attempts=1, backoff=0.0)
+        self.delay = delay
+
+    def _put(self, name, data):
+        if name.endswith(".npz"):
+            time.sleep(self.delay)
+        super()._put(name, data)
+
+
+def test_async_staging_blocks_on_previous_epoch_only(stacked8):
+    bucket: dict = {}
+    opts = AdaptOptions(hsiz=0.35, niter=4)
+    c = failsafe.Checkpointer(None, opts, "distributed", rank=0,
+                              world=1, store=_SlowStore(bucket, 0.4))
+    meshes = {"mesh": stacked8}
+    t0 = time.perf_counter()
+    c.stage(0, meshes, history=[], emult=1.6)
+    assert time.perf_counter() - t0 < 0.3       # snapshot only, no put
+    assert "ckpt_00000.json" not in bucket      # epoch 0 not yet durable
+    time.sleep(0.6)                             # "compute" overlaps I/O
+    t0 = time.perf_counter()
+    c.stage(1, meshes, history=[], emult=1.6)   # commits epoch 0 first
+    stage1_block = time.perf_counter() - t0
+    assert "ckpt_00000.json" in bucket          # previous epoch durable
+    assert "ckpt_00001.json" not in bucket      # current still staged
+    assert stage1_block < 0.3                   # epoch 0 was already done
+    c.drain()
+    assert "ckpt_00001.json" in bucket
+    # the writer's 0.4 s npz put was hidden behind the 0.6 s compute
+    assert c.overlap_s >= 0.3, c.overlap_s
+    # both epochs readable
+    assert c.load().it == 1
+
+
+def test_async_writer_failure_surfaces_typed_at_commit(stacked8):
+    def cb(op, name, timeout):
+        if op == "put":
+            raise OSError("store down")
+
+    c = failsafe.Checkpointer(
+        None, AdaptOptions(hsiz=0.35), "distributed", rank=0, world=1,
+        store=ObjectStore({}, attempts=2, backoff=0.0, fault_cb=cb),
+    )
+    c.stage(0, {"mesh": stacked8}, history=[], emult=1.6)
+    with pytest.raises(CheckpointIOError, match="attempts"):
+        c.drain()
+    # the failed epoch is cleared: drain is idempotent afterwards
+    c.drain()
+
+
+def test_preemption_drains_staged_epoch(tmp_path, stacked8):
+    """The SIGTERM contract under async staging: once the harness sees
+    preempt_requested, save() commits synchronously — the process never
+    exits with checkpoint state in flight."""
+    opts = AdaptOptions(
+        hsiz=0.35, niter=2, checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_async=True,
+    )
+    fs = failsafe.harness(opts, driver="distributed")
+    assert fs.async_staging and fs.ckpt is not None
+    prev = signal.getsignal(signal.SIGTERM)
+    fs.arm_preemption()
+    try:
+        fs.save(0, {"mesh": stacked8}, history=[], emult=1.6,
+                force=True)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert fs.preempt_requested
+        fs.save(1, {"mesh": stacked8}, history=[], emult=1.6,
+                force=True)
+        # both epochs committed: nothing in flight after the save
+        names = sorted(os.listdir(tmp_path / "ck"))
+        assert "ckpt_00000.json" in names and "ckpt_00001.json" in names
+        fs.finish()     # idempotent
+    finally:
+        fs.disarm_preemption()
+    assert signal.getsignal(signal.SIGTERM) == prev
+    assert fs.ckpt_overlap_s >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# proactive preemption notice
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_notice_sources(tmp_path, monkeypatch):
+    fs = failsafe.harness(
+        AdaptOptions(checkpoint_dir=str(tmp_path / "ck")),
+        driver="centralized",
+    )
+    assert not fs.preempt_notice()
+    # 1. drain file
+    drain = tmp_path / "drain"
+    monkeypatch.setenv("PMMGTPU_PREEMPT_FILE", str(drain))
+    assert not fs.preempt_notice()
+    drain.write_text("")
+    assert fs.preempt_notice()
+    multihost.clear_preemption_notice()
+    drain.unlink()
+    assert not fs.preempt_notice()
+    # 2. callback probe (latched on first truthy return)
+    hits = {"n": 0}
+
+    def probe():
+        hits["n"] += 1
+        return hits["n"] >= 2
+
+    multihost.set_preemption_callback(probe)
+    assert not fs.preempt_notice()
+    assert fs.preempt_notice() and fs.preempt_notice()
+    multihost.set_preemption_callback(None)
+    multihost.clear_preemption_notice()
+    # 3. the injected fault kind latches it at a phase boundary
+    plan = failsafe.FaultPlan.parse("it0:remesh:preempt-notice")
+    plan.fire(0, "remesh", unit_cube_mesh(2))
+    assert fs.preempt_notice()
+    # no checkpointer -> nothing to commit proactively -> never pending
+    bare = failsafe.harness(AdaptOptions(), driver="centralized")
+    assert not bare.preempt_notice()
+
+
+def test_preempt_notice_forces_out_of_cadence_checkpoint(tmp_path):
+    """Driver-level: with checkpoint_every far beyond niter, an
+    injected maintenance notice still commits a checkpoint at its
+    iteration boundary — and the run completes normally (the notice is
+    proactive, not terminal)."""
+    ck = tmp_path / "ck"
+    out, info = adapt(
+        unit_cube_mesh(2),
+        AdaptOptions(faults="it0:remesh:preempt-notice",
+                     checkpoint_every=50, **C_OPTS),
+        checkpoint_dir=str(ck),
+    )
+    assert info["status"] == ReturnStatus.SUCCESS
+    names = sorted(os.listdir(ck))
+    assert "ckpt_00000.json" in names, names
+    # the latched notice is process-global: clear it so the control
+    # run below really runs notice-free
+    multihost.clear_preemption_notice()
+    # without the notice the same cadence writes nothing
+    ck2 = tmp_path / "ck2"
+    adapt(unit_cube_mesh(2),
+          AdaptOptions(checkpoint_every=50, **C_OPTS),
+          checkpoint_dir=str(ck2))
+    assert not ck2.exists() or not os.listdir(ck2)
+
+
+# ---------------------------------------------------------------------------
+# driver-level elastic re-cut + store plumbing (subprocess-free)
+# ---------------------------------------------------------------------------
+
+
+def test_driver_resumes_from_object_store(tmp_path):
+    """`checkpoint_store` plumbs through the centralized driver: a run
+    killed mid-flight through a mem:// bucket resumes from it
+    bit-identically (the chaos harness covers the LocalFS path)."""
+    spec = "mem://m15-driver"
+    ckpt_store.memory_bucket("m15-driver").clear()
+    ref, ref_info = adapt(unit_cube_mesh(2), AdaptOptions(**C_OPTS))
+
+    def key(m, info):
+        h = info["qual_out"]
+        return (
+            int(np.asarray(jax.device_get(m.vmask)).sum()),
+            int(np.asarray(jax.device_get(m.tmask)).sum()),
+            tuple(int(x) for x in np.asarray(jax.device_get(h.counts))),
+        )
+
+    with pytest.raises(failsafe.PreemptionError):
+        adapt(unit_cube_mesh(2),
+              AdaptOptions(checkpoint_store=spec,
+                           faults=failsafe.FaultPlan.parse(
+                               "it1:post:kill", kill_mode="raise"),
+                           **C_OPTS))
+    bucket = ckpt_store.memory_bucket("m15-driver")
+    assert any(n.endswith(".json") for n in bucket)
+    res, res_info = adapt(
+        unit_cube_mesh(2),
+        AdaptOptions(checkpoint_store=spec, **C_OPTS),
+    )
+    assert res_info["status"] == ReturnStatus.SUCCESS
+    assert key(res, res_info) == key(ref, ref_info)
+
+
+@pytest.mark.slow
+def test_elastic_resume_1_to_2_ranks(tmp_path):
+    """The 1→2 elastic direction (2→1 is fault_smoke --multihost phase
+    D / the m10 matrix): a single-controller run (all 8 devices,
+    PMMGTPU_SPMD_SWEEPS=1) killed mid-run leaves a world-1 manifest; a
+    2-process world resumes from that SAME manifest and must converge
+    to the digest of an uninterrupted run at the target world size."""
+    import socket
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "multihost_worker.py")
+
+    def base_env(extra):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        for k in ("PMMGTPU_COORDINATOR", "PMMGTPU_NUM_PROCS",
+                  "PMMGTPU_PROC_ID"):
+            env.pop(k, None)
+        env.update(JAX_PLATFORMS="cpu", PYTHONPATH=root,
+                   PYTHONFAULTHANDLER="1")
+        env.update(extra)
+        return env
+
+    def run_single(extra):
+        env = base_env(dict(
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+            PMMGTPU_SPMD_SWEEPS="1", **extra,
+        ))
+        p = subprocess.run(
+            [sys.executable, worker, "--failsafe"], env=env, cwd=root,
+            capture_output=True, text=True, timeout=1200,
+        )
+        return p.returncode, p.stdout + p.stderr
+
+    def run_pair(tag, extra):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs, logs = [], []
+        for pid in (0, 1):
+            env = base_env(dict(
+                XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
+                PMMGTPU_NUM_PROCS="2", PMMGTPU_PROC_ID=str(pid),
+                PMMGTPU_WATCHDOG="300", **extra,
+            ))
+            lp = tmp_path / f"{tag}{pid}.log"
+            logs.append(lp)
+            procs.append(subprocess.Popen(
+                [sys.executable, worker, "--failsafe"], env=env,
+                stdout=open(lp, "w"), stderr=subprocess.STDOUT,
+                cwd=root,
+            ))
+        try:
+            rcs = [p.wait(timeout=1200) for p in procs]
+        finally:
+            for p in procs:
+                p.kill()
+        return rcs, [lp.read_text() for lp in logs]
+
+    def digests(text):
+        return [ln for ln in text.splitlines()
+                if ln.startswith("ADAPT_DIGEST")]
+
+    # uninterrupted reference at the TARGET world size (2 processes)
+    rcs, logs = run_pair("ref", {})
+    assert rcs == [0, 0], (rcs, logs[0][-2000:], logs[1][-2000:])
+    ref = digests(logs[0])
+    assert ref and digests(logs[1]) == ref
+
+    # world-1 run killed after its first committed epoch
+    ck = str(tmp_path / "ck")
+    rc, out = run_single({
+        "PMMGTPU_CKPT_DIR": ck, "PARMMG_FAULTS": "it0:post:kill",
+    })
+    assert rc == failsafe.KILL_EXIT_CODE, (rc, out[-2000:])
+    names = sorted(os.listdir(ck))
+    assert "ckpt_00000.json" in names and "ckpt_00000.npz" in names, (
+        names
+    )
+
+    # 2-process elastic resume from the world-1 manifest
+    rcs, logs = run_pair("resume", {"PMMGTPU_CKPT_DIR": ck})
+    assert rcs == [0, 0], (rcs, logs[0][-2000:], logs[1][-2000:])
+    assert digests(logs[0]) == ref and digests(logs[1]) == ref, (
+        digests(logs[0]), ref,
+    )
+
+
+@pytest.mark.slow
+def test_elastic_recut_to_different_shard_count(tmp_path):
+    """A distributed checkpoint written at 4 shards resumes at nparts=8
+    through the merge + SFC re-cut path: the run completes with a
+    conformal mesh (bit-identity is only promised for an unchanged
+    shard count — covered by the subprocess legs)."""
+    from parmmg_tpu.models.distributed import DistOptions, adapt_distributed
+    from parmmg_tpu.utils.conformity import check_mesh
+    from parmmg_tpu.models.distributed import merge_adapted
+
+    ck = str(tmp_path / "ck")
+    opts4 = DistOptions(nparts=4, min_shard_elts=8, checkpoint_dir=ck,
+                        faults=failsafe.FaultPlan.parse(
+                            "it0:post:kill", kill_mode="raise"),
+                        **C_OPTS)
+    with pytest.raises(failsafe.PreemptionError):
+        adapt_distributed(unit_cube_mesh(3), opts4)
+    assert any(n.endswith(".json") for n in os.listdir(ck))
+    opts8 = DistOptions(nparts=8, min_shard_elts=8, checkpoint_dir=ck,
+                        **C_OPTS)
+    st, comm, info = adapt_distributed(unit_cube_mesh(3), opts8)
+    assert st.vert.shape[0] == 8
+    assert info["status"] == ReturnStatus.SUCCESS
+    merged = merge_adapted(st, comm)
+    assert check_mesh(merged, check_boundary=False).ok

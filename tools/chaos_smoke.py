@@ -1,0 +1,296 @@
+"""Seeded chaos harness for the fail-safe layer (tools/check.sh gate).
+
+Generates N randomized-but-SEEDED fault schedules — kill / sigterm /
+ioerror / slowio / nan / overflow / retrace / preempt-notice at random
+iterations, phases and store-op ordinals, with async snapshot staging
+flipped at random — and runs each against the public `adapt` driver in
+a subprocess. The contract under chaos:
+
+- every run terminates inside the stage watchdog (subprocess timeout)
+  — **zero hangs**;
+- every run ends in a TYPED outcome: exit 0 with a
+  ``CHAOS_RESULT status=<ReturnStatus>`` line, or a documented exit
+  code of the 86/87/88/89 family (kill/preemption, peer lost, resume
+  refusal, checkpoint I/O abort) announced by a ``CHAOS_TYPED`` line —
+  **zero untyped tracebacks** anywhere in any log;
+- a killed run RESUMES from its checkpoint **bit-identically**: the
+  resumed final-mesh digest equals the uninterrupted reference run's
+  (schedules containing trajectory-altering faults — nan / overflow /
+  retrace, whose recovery legitimately changes the iteration history —
+  assert the typed outcome only; schedules made purely of
+  trajectory-neutral faults must also reproduce the reference digest).
+
+Scheduling rules keeping every assertion well-defined: a terminal fault
+(kill/sigterm) is always the LAST driver-phase fault of its schedule,
+so everything before it is committed into the checkpoint the resume
+reads, and the resumed run (fault-free) replays the identical
+deterministic trajectory.
+
+Run: ``python tools/chaos_smoke.py --seeds 3 [--seed-base 0]``.
+Exit 0 = every seeded schedule behaved.
+"""
+
+import argparse
+import hashlib
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import shutil
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# exit codes of the typed family (mirrors parmmg_tpu.failsafe without
+# importing jax in the parent before the workers fork their own envs)
+KILL = 86
+PEER_LOST = 87
+MISMATCH = 88
+CKPT_IO = 89
+TYPED_RCS = {0, KILL, PEER_LOST, MISMATCH, CKPT_IO}
+
+OPTS = dict(hsiz=0.45, niter=3, max_sweeps=3, hgrad=None,
+            polish_sweeps=0)
+# per-run stage watchdog: a wedged worker is a FAILURE of the
+# zero-hang contract, not something to wait out
+RUN_TIMEOUT = 600
+
+# faults whose recovery changes the trajectory (rollback, grown
+# capacities): runs containing them assert typed outcomes, not digests
+TRAJECTORY_FAULTS = ("nan", "overflow", "retrace")
+NEUTRAL_FAULTS = ("preempt-notice",)
+DRIVER_PHASES = ("remesh", "post")
+
+
+def worker(ckdir: str) -> None:
+    """Child mode: one checkpointing adapt run under the PARMMG_FAULTS
+    env schedule; every outcome is typed — a result line + exit 0, or a
+    CHAOS_TYPED line + an 86/88/89-family exit code."""
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _accel in ("axon", "tpu", "cuda", "rocm"):
+        _xb._backend_factories.pop(_accel, None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from parmmg_tpu import failsafe
+    from parmmg_tpu.io.ckpt_store import CheckpointIOError
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    try:
+        out, info = adapt(
+            unit_cube_mesh(2), AdaptOptions(**OPTS), checkpoint_dir=ckdir
+        )
+    except failsafe.PreemptionError as e:
+        # the sigterm fault's graceful path: checkpoint committed, exit
+        # through the same code the hard kill uses
+        print(f"CHAOS_TYPED PreemptionError: {e}", flush=True)
+        os._exit(failsafe.KILL_EXIT_CODE)
+    except failsafe.CheckpointMismatchError as e:
+        print(f"CHAOS_TYPED CheckpointMismatchError: {e}", flush=True)
+        sys.exit(failsafe.MISMATCH_EXIT_CODE)
+    except CheckpointIOError as e:
+        print(f"CHAOS_TYPED CheckpointIOError: {e}", flush=True)
+        sys.exit(failsafe.CKPT_IO_EXIT_CODE)
+    h = hashlib.sha256()
+    d = jax.device_get(out)
+    for name in ("vert", "vmask", "tet", "tmask", "tria", "trmask",
+                 "vtag", "trtag"):
+        h.update(np.ascontiguousarray(np.asarray(getattr(d, name)))
+                 .tobytes())
+    print(
+        f"CHAOS_RESULT status={int(info['status'])} "
+        f"digest={h.hexdigest()}",
+        flush=True,
+    )
+    sys.exit(0)
+
+
+def gen_schedule(rng: random.Random):
+    """One seeded schedule: (spec string, terminal kind or None,
+    trajectory-altering?, async staging?)."""
+    faults = []
+    trajectory = False
+    # 0-2 background faults
+    for _ in range(rng.randint(0, 2)):
+        roll = rng.random()
+        if roll < 0.4:
+            # checkpoint-store I/O faults: it<k> = store-op ordinal;
+            # a burst >= the retry budget forces the typed 89 abort
+            burst = rng.choice((1, 1, 2, 5))
+            start = rng.randint(0, 3)
+            kind = rng.choice(("ioerror", "slowio"))
+            faults += [f"it{start + j}:ckpt:{kind}" for j in range(burst)]
+        elif roll < 0.7:
+            kind = rng.choice(TRAJECTORY_FAULTS)
+            trajectory = True
+            faults.append(
+                f"it{rng.randint(0, OPTS['niter'] - 1)}:"
+                f"{rng.choice(DRIVER_PHASES)}:{kind}"
+            )
+        else:
+            faults.append(
+                f"it{rng.randint(0, OPTS['niter'] - 1)}:"
+                f"{rng.choice(DRIVER_PHASES)}:preempt-notice"
+            )
+    terminal = None
+    if rng.random() < 0.6:
+        terminal = rng.choice(("kill", "sigterm"))
+        # appended LAST so it fires after any same-boundary background
+        # fault (the resume-equivalence rule of the module docstring).
+        # kill exits inside the post hook itself, so the final
+        # iteration works; sigterm only sets a flag the NEXT loop-top
+        # check converts into the checkpoint-backed exit, so it must
+        # land one iteration earlier to fire at all.
+        term_it = OPTS["niter"] - (1 if terminal == "kill" else 2)
+        faults.append(f"it{term_it}:post:{terminal}")
+    return ",".join(faults), terminal, trajectory, rng.random() < 0.5
+
+
+def _run(ckdir: str, log: str, env_extra: dict) -> int:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        # small per-op timeout so slowio faults genuinely trip it, and
+        # fast backoff so ioerror retries don't stretch the stage
+        PMMGTPU_CKPT_TIMEOUT="2",
+        PMMGTPU_CKPT_BACKOFF="0.01",
+    )
+    env.update(env_extra)
+    with open(log, "w") as lf:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", ckdir],
+            env=env, stdout=lf, stderr=subprocess.STDOUT,
+            timeout=RUN_TIMEOUT,
+        )
+    return p.returncode
+
+
+def _field(text: str, key: str):
+    for ln in reversed(text.splitlines()):
+        if ln.startswith("CHAOS_RESULT"):
+            for tok in ln.split():
+                if tok.startswith(key + "="):
+                    return tok.split("=", 1)[1]
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--seed-base", type=int, default=0)
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="parmmg_chaos_")
+    failures = []
+    try:
+        # shared fault-free reference digest (all terminal/neutral
+        # schedules must converge to it)
+        ref_log = os.path.join(tmp, "ref.log")
+        rc = _run(os.path.join(tmp, "ck_ref"), ref_log,
+                  {"PARMMG_FAULTS": ""})
+        ref_text = open(ref_log).read()
+        assert rc == 0 and _field(ref_text, "digest"), (
+            rc, ref_text[-2000:],
+        )
+        ref_digest = _field(ref_text, "digest")
+        print(f"[chaos] reference digest {ref_digest[:16]}…")
+
+        for seed in range(args.seed_base, args.seed_base + args.seeds):
+            rng = random.Random(seed)
+            spec, terminal, trajectory, use_async = gen_schedule(rng)
+            ck = os.path.join(tmp, f"ck_{seed}")
+            log = os.path.join(tmp, f"seed_{seed}.log")
+            env = {"PARMMG_FAULTS": spec}
+            if use_async:
+                env["PMMGTPU_ASYNC_CKPT"] = "1"
+            label = (f"seed {seed}: faults={spec or '<none>'} "
+                     f"async={int(use_async)}")
+            try:
+                rc = _run(ck, log, env)
+            except subprocess.TimeoutExpired:
+                failures.append(f"{label}: HANG (watchdog)")
+                continue
+            text = open(log).read()
+            if rc not in TYPED_RCS:
+                failures.append(
+                    f"{label}: untyped exit {rc}: …{text[-1500:]}"
+                )
+                continue
+            if "Traceback (most recent call last)" in text:
+                failures.append(
+                    f"{label}: untyped traceback: …{text[-1500:]}"
+                )
+                continue
+            if rc == 0:
+                status = _field(text, "status")
+                if status not in ("0", "1"):
+                    failures.append(f"{label}: bad status {status}")
+                    continue
+                if not trajectory \
+                        and _field(text, "digest") != ref_digest:
+                    failures.append(
+                        f"{label}: neutral-schedule digest diverged"
+                    )
+                    continue
+                print(f"[chaos] {label} -> typed status {status}")
+            elif rc == KILL:
+                # resume the killed run fault-free: bit-identical
+                try:
+                    rc2 = _run(ck, log + ".resume",
+                               {"PARMMG_FAULTS": ""})
+                except subprocess.TimeoutExpired:
+                    failures.append(f"{label}: resume HANG")
+                    continue
+                rtext = open(log + ".resume").read()
+                if rc2 != 0 or "Traceback (most recent call last)" \
+                        in rtext:
+                    failures.append(
+                        f"{label}: resume exit {rc2}: …{rtext[-1500:]}"
+                    )
+                    continue
+                ok = _field(rtext, "digest") == ref_digest
+                if trajectory:
+                    # a pre-kill trajectory fault is baked into the
+                    # checkpoint: the resume must still END typed, but
+                    # the digest legitimately differs
+                    print(f"[chaos] {label} -> {terminal} + resume "
+                          "(typed, trajectory fault absorbed)")
+                elif not ok:
+                    failures.append(f"{label}: resume digest diverged")
+                    continue
+                else:
+                    print(f"[chaos] {label} -> {terminal} + "
+                          "bit-identical resume")
+            else:
+                print(f"[chaos] {label} -> typed exit {rc}")
+        if failures:
+            print("\n[chaos] FAILURES:")
+            for f in failures:
+                print(" -", f)
+            return 1
+        print(f"[chaos] all {args.seeds} seeded schedules terminated "
+              "typed — zero hangs, zero untyped tracebacks")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker(sys.argv[2])
+    sys.exit(main())

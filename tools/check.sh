@@ -26,10 +26,20 @@ echo "## fault-smoke rc=$rc"
 
 # 2-process multi-host stage: rank-targeted kill after a sharded,
 # barrier-committed checkpoint; the survivor's watchdog must raise a
-# typed PeerLostError and a 2-process resume must be bit-identical
+# typed PeerLostError, a 2-process resume must be bit-identical, and
+# the same 2-rank checkpoint must ELASTICALLY resume at world size 1
 timeout -k 10 1800 env JAX_PLATFORMS=cpu python tools/fault_smoke.py --multihost
 rc=$?
 echo "## fault-smoke-multihost rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
+# seeded chaos stage: randomized-but-seeded fault schedules (kill /
+# sigterm / ioerror / slowio / nan / overflow / preempt-notice, async
+# staging flipped at random) — every run must end in a typed status or
+# a bit-identical resume; zero hangs, zero untyped tracebacks
+timeout -k 10 1800 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py --seeds 3
+rc=$?
+echo "## chaos-smoke rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
 set -o pipefail

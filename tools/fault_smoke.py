@@ -14,7 +14,7 @@ fixture, end to end through the public drivers:
    uninterrupted run's mesh counts and quality histogram.
 
 ``--multihost`` runs the 2-process stage instead (its own check.sh
-gate, between this smoke and tier-1): three phases of
+gate, between this smoke and tier-1): four phases of
 ``tests/multihost_worker.py --failsafe`` under the PMMGTPU_* env —
 (A) an uninterrupted 2-process run for the reference digest; (B) the
 same run with a rank-targeted ``it0:post:kill@rank1`` fault and a
@@ -23,7 +23,11 @@ after the barrier-committed checkpoint and rank 0's collective
 watchdog must convert the silent peer loss into PeerLostError
 (PEER_LOST_EXIT_CODE) instead of hanging; (C) a 2-process resume from
 the sharded checkpoint, which must reproduce phase A's merged-mesh
-digest bit for bit.
+digest bit for bit; (D) an ELASTIC resume of the same 2-rank
+checkpoint at world size 1 (one controller owning all 8 devices,
+PMMGTPU_SPMD_SWEEPS=1 so the identical SPMD sweep programs run) —
+the re-concatenated state must continue to the same digest bit for
+bit.
 
 Run hermetically on CPU: ``python tools/fault_smoke.py``. Exit 0 =
 every scenario behaved; any unhandled exception or mismatch fails the
@@ -172,6 +176,34 @@ def _run_pair(worker, tmp, tag, extra_env, timeout=900):
     return rcs, [open(lp).read() for lp in logs]
 
 
+def _run_single(worker, tmp, tag, extra_env, timeout=900):
+    """One UN-coordinated worker process owning all 8 CPU devices (the
+    world-size-1 elastic-resume leg); returns (exit code, log text)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for k in ("PMMGTPU_COORDINATOR", "PMMGTPU_NUM_PROCS",
+              "PMMGTPU_PROC_ID"):
+        env.pop(k, None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PYTHONPATH=root,
+        # run the IDENTICAL SPMD sweep programs single-process so the
+        # continued trajectory is bit-comparable to the 2-process runs
+        PMMGTPU_SPMD_SWEEPS="1",
+        PYTHONFAULTHANDLER="1",
+    )
+    env.update(extra_env)
+    lp = os.path.join(tmp, f"{tag}.log")
+    p = subprocess.run(
+        [sys.executable, worker, "--failsafe"], env=env,
+        stdout=open(lp, "w"), stderr=subprocess.STDOUT, cwd=root,
+        timeout=timeout,
+    )
+    return p.returncode, open(lp).read()
+
+
 def _digest_lines(text):
     return [ln for ln in text.splitlines()
             if ln.startswith("ADAPT_DIGEST")]
@@ -214,6 +246,12 @@ def main_multihost() -> int:
               f"checkpoint; rank0 converted the silent peer loss into "
               f"PeerLostError (exit {failsafe.PEER_LOST_EXIT_CODE})")
 
+        # snapshot the kill checkpoint BEFORE any resume consumes it:
+        # each resume leg gets its own copy (a resumed run writes new
+        # checkpoints into the directory and GCs the old ones)
+        ck1 = os.path.join(tmp, "ck_elastic")
+        shutil.copytree(ck, ck1)
+
         rcs, logs = _run_pair(worker, tmp, "resume", {
             "PMMGTPU_CKPT_DIR": ck, "PMMGTPU_WATCHDOG": "300",
         })
@@ -222,6 +260,18 @@ def main_multihost() -> int:
         assert got == ref and _digest_lines(logs[1]) == ref, (got, ref)
         print("[mh-smoke] 2-process resume from the sharded checkpoint "
               "matches the uninterrupted run bit for bit")
+
+        # elastic resume: the SAME 2-rank manifest restarts at world
+        # size 1 — all shard files digest-verified, re-concatenated,
+        # and the continued run must land on the reference digest
+        rc, log = _run_single(worker, tmp, "elastic", {
+            "PMMGTPU_CKPT_DIR": ck1,
+        })
+        assert rc == 0, (rc, log[-2000:])
+        got = _digest_lines(log)
+        assert got == ref, (got, ref)
+        print("[mh-smoke] ELASTIC resume (2-rank checkpoint -> world "
+              "size 1) matches the uninterrupted run bit for bit")
         return 0
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
