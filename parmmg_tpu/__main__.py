@@ -408,5 +408,18 @@ def main(argv=None) -> int:
     return 0
 
 
+def _main_traced(argv=None) -> int:
+    """CLI entry: run `main` and flush the process tracer afterwards,
+    so the top-level Timers spans that close AFTER the driver's own
+    flush (remeshing/output) still make it into the Chrome trace —
+    the JSONL log has them either way (per-line flush)."""
+    try:
+        return main(argv)
+    finally:
+        from .obs import trace as obs_trace
+
+        obs_trace.get_tracer().flush()
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_main_traced())

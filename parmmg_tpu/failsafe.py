@@ -92,6 +92,7 @@ import numpy as np
 from .core import tags
 from .core.mesh import Mesh, tet_volumes
 from .io.ckpt_store import CheckpointIOError  # noqa: F401  (re-export)
+from .obs import metrics as obs_metrics, trace as obs_trace
 
 # exit code of an injected ``kill`` fault (simulated preemption) — the
 # test harness and tools/check.sh smoke stage assert on it
@@ -207,6 +208,18 @@ def snapshot(state):
     return jax.tree_util.tree_map(
         lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state
     )
+
+
+def record_rollback(it: int, exc: BaseException,
+                    phase: str = "iteration") -> None:
+    """Observability hook the drivers call next to every rollback
+    `history` entry: the absorbed failure lands in the obs event
+    timeline and the `failsafe/rollbacks` counter, so a chaos run's
+    recovery sequence is reconstructable from the trace directory
+    alone."""
+    obs_trace.emit_event("rollback", it=int(it), phase=phase,
+                         error=type(exc).__name__)
+    obs_metrics.registry().counter("failsafe/rollbacks").inc()
 
 
 # ---------------------------------------------------------------------------
@@ -532,6 +545,13 @@ class FaultPlan:
             if not f.fired and f.it <= it and f.phase == phase \
                     and f.kind == kind and f.mine:
                 f.fired = True
+                obs_trace.emit_event(
+                    "fault_injected", kind=kind, phase=phase, it=int(it),
+                    realized="driver",
+                )
+                obs_metrics.registry().counter(
+                    "failsafe/faults_injected"
+                ).inc()
                 return True
         return False
 
@@ -553,6 +573,13 @@ class FaultPlan:
             if f.fired or f.phase != "ckpt" or not f.mine or f.it > k:
                 continue
             f.fired = True
+            obs_trace.emit_event(
+                "fault_injected", kind=f.kind, phase="ckpt", op=op,
+                store_op=k,
+            )
+            obs_metrics.registry().counter(
+                "failsafe/faults_injected"
+            ).inc()
             if f.kind == "ioerror":
                 raise OSError(
                     f"injected checkpoint ioerror at store op {k} "
@@ -577,6 +604,16 @@ class FaultPlan:
             where = f"it{it}:{phase}" + (
                 f"@rank{f.rank}" if f.rank is not None else ""
             )
+            # timeline first, action second: the JSONL line is flushed
+            # before a `kill` can os._exit, so even a hard death leaves
+            # the injected fault in the durable event log
+            obs_trace.emit_event(
+                "fault_injected", kind=f.kind, phase=phase, it=int(it),
+                where=where,
+            )
+            obs_metrics.registry().counter(
+                "failsafe/faults_injected"
+            ).inc()
             if f.kind == "nan":
                 idx = (0,) * (state.vert.ndim - 1)
                 state = state.replace(
@@ -1003,10 +1040,20 @@ class Checkpointer:
             it, meshes, history=history, emult=emult, meta=meta,
             aux_arrays=aux_arrays,
         )
+        t0 = time.perf_counter()
         for name, arrs in objs:
             self.store.put(name, ckpt_store.npz_bytes(arrs))
         tail()
         commit()
+        self._note_commit(it, mode="sync",
+                          seconds=time.perf_counter() - t0)
+
+    def _note_commit(self, it: int, mode: str, seconds: float) -> None:
+        """Timeline + counter record of a durable checkpoint commit —
+        what a post-mortem needs to know survived."""
+        obs_trace.emit_event("checkpoint_commit", it=int(it), mode=mode,
+                             seconds=round(seconds, 4))
+        obs_metrics.registry().counter("ckpt/commits").inc()
 
     # -- async staging ----------------------------------------------------
     def stage(self, it: int, meshes: Dict[str, Mesh], *, history, emult,
@@ -1062,6 +1109,8 @@ class Checkpointer:
         if "error" in box:
             raise box["error"]
         commit()
+        self._note_commit(it, mode="async",
+                          seconds=box.get("busy", 0.0))
 
     def drain(self) -> None:
         """Flush the staging queue: after this, no checkpoint state is
@@ -1265,6 +1314,10 @@ class FailsafeHarness:
 
     def _on_sigterm(self, signum, frame) -> None:
         self.preempt_requested = True
+        # a flag write plus one appended timeline line — both safe in
+        # signal-handler context, and the only record of WHEN the
+        # platform's SIGTERM landed relative to the iteration spans
+        obs_trace.emit_event("sigterm_received")
 
     def disarm_preemption(self) -> None:
         if self._armed:

@@ -45,8 +45,10 @@ from __future__ import annotations
 import io as _io
 import os
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
+from ..obs import metrics as obs_metrics
 from ..utils.retry import retry
 
 
@@ -159,6 +161,8 @@ class CheckpointStore:
                 return _call_with_timeout(raw, self.timeout, what)
             return raw()
 
+        reg = obs_metrics.registry()
+        t0 = time.perf_counter()
         try:
             return retry(
                 attempt,
@@ -167,6 +171,7 @@ class CheckpointStore:
                 jitter=self.jitter,
                 seed=self.seed,
                 retry_on=_retryable,
+                on_retry=lambda e, k: reg.counter("ckpt/retries").inc(),
             )
         except FileNotFoundError:
             raise
@@ -175,20 +180,32 @@ class CheckpointStore:
                 f"checkpoint {what} failed after {self.attempts} "
                 f"attempts: {e}"
             ) from e
+        finally:
+            # always-on store telemetry (a counter bump + a float — the
+            # I/O it measures dwarfs it): op count + latency, rendered
+            # by tools/obs_report.py as the checkpoint I/O table
+            reg.counter("ckpt/ops").inc()
+            reg.histogram("ckpt/op_seconds").observe(
+                time.perf_counter() - t0
+            )
 
     # -- public surface --------------------------------------------------
     def put(self, name: str, data: bytes) -> None:
         """Atomically store `data` under `name` (whole-object put)."""
         self._op("put", name, lambda: self._put(name, bytes(data)))
+        obs_metrics.registry().counter("ckpt/put_bytes").inc(len(data))
 
     def publish(self, name: str, data: bytes) -> None:
         """Atomic commit-token put — identical durability to
         :meth:`put`; named separately because the checkpoint protocol's
         correctness hangs on this object landing LAST."""
         self._op("publish", name, lambda: self._put(name, bytes(data)))
+        obs_metrics.registry().counter("ckpt/put_bytes").inc(len(data))
 
     def get(self, name: str) -> bytes:
-        return self._op("get", name, lambda: self._get(name))
+        data = self._op("get", name, lambda: self._get(name))
+        obs_metrics.registry().counter("ckpt/get_bytes").inc(len(data))
+        return data
 
     def list(self) -> List[str]:
         return self._op("list", "", self._list)

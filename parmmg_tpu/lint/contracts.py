@@ -291,6 +291,14 @@ class RetraceCounter:
     def _record(self, name: str) -> None:
         self.counts[self._phase] = self.counts.get(self._phase, 0) + 1
         self.names.setdefault(self._phase, []).append(name)
+        # the obs registry mirrors the per-phase miss counts so the
+        # run report's retrace table needs no live counter handle
+        # (compiles are rare — the lazy import costs nothing steady)
+        from ..obs import metrics as obs_metrics
+
+        obs_metrics.registry().counter(
+            f"recompiles/{self._phase}"
+        ).inc()
 
     @property
     def total(self) -> int:

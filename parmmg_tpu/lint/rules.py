@@ -38,6 +38,12 @@ PML008 print-under-trace   ``print`` in jit-reachable code runs at
 PML009 arange-no-dtype     ``jnp.arange`` without ``dtype=``: under
                            ``jax_enable_x64`` (the test harness) the
                            index array silently widens to int64.
+PML010 host-clock-trace    ``time.time()``/``time.perf_counter()``/
+                           ``time.monotonic()`` inside jit-reachable
+                           code: a host clock under trace measures
+                           TRACE time (once, at compile), not run
+                           time — instrument with `obs.trace` spans
+                           around the dispatch instead.
 """
 
 from __future__ import annotations
@@ -61,7 +67,16 @@ RULES: Dict[str, str] = {
     "PML007": "data-dependent output shape inside jit-reachable code",
     "PML008": "print under trace (use jax.debug.print)",
     "PML009": "jnp.arange without explicit dtype (int64 under x64)",
+    "PML010": "host clock inside jit-reachable code (measures trace "
+              "time, not run time — use obs.trace spans)",
 }
+
+# host-clock reads that are meaningless under trace (PML010): they
+# execute once at trace time and bake a constant into the program
+HOST_CLOCK_CALLS = frozenset({
+    "time.time", "time.perf_counter", "time.perf_counter_ns",
+    "time.monotonic", "time.monotonic_ns", "time.process_time",
+})
 
 # names whose first parameter is the big mesh pytree (PML005)
 MESH_PARAM_NAMES = frozenset({"mesh", "stacked", "m", "blk"})
@@ -263,6 +278,17 @@ class _FuncChecker(ast.NodeVisitor):
                         "PML001", node,
                         "jax.device_get inside jit-reachable code is a "
                         "host sync (and fails on tracers)",
+                    )
+                # PML010: host clocks under trace time the TRACE, not
+                # the run (and a clock-derived value baked into the
+                # program is a silent correctness bug)
+                if dotted in HOST_CLOCK_CALLS:
+                    self.emit(
+                        "PML010", node,
+                        f"{dotted}() in jit-reachable code runs once at "
+                        "trace time — it measures compilation, not the "
+                        "run; wrap the DISPATCH in a parmmg_tpu.obs."
+                        "trace span (PMMGTPU_TRACE) instead",
                     )
                 if _is_numpy(mi, fn) and any(
                     self.tainted(a) for a in node.args

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..core import adjacency, metric as metric_mod, tags
 from ..core.mesh import Mesh, compact, compact_aux
+from ..obs import metrics as obs_metrics, trace as obs_trace
 from ..ops import analysis, collapse, common, quality, smooth, split, swap
 
 
@@ -982,12 +983,18 @@ def run_sweep_loop(
     nsplit/ncollapse/nswap/nmoved/ne/np (aggregated over shards where
     applicable) plus n_unique (max) and capped (any).
     """
+    tr = obs_trace.get_tracer()
     sweep = 0
     budget = opts.max_sweeps
     while sweep < budget:
         state = ensure_fn(state)
         ecap = int(tcap_fn(state) * emult[0]) + 64
-        state, rec = sweep_fn(state, ecap)
+        # device_span: the same named region shows up on the host track
+        # of a jax.profiler capture, aligning this dispatch with the
+        # XLA device trace
+        with tr.device_span("sweep", it=it, sweep=sweep):
+            state, rec = sweep_fn(state, ecap)
+        obs_metrics.record_sweep(rec)
         overflow = rec["n_unique"] > ecap
         if overflow:
             # unique_edges dropped overflow edges this sweep (its
@@ -1033,6 +1040,7 @@ def run_batched_sweep_loop(
     call runs as many sweeps as it can; the host only intervenes for
     capacity growth / edge-cap overflow, then re-enters. Replaces one
     dispatch + stats readback PER SWEEP with one per capacity event."""
+    tr = obs_trace.get_tracer()
     budget = opts.max_sweeps
     done = 0
     fr = None
@@ -1053,29 +1061,32 @@ def run_batched_sweep_loop(
                     or fr.tables[2].shape[0] != mesh.tcap
                 ):
                     fr = empty_frontier(mesh, ecap)
-                mesh, stats, fr = _sweep_body(
-                    mesh, ecap, noinsert=opts.noinsert,
-                    noswap=opts.noswap, nomove=opts.nomove,
-                    nosurf=opts.nosurf, hausd=hausd, fused=False,
-                    frontier=fr,
-                )
+                with tr.device_span("sweep_unfused", it=it, sweep=done):
+                    mesh, stats, fr = _sweep_body(
+                        mesh, ecap, noinsert=opts.noinsert,
+                        noswap=opts.noswap, nomove=opts.nomove,
+                        nosurf=opts.nosurf, hausd=hausd, fused=False,
+                        frontier=fr,
+                    )
             else:
-                mesh, stats = _sweep_body(
-                    mesh, ecap, noinsert=opts.noinsert, noswap=opts.noswap,
-                    nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
-                    fused=False,
-                )
+                with tr.device_span("sweep_unfused", it=it, sweep=done):
+                    mesh, stats = _sweep_body(
+                        mesh, ecap, noinsert=opts.noinsert,
+                        noswap=opts.noswap, nomove=opts.nomove,
+                        nosurf=opts.nosurf, hausd=hausd, fused=False,
+                    )
             hist = _hist_row(stats, mesh.ntet, mesh.npoin)[None, :]
             n = 1
         else:
-            mesh, hist, n_done = remesh_sweeps(
-                mesh, jnp.int32(budget - done), ecap, opts.max_sweeps,
-                noinsert=opts.noinsert, noswap=opts.noswap,
-                nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
-                converge_frac=opts.converge_frac,
-                grow_trigger=opts.grow_trigger,
-                frontier=opts.frontier,
-            )
+            with tr.device_span("remesh_sweeps", it=it, sweep=done):
+                mesh, hist, n_done = remesh_sweeps(
+                    mesh, jnp.int32(budget - done), ecap, opts.max_sweeps,
+                    noinsert=opts.noinsert, noswap=opts.noswap,
+                    nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
+                    converge_frac=opts.converge_frac,
+                    grow_trigger=opts.grow_trigger,
+                    frontier=opts.frontier,
+                )
             n = int(n_done)
             if n == 0:
                 break
@@ -1087,6 +1098,7 @@ def run_batched_sweep_loop(
             rec["capped"] = bool(rec["capped"])
             rec.update(iter=it, sweep=done + i)
             history.append(rec)
+            obs_metrics.record_sweep(rec)
             if opts.verbose >= 2:
                 act = rec["n_active"] / max(rec["n_unique"], 1)
                 print(
@@ -1190,6 +1202,7 @@ def _grow_for_recovery(mesh: Mesh, opts: AdaptOptions) -> Mesh:
     return mesh.with_capacity(*want)
 
 
+@obs_trace.traced("adapt", driver="centralized")
 def adapt(
     mesh: Mesh,
     opts: AdaptOptions | None = None,
@@ -1197,6 +1210,14 @@ def adapt(
     checkpoint_dir: Optional[str] = None,
 ):
     """Adapt `mesh` to its metric. Returns (mesh, info dict).
+
+    Observability (`parmmg_tpu.obs`): the run is traced as a span tree
+    (run → phase → iteration → sweep) on the process tracer — a
+    `Tracer` passed via the extra ``tracer=`` keyword, else the
+    ``PMMGTPU_TRACE=dir[,profile]`` environment contract, else the
+    no-op NullTracer (the default: zero overhead). Sweep/op counters
+    land in the `obs.metrics` registry either way, snapshotted per
+    iteration.
 
     Host loop over `opts.niter` outer iterations of up to `max_sweeps`
     operator sweeps each, with capacity growth between sweeps — the
@@ -1235,9 +1256,20 @@ def adapt(
         if derived is not None:
             opts = dataclasses.replace(opts, mem_budget_mb=derived)
     fs = failsafe.harness(opts, driver="centralized")
+    tr = obs_trace.get_tracer()
     # unique-edge capacity multiplier: ~1.19 edges/tet asymptotically, but
     # pathological meshes can exceed 1.6x — grown on overflow
     emult = [1.6]
+
+    # sequential phase spans: each _phase() closes the previous phase's
+    # span and opens the next, so the whole run partitions into
+    # phase:<name> spans under the root (the `printim` boundaries)
+    _phase_span = [None]
+
+    def _close_phase():
+        if _phase_span[0] is not None:
+            _phase_span[0].__exit__(None, None, None)
+            _phase_span[0] = None
 
     def _phase(name):
         # progress marker per setup phase: jit COMPILATION is host-
@@ -1246,6 +1278,10 @@ def adapt(
         # the first sweep prints — watchdogs key off them
         if phase_hook is not None:
             phase_hook(name)
+        if tr.enabled:
+            _close_phase()
+            _phase_span[0] = tr.span(f"phase:{name}")
+            _phase_span[0].__enter__()
         if opts.verbose >= 2:
             print(f"  ## phase: {name}", flush=True)
 
@@ -1350,20 +1386,23 @@ def adapt(
                 return m
 
             try:
-                if attempts:
-                    # recovery re-entry: its recompiles (grown shapes /
-                    # cleared caches) are accounted to a recovery
-                    # phase, not charged against the steady budgets
-                    with contracts.budget_exempt("iteration-retry"):
+                with tr.span("iteration", it=it):
+                    if attempts:
+                        # recovery re-entry: its recompiles (grown
+                        # shapes / cleared caches) are accounted to a
+                        # recovery phase, not charged against the
+                        # steady budgets
+                        with contracts.budget_exempt("iteration-retry"):
+                            mesh = _iteration(mesh)
+                    else:
                         mesh = _iteration(mesh)
-                else:
-                    mesh = _iteration(mesh)
             except failsafe.MemoryBudgetError:
                 raise
             except failsafe.CapacityError as e:
                 history.append(dict(iter=it, phase="remesh",
                                     failure=str(e),
                                     error=type(e).__name__))
+                failsafe.record_rollback(it, e, phase="remesh")
                 if last_good is None:
                     raise
                 mesh = failsafe.snapshot(last_good)
@@ -1383,6 +1422,7 @@ def adapt(
                 history.append(dict(iter=it, phase="remesh",
                                     failure=str(e),
                                     error=type(e).__name__))
+                failsafe.record_rollback(it, e, phase="remesh")
                 if last_good is None:
                     raise
                 mesh = failsafe.snapshot(last_good)
@@ -1400,6 +1440,7 @@ def adapt(
                 history.append(dict(iter=it, phase="remesh",
                                     failure=str(e),
                                     error=type(e).__name__))
+                failsafe.record_rollback(it, e, phase="remesh")
                 if last_good is None:
                     raise
                 mesh = failsafe.snapshot(last_good)
@@ -1407,6 +1448,8 @@ def adapt(
                 break
             attempts = 0
             last_good = fs.snapshot(mesh)
+            if tr.enabled:
+                obs_metrics.registry().snapshot(it)
             if fs.ckpt is not None and (
                 fs.ckpt.due(it) or fs.preempt_requested
                 # a maintenance-event notice forces an out-of-cadence
@@ -1425,8 +1468,9 @@ def adapt(
                     meta["hausd"] = float(hausd)
                 else:
                     aux["hausd"] = hausd
-                fs.save(it, meshes, history=history, emult=emult[0],
-                        meta=meta, aux_arrays=aux, force=True)
+                with tr.span("checkpoint", it=it):
+                    fs.save(it, meshes, history=history, emult=emult[0],
+                            meta=meta, aux_arrays=aux, force=True)
             if fs.preempt_requested:
                 # the grace window of a real preemption notice: the
                 # iteration's checkpoint is committed, so exit through
@@ -1443,6 +1487,9 @@ def adapt(
         # COMMITTED before control leaves the loop — every exit path
         # (completion, typed failure, preemption) ends drained
         fs.finish()
+        # the open phase span must not leak past an exception exit —
+        # the timeline should end where the run did
+        _close_phase()
 
     # once, after the final iteration — polishing between iterations is
     # wasted work (the next iteration's insertion sweeps disturb it)
@@ -1454,6 +1501,7 @@ def adapt(
 
         mesh = interp.interp_fields_only(mesh, old_snapshot)
     h1 = quality.quality_histogram(mesh)
+    _close_phase()
     info = dict(history=history, qual_in=h0, qual_out=h1,
                 presize_skipped=presize_skipped,
                 mem_budget_mb=opts.mem_budget_mb,

@@ -34,6 +34,7 @@ import numpy as np
 from ..core import adjacency, tags
 from ..core.mesh import Mesh, compact
 from ..failsafe import CapacityError
+from ..obs import metrics as obs_metrics, trace as obs_trace
 from ..ops import analysis, interp, quality
 from ..parallel.distribute import (
     ShardComm,
@@ -440,6 +441,7 @@ def _resume_stacked(resume, opts: DistOptions):
     return stacked, icap
 
 
+@obs_trace.traced("adapt_distributed", driver="distributed")
 def adapt_distributed(
     mesh: Mesh,
     opts: Optional[DistOptions] = None,
@@ -595,6 +597,7 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
 
     if fs is None:
         fs = failsafe.harness(opts, driver="distributed")
+    tr = obs_trace.get_tracer()
     nparts = opts.nparts
     emult = [emult0 if emult0 is not None else 1.6]
     icap = icap0
@@ -627,20 +630,24 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                 return st, cm, ic
 
             try:
-                if attempts:
-                    # recovery re-entry: recompiles (grown shapes /
-                    # cleared caches) land in a recovery phase, exempt
-                    # from the steady retrace budgets
-                    with contracts.budget_exempt("iteration-retry"):
+                with tr.span("iteration", it=it):
+                    if attempts:
+                        # recovery re-entry: recompiles (grown shapes /
+                        # cleared caches) land in a recovery phase,
+                        # exempt from the steady retrace budgets
+                        with contracts.budget_exempt("iteration-retry"):
+                            stacked, comm, icap = _iteration(
+                                stacked, comm, icap
+                            )
+                    else:
                         stacked, comm, icap = _iteration(
                             stacked, comm, icap
                         )
-                else:
-                    stacked, comm, icap = _iteration(stacked, comm, icap)
             except failsafe.CapacityError as e:
                 history.append(dict(iter=it, phase="iteration",
                                     failure=str(e),
                                     error=type(e).__name__))
+                failsafe.record_rollback(it, e)
                 if last_good is None:
                     raise
                 stacked = failsafe.snapshot(last_good)
@@ -664,6 +671,7 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                 history.append(dict(iter=it, phase="iteration",
                                     failure=str(e),
                                     error=type(e).__name__))
+                failsafe.record_rollback(it, e)
                 if last_good is None:
                     raise
                 stacked = failsafe.snapshot(last_good)
@@ -675,12 +683,14 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                     continue
                 status = tags.ReturnStatus.LOWFAILURE
                 break
-            except failsafe.PeerLostError:
+            except failsafe.PeerLostError as e:
                 # a dead peer cannot be rolled back around: the SPMD
                 # world is broken, every further collective would hang.
                 # Re-raise through the graded-degradation ladder — the
                 # cure is checkpoint-backed restart, not LOWFAILURE
                 # (which would run the post-loop collectives below)
+                obs_trace.emit_event("peer_lost", it=int(it),
+                                     error=str(e)[:200])
                 raise
             except (FloatingPointError, ValueError, RuntimeError,
                     OverflowError) as e:
@@ -690,6 +700,7 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                 # defects
                 history.append(dict(iter=it, failure=str(e),
                                     error=type(e).__name__))
+                failsafe.record_rollback(it, e)
                 if last_good is None:
                     raise
                 stacked = failsafe.snapshot(last_good)
@@ -699,6 +710,8 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                 break
             attempts = 0
             last_good = fs.snapshot(stacked)
+            if tr.enabled:
+                obs_metrics.registry().snapshot(it)
             if fs.ckpt is not None and (
                 fs.ckpt.due(it) or fs.preempt_requested
                 # a maintenance-event notice forces an out-of-cadence
@@ -712,9 +725,10 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                     meta["hausd"] = float(hausd)
                 else:
                     aux["hausd"] = hausd
-                fs.save(it, {"mesh": stacked}, history=history,
-                        emult=emult[0], meta=meta, aux_arrays=aux,
-                        force=True)
+                with tr.span("checkpoint", it=it):
+                    fs.save(it, {"mesh": stacked}, history=history,
+                            emult=emult[0], meta=meta, aux_arrays=aux,
+                            force=True)
             if fs.preempt_requested:
                 # preemption grace window: the iteration's (sharded,
                 # barrier-committed) checkpoint is in place — exit via
@@ -742,17 +756,20 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
         from .. import failsafe
 
         fs = failsafe.harness(opts, driver="distributed")
+    tr = obs_trace.get_tracer()
     # snapshot for interpolation (PMMG_update_oldGrps role,
     # src/grpsplit_pmmg.c:1224) — needs fresh adjacency for the walk
     old = jax.vmap(adjacency.build_adjacency)(stacked)
 
-    stacked = remesh_phase(stacked, opts, emult, history, it, hausd,
-                           fs=fs)
-    stacked = jax.vmap(compact)(stacked)
+    with tr.span("phase:remesh", it=it):
+        stacked = remesh_phase(stacked, opts, emult, history, it, hausd,
+                               fs=fs)
+        stacked = jax.vmap(compact)(stacked)
     stacked = fs.fire(it, "remesh", stacked)
 
     # interpolate metric + fields from the snapshot
-    stacked = interp_phase(stacked, old, opts)
+    with tr.device_span("phase:interp", it=it):
+        stacked = interp_phase(stacked, old, opts)
     stacked = fs.fire(it, "interp", stacked)
 
     if opts.check_comm:
@@ -814,6 +831,19 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
             cnts = np.asarray(jax.device_get(
                 migrate_mod.migration_counts(stacked, color, nparts)
             ))
+        # migration telemetry: cells crossing shards and an estimated
+        # wire payload (tet row + its 4 vertex rows + amortized
+        # surface/edge freight — the _pack stream contents), so the
+        # run report can attribute comm volume per iteration
+        moved_cells = int(cnts.sum())
+        if moved_cells:
+            fsz = jnp.dtype(stacked.vert.dtype).itemsize
+            per_tet = (4 * 4 + 4) + 4 * (3 * fsz + 3 * 4) + 16
+            reg = obs_metrics.registry()
+            reg.counter("migrate/cells_moved").inc(moved_cells)
+            reg.counter("migrate/payload_bytes").inc(
+                moved_cells * per_tet
+            )
         shard_ne = np.asarray(
             jax.device_get(jnp.sum(stacked.tmask, axis=1))
         )
@@ -875,15 +905,20 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
             moved = None
             for att in range(3):
                 try:
-                    moved = migrate_mod.migrate(
-                        stacked, color, nparts, slot_cap
-                    )
+                    with tr.device_span("migrate_exchange", it=it):
+                        moved = migrate_mod.migrate(
+                            stacked, color, nparts, slot_cap
+                        )
                     break
                 except CapacityError as e:
                     history.append(dict(
                         iter=it, phase="migrate", failure=str(e),
                         error=type(e).__name__, recovered=True,
                     ))
+                    obs_trace.emit_event(
+                        "migrate_capacity_retry", it=int(it),
+                        attempt=att,
+                    )
                     if att == 2:
                         break
                     if e.counts is not None:
@@ -942,6 +977,7 @@ def _rebalance_full(stacked: Mesh, comm: ShardComm, nparts: int):
     )
 
 
+@obs_trace.traced("adapt_stacked_input", driver="distributed-input")
 def adapt_stacked_input(
     stacked: Mesh,
     comm: Optional[ShardComm],

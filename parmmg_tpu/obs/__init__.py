@@ -1,0 +1,26 @@
+"""Unified observability layer: span tracer + metrics registry + report.
+
+One subsystem serving both drivers, the failsafe/checkpoint stack and
+the bench ladder (the `mytime`/`printim`/`PMMG_VERB_*` role of the
+reference, extended to attribute time inside jitted/SPMD regions):
+
+- `obs.trace`  — hierarchical spans (run → iteration → phase → op)
+  exported as Chrome-trace-event JSON (Perfetto-loadable) + a durable
+  JSONL event log, with `jax.profiler` alignment and an opt-in device
+  capture window (``PMMGTPU_TRACE=dir[,profile]``). Disabled (the
+  default) it compiles down to no-op singletons.
+- `obs.metrics` — typed counters/gauges/histograms, per-rank under
+  `jax.distributed`, with a rank merge so one JSON describes the world.
+- `obs.report` — the post-mortem renderer behind `tools/obs_report.py`.
+"""
+
+from . import metrics, report, trace  # noqa: F401
+from .metrics import MetricsRegistry, merge_rank_docs, registry  # noqa: F401
+from .trace import (  # noqa: F401
+    NullTracer,
+    Tracer,
+    emit_event,
+    get_tracer,
+    install,
+    traced,
+)

@@ -1,0 +1,270 @@
+"""Typed per-rank metrics registry with a cross-rank merge.
+
+The numeric half of the observability layer (`obs.trace` is the time
+half): counters, gauges and histograms keyed by slash-namespaced names,
+recorded from the drivers, the migration/comm layer, the checkpoint
+store and the retrace counter. All recording is plain locked host
+arithmetic — a few dict operations per sweep — so the registry is
+ALWAYS on; only the exports are gated on tracing.
+
+Naming convention (what `tools/obs_report.py` renders):
+
+  ops/<op>_accepted      accepted operations per operator (split /
+                         collapse / swap / smooth), exactly the
+                         driver-reported history counts
+  ops/candidates         active edges offered to the operators
+  sweeps                 executed operator sweeps
+  sweep_active_fraction  gauge: last sweep's active fraction
+  migrate/cells_moved    tets exchanged between shards
+  migrate/payload_bytes  estimated migration payload
+  comm/barriers          coordination barriers entered
+  comm/collectives       cross-process gathers dispatched
+  ckpt/ops, ckpt/retries, ckpt/commits, ckpt/put_bytes, ckpt/get_bytes
+  ckpt/op_seconds        histogram of store-operation latency
+  retry/attempts         generic utils.retry re-attempts
+  recompiles/<phase>     jit cache misses per RetraceCounter phase
+  failsafe/faults_injected, failsafe/rollbacks
+
+Per-rank story: each process owns one registry and writes
+``metrics_rank<r>.json`` into the trace directory
+(`MetricsRegistry.write`, called by `Tracer.flush`); `merge_rank_docs`
+folds any number of rank documents into ONE world document (counters
+and histograms summed, gauges kept per rank with a world max), so a
+single JSON describes the whole world post-mortem.
+
+Iteration series: `snapshot(it)` appends a row of the current counter
+values — the per-iteration trajectory the run report plots, and how a
+chaos run's failure timeline lines up with the metric state at each
+boundary.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+    "record_sweep", "merge_rank_docs", "read_rank_docs", "merge_dir",
+]
+
+
+class Counter:
+    """Monotone int counter."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += int(n)
+
+
+class Gauge:
+    """Last-write-wins scalar."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Streaming summary (count / sum / min / max) — enough for the
+    latency tables the report renders, with no bin-edge contract to
+    version across ranks."""
+
+    __slots__ = ("count", "sum", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def to_doc(self) -> dict:
+        if self.count == 0:
+            return dict(count=0, sum=0.0)
+        return dict(count=self.count, sum=self.sum, min=self.min,
+                    max=self.max, mean=self.sum / self.count)
+
+
+class MetricsRegistry:
+    """One process's metric state. Thread-safe (one lock — recording
+    is a handful of ops, contention is negligible next to a device
+    dispatch)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self.series: List[dict] = []
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter()
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge()
+            return g
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram()
+            return h
+
+    def snapshot(self, it: int) -> None:
+        """Append the per-iteration row: current counter values plus
+        gauges, stamped with the iteration id."""
+        with self._lock:
+            row = {"it": int(it)}
+            row.update({k: c.value for k, c in self._counters.items()})
+            row.update({k: g.value for k, g in self._gauges.items()})
+            self.series.append(row)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self.series.clear()
+
+    def to_doc(self, rank: int = 0) -> dict:
+        with self._lock:
+            return dict(
+                rank=int(rank),
+                counters={k: c.value for k, c in
+                          sorted(self._counters.items())},
+                gauges={k: g.value for k, g in
+                        sorted(self._gauges.items())},
+                histograms={k: h.to_doc() for k, h in
+                            sorted(self._histograms.items())},
+                series=list(self.series),
+            )
+
+    def write(self, dirpath: str, rank: int = 0) -> str:
+        """Atomic per-rank metrics file in the trace directory."""
+        path = os.path.join(dirpath, f"metrics_rank{rank}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_doc(rank), f)
+        os.replace(tmp, path)
+        return path
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-global registry every instrumentation site records
+    into (tests reset it around a measured run)."""
+    return _REGISTRY
+
+
+def record_sweep(rec: dict) -> None:
+    """Fold one sweep history record (the drivers' HIST_COLS dict) into
+    the registry — the single definition shared by the single-shard,
+    vmapped and SPMD sweep engines, so `ops/*_accepted` is EXACTLY the
+    sum of the driver-reported history."""
+    reg = _REGISTRY
+    reg.counter("sweeps").inc()
+    reg.counter("ops/split_accepted").inc(rec.get("nsplit", 0))
+    reg.counter("ops/collapse_accepted").inc(rec.get("ncollapse", 0))
+    reg.counter("ops/swap_accepted").inc(rec.get("nswap", 0))
+    reg.counter("ops/smooth_moved").inc(rec.get("nmoved", 0))
+    n_act = rec.get("n_active", rec.get("n_unique", 0))
+    reg.counter("ops/candidates").inc(n_act)
+    nu = rec.get("n_unique", 0)
+    if nu:
+        reg.gauge("sweep_active_fraction").set(n_act / nu)
+
+
+# ---------------------------------------------------------------------------
+# cross-rank merge
+# ---------------------------------------------------------------------------
+
+
+def merge_rank_docs(docs: List[dict]) -> dict:
+    """Fold per-rank metric documents into one world document:
+    counters and histograms are summed, gauges keep a per-rank map plus
+    the world max, iteration series are kept per rank. Input order is
+    irrelevant; ranks are read from each document."""
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, dict] = {}
+    hists: Dict[str, dict] = {}
+    series: Dict[str, list] = {}
+    ranks = []
+    for doc in docs:
+        r = int(doc.get("rank", 0))
+        ranks.append(r)
+        for k, v in doc.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + int(v)
+        for k, v in doc.get("gauges", {}).items():
+            g = gauges.setdefault(k, {"per_rank": {}, "max": None})
+            g["per_rank"][str(r)] = v
+            g["max"] = v if g["max"] is None else max(g["max"], v)
+        for k, h in doc.get("histograms", {}).items():
+            m = hists.setdefault(
+                k, dict(count=0, sum=0.0, min=float("inf"),
+                        max=float("-inf")),
+            )
+            m["count"] += int(h.get("count", 0))
+            m["sum"] += float(h.get("sum", 0.0))
+            if h.get("count"):
+                m["min"] = min(m["min"], float(h["min"]))
+                m["max"] = max(m["max"], float(h["max"]))
+        series[str(r)] = doc.get("series", [])
+    for m in hists.values():
+        if m["count"]:
+            m["mean"] = m["sum"] / m["count"]
+        else:
+            m.pop("min"), m.pop("max")
+    return dict(
+        world=len(docs),
+        ranks=sorted(ranks),
+        counters=dict(sorted(counters.items())),
+        gauges=dict(sorted(gauges.items())),
+        histograms=dict(sorted(hists.items())),
+        series=series,
+    )
+
+
+def read_rank_docs(dirpath: str) -> List[dict]:
+    docs = []
+    for path in sorted(glob.glob(
+            os.path.join(dirpath, "metrics_rank*.json"))):
+        with open(path) as f:
+            docs.append(json.load(f))
+    return docs
+
+
+def merge_dir(dirpath: str) -> Optional[dict]:
+    """One world metrics document from every per-rank file in a trace
+    directory (None when the directory holds none)."""
+    docs = read_rank_docs(dirpath)
+    return merge_rank_docs(docs) if docs else None

@@ -1,0 +1,227 @@
+"""Run-report renderer over a trace directory.
+
+Consumes what `obs.trace.Tracer.flush` leaves behind —
+``trace_rank<r>.json`` (Chrome trace events), ``events_rank<r>.jsonl``
+(the durable line log) and ``metrics_rank<r>.json`` (per-rank registry
+snapshots) — and renders the post-mortem a run operator wants first:
+
+- phase breakdown: wall time per span name (count / total / mean),
+  top-level phases separated from nested op spans;
+- operator acceptance: candidates offered vs accepted per operator;
+- comm / migration / checkpoint volume (collectives, cells moved,
+  payload and checkpoint bytes, store retry and latency summary);
+- retrace table: jit cache misses per RetraceCounter phase;
+- failure timeline: every instant event (faults injected, rollbacks,
+  checkpoint commits, preemption notices) in time order.
+
+`tools/obs_report.py` is the CLI wrapper; tests and the obs smoke
+stage call :func:`render` directly.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional
+
+from . import metrics as metrics_mod
+
+__all__ = ["load_trace_events", "load_timeline", "summarize", "render"]
+
+
+def load_trace_events(dirpath: str) -> List[dict]:
+    """All Chrome trace events of every rank's trace_rank*.json."""
+    events: List[dict] = []
+    for path in sorted(glob.glob(
+            os.path.join(dirpath, "trace_rank*.json"))):
+        with open(path) as f:
+            doc = json.load(f)
+        events.extend(doc.get("traceEvents", []))
+    return events
+
+
+def load_timeline(dirpath: str) -> List[dict]:
+    """All JSONL records of every rank, time-ordered. Tolerates a
+    truncated final line (a process killed mid-write)."""
+    recs: List[dict] = []
+    for path in sorted(glob.glob(
+            os.path.join(dirpath, "events_rank*.jsonl"))):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    recs.sort(key=lambda r: (r.get("ts_us", 0), r.get("rank", 0)))
+    return recs
+
+
+def _span_table(events: List[dict]) -> Dict[str, dict]:
+    table: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        row = table.setdefault(
+            ev["name"], dict(count=0, total_us=0, max_us=0)
+        )
+        row["count"] += 1
+        dur = int(ev.get("dur", 0))
+        row["total_us"] += dur
+        row["max_us"] = max(row["max_us"], dur)
+    return table
+
+
+def summarize(dirpath: str) -> dict:
+    """Structured summary document (what `render` formats, and what
+    the obs smoke stage asserts on)."""
+    events = load_trace_events(dirpath)
+    timeline = load_timeline(dirpath)
+    metrics = metrics_mod.merge_dir(dirpath)
+    spans = _span_table(events)
+    counters = (metrics or {}).get("counters", {})
+    ops = {}
+    for op in ("split", "collapse", "swap"):
+        ops[op] = counters.get(f"ops/{op}_accepted", 0)
+    accepted = sum(ops.values())
+    candidates = counters.get("ops/candidates", 0)
+    return dict(
+        dir=dirpath,
+        n_spans=sum(r["count"] for r in spans.values()),
+        spans=spans,
+        ops=dict(
+            accepted=accepted,
+            accepted_per_op=ops,
+            moved=counters.get("ops/smooth_moved", 0),
+            candidates=candidates,
+            acceptance=(accepted / candidates) if candidates else None,
+            sweeps=counters.get("sweeps", 0),
+        ),
+        comm=dict(
+            barriers=counters.get("comm/barriers", 0),
+            collectives=counters.get("comm/collectives", 0),
+            cells_moved=counters.get("migrate/cells_moved", 0),
+            payload_bytes=counters.get("migrate/payload_bytes", 0),
+        ),
+        ckpt=dict(
+            ops=counters.get("ckpt/ops", 0),
+            retries=counters.get("ckpt/retries", 0),
+            commits=counters.get("ckpt/commits", 0),
+            put_bytes=counters.get("ckpt/put_bytes", 0),
+            get_bytes=counters.get("ckpt/get_bytes", 0),
+            op_seconds=(metrics or {}).get("histograms", {}).get(
+                "ckpt/op_seconds"
+            ),
+        ),
+        retries=counters.get("retry/attempts", 0),
+        recompiles={
+            k[len("recompiles/"):]: v for k, v in counters.items()
+            if k.startswith("recompiles/")
+        },
+        failsafe=dict(
+            faults_injected=counters.get("failsafe/faults_injected", 0),
+            rollbacks=counters.get("failsafe/rollbacks", 0),
+        ),
+        events=[r for r in timeline if r.get("type") == "event"],
+        metrics=metrics,
+    )
+
+
+def _fmt_us(us: int) -> str:
+    if us >= 1_000_000:
+        return f"{us / 1e6:9.3f} s"
+    return f"{us / 1e3:9.3f} ms"
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n} B"
+
+
+def render(dirpath: str) -> str:
+    """Human-readable run report (the `printim`-style summary of the
+    traced run)."""
+    s = summarize(dirpath)
+    lines = [f"== obs report: {s['dir']} =="]
+
+    lines.append("")
+    lines.append("-- phase breakdown (span wall time) --")
+    if not s["spans"]:
+        lines.append("   (no spans recorded)")
+    for name, row in sorted(
+        s["spans"].items(), key=lambda kv: -kv[1]["total_us"]
+    ):
+        lines.append(
+            f"   {name:<28s} x{row['count']:<5d} "
+            f"total {_fmt_us(row['total_us'])}  "
+            f"max {_fmt_us(row['max_us'])}"
+        )
+
+    o = s["ops"]
+    lines.append("")
+    lines.append("-- operators --")
+    per_op = "  ".join(
+        f"{k} {v}" for k, v in o["accepted_per_op"].items()
+    )
+    lines.append(
+        f"   sweeps {o['sweeps']}  candidates {o['candidates']}  "
+        f"accepted {o['accepted']} ({per_op})  moved {o['moved']}"
+    )
+    if o["acceptance"] is not None:
+        lines.append(f"   acceptance rate {o['acceptance']:.3%}")
+
+    c = s["comm"]
+    lines.append("")
+    lines.append("-- comm / migration --")
+    lines.append(
+        f"   barriers {c['barriers']}  collectives {c['collectives']}  "
+        f"cells moved {c['cells_moved']}  "
+        f"payload {_fmt_bytes(c['payload_bytes'])}"
+    )
+
+    k = s["ckpt"]
+    lines.append("")
+    lines.append("-- checkpoint I/O --")
+    lines.append(
+        f"   ops {k['ops']}  retries {k['retries']}  "
+        f"commits {k['commits']}  put {_fmt_bytes(k['put_bytes'])}  "
+        f"get {_fmt_bytes(k['get_bytes'])}"
+    )
+    if k["op_seconds"] and k["op_seconds"].get("count"):
+        h = k["op_seconds"]
+        lines.append(
+            f"   op latency mean {h['mean'] * 1e3:.1f} ms  "
+            f"max {h['max'] * 1e3:.1f} ms over {h['count']} ops"
+        )
+
+    lines.append("")
+    lines.append("-- recompiles (jit cache misses per phase) --")
+    if s["recompiles"]:
+        for phase, n in sorted(s["recompiles"].items()):
+            lines.append(f"   {phase:<28s} {n}")
+    else:
+        lines.append("   (none recorded)")
+
+    lines.append("")
+    lines.append("-- failure timeline --")
+    fs = s["failsafe"]
+    lines.append(
+        f"   faults injected {fs['faults_injected']}  "
+        f"rollbacks {fs['rollbacks']}"
+    )
+    for ev in s["events"]:
+        extra = ev.get("args", {})
+        lines.append(
+            f"   [{ev.get('ts_us', 0) / 1e6:9.3f}s r{ev.get('rank', 0)}] "
+            f"{ev.get('name')} {extra if extra else ''}".rstrip()
+        )
+    if not s["events"]:
+        lines.append("   (no events)")
+    lines.append("")
+    return "\n".join(lines)

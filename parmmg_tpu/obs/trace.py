@@ -1,0 +1,385 @@
+"""Structured span tracer with device-profile alignment.
+
+The reference ships a real observability surface — the `mytime`/
+`printim` phase timers and the `PMMG_VERB_*` ladder (reference
+`src/parmmg.c:91-92`, `src/libparmmg1.c:637-660`) — but host wall
+clocks cannot attribute time inside jitted/SPMD regions, where all the
+cost of this port lives. This module is the host half of a two-sided
+story:
+
+- **hierarchical spans** (run → iteration → phase → op) recorded into a
+  thread-safe in-process buffer and exported two ways: a Chrome-trace-
+  event JSON (``trace.json``, loadable in Perfetto / chrome://tracing)
+  and an append-only JSONL event log (``events.jsonl``) written line-
+  by-line with an explicit flush, so a process that dies via
+  ``os._exit`` (the injected ``kill`` fault, a real preemption) still
+  leaves a complete timeline up to the instant of death;
+- **device alignment**: spans around jitted dispatch additionally enter
+  a `jax.profiler.TraceAnnotation`, so when a device profile is
+  captured (``PMMGTPU_TRACE=dir,profile`` arms
+  ``jax.profiler.start_trace``) the host spans line up with the XLA
+  device trace in the same Perfetto view;
+- **zero-cost disabled path**: when ``PMMGTPU_TRACE`` is unset the
+  process tracer is a :class:`NullTracer` whose ``span()`` returns one
+  shared no-op context manager — no allocation, no clock read, no
+  branch beyond the method call (guarded by a measured test in
+  tests/test_m16_obs.py).
+
+Env contract::
+
+  PMMGTPU_TRACE=<dir>[,profile]
+
+``<dir>`` receives ``trace.json`` + ``events.jsonl`` +
+``metrics_rank<r>.json`` (one per process under `jax.distributed`);
+``,profile`` additionally opens a `jax.profiler` capture window for the
+tracer's lifetime, writing the device profile under the same directory.
+
+The process-global tracer is resolved once from the environment
+(`get_tracer`); drivers accept an explicit ``tracer=`` argument which
+is installed for the duration of the run (`activate`/`restore`), so
+module-level emitters (`emit_event`, the failsafe fault hooks, the
+checkpoint store) reach the right sink without plumbing a handle
+through every call.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "Tracer", "NullTracer", "get_tracer", "install", "activate",
+    "restore", "emit_event", "traced", "from_env",
+]
+
+
+class _NullSpan:
+    """Shared no-op context manager: the whole disabled-path cost."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """Disabled tracer: every operation is a no-op returning shared
+    singletons. `adapt` runs with exactly this unless PMMGTPU_TRACE is
+    set or a Tracer is passed in — the hot path must not pay for
+    observability it did not ask for."""
+
+    enabled = False
+    dir: Optional[str] = None
+
+    def span(self, name, **args):
+        return _NULL_SPAN
+
+    def device_span(self, name, **args):
+        return _NULL_SPAN
+
+    def event(self, name, **args):
+        return None
+
+    def current_span(self) -> Optional[str]:
+        return None
+
+    def flush(self):
+        return None
+
+
+class _Span:
+    """One live span: context manager handed out by `Tracer.span`."""
+
+    __slots__ = ("tracer", "name", "args", "t0", "annotation")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict,
+                 annotate: bool = False):
+        self.tracer = tracer
+        self.name = name
+        self.args = args
+        self.t0 = 0
+        # device-profile alignment: the same named region appears on
+        # the host track of a jax.profiler capture
+        self.annotation = None
+        if annotate:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self.annotation = TraceAnnotation(name)
+            except Exception:
+                self.annotation = None
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        self.tracer._push(self.name)
+        if self.annotation is not None:
+            self.annotation.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.annotation is not None:
+            self.annotation.__exit__(exc_type, exc, tb)
+        t1 = time.perf_counter_ns()
+        if exc_type is not None:
+            # a span cut short by an exception is still closed — the
+            # timeline must show where the failure path left the run
+            self.args = dict(self.args, error=exc_type.__name__)
+        self.tracer._pop(self.name, self.t0, t1, self.args)
+        return False
+
+
+class Tracer:
+    """Enabled tracer: spans + instant events into `dir`.
+
+    Thread-safe: the event buffer and the JSONL stream are guarded by
+    one lock; span nesting is tracked per thread (Chrome trace derives
+    nesting from ts/dur containment per ``tid``, the JSONL records an
+    explicit ``depth``). Every JSONL line is flushed on write so the
+    log survives ``os._exit`` — the chaos timelines depend on it.
+    """
+
+    enabled = True
+
+    def __init__(self, dirpath: str, profile: bool = False,
+                 rank: Optional[int] = None):
+        self.dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        self.rank = self._rank() if rank is None else int(rank)
+        self._t0 = time.perf_counter_ns()
+        self._lock = threading.Lock()
+        self._events: List[dict] = []      # Chrome trace events
+        self._local = threading.local()
+        self._jsonl = open(
+            os.path.join(dirpath, f"events_rank{self.rank}.jsonl"), "a"
+        )
+        self._profiling = False
+        if profile:
+            self._start_profile()
+
+    @staticmethod
+    def _rank() -> int:
+        try:
+            import jax
+
+            return int(jax.process_index())
+        except Exception:
+            return 0
+
+    def _start_profile(self):
+        """Opt-in jax.profiler capture window: the device half of the
+        aligned view. Failure to start (no profiler backend, an already
+        active session) degrades to host-only tracing, never raises."""
+        try:
+            import jax
+
+            jax.profiler.start_trace(os.path.join(self.dir, "profile"))
+            self._profiling = True
+        except Exception:
+            self._profiling = False
+
+    # -- span bookkeeping -------------------------------------------------
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _push(self, name: str) -> None:
+        self._stack().append(name)
+
+    def _pop(self, name: str, t0_ns: int, t1_ns: int, args: dict) -> None:
+        st = self._stack()
+        depth = max(len(st) - 1, 0)
+        if st and st[-1] == name:
+            st.pop()
+        ts = (t0_ns - self._t0) // 1000
+        dur = max((t1_ns - t0_ns) // 1000, 0)
+        ev = {
+            "name": name, "ph": "X", "ts": ts, "dur": dur,
+            "pid": self.rank, "tid": threading.get_ident() & 0xFFFF,
+        }
+        if args:
+            ev["args"] = args
+        rec = dict(type="span", name=name, ts_us=ts, dur_us=dur,
+                   depth=depth, rank=self.rank)
+        if args:
+            rec["args"] = args
+        with self._lock:
+            self._events.append(ev)
+            self._write_jsonl(rec)
+
+    def _write_jsonl(self, rec: dict) -> None:
+        # default=str: span args may carry numpy scalars / enums
+        self._jsonl.write(json.dumps(rec, default=str) + "\n")
+        # explicit flush per line: the timeline must be on disk before
+        # an os._exit (injected kill / preemption) can cut the process
+        self._jsonl.flush()
+
+    # -- public API --------------------------------------------------------
+    def span(self, name: str, **args):
+        """Hierarchical span context manager; nesting follows the call
+        stack of the current thread."""
+        return _Span(self, name, args)
+
+    def device_span(self, name: str, **args):
+        """Span that also enters a `jax.profiler.TraceAnnotation`, so a
+        captured device profile shows the same named region — use around
+        jitted dispatch (the sweep calls)."""
+        return _Span(self, name, args, annotate=True)
+
+    def event(self, name: str, **args) -> None:
+        """Instant event (fault injected, rollback, checkpoint commit,
+        preemption notice...): lands in both exports immediately."""
+        ts = (time.perf_counter_ns() - self._t0) // 1000
+        ev = {
+            "name": name, "ph": "i", "s": "p", "ts": ts,
+            "pid": self.rank, "tid": threading.get_ident() & 0xFFFF,
+        }
+        if args:
+            ev["args"] = args
+        rec = dict(type="event", name=name, ts_us=ts, rank=self.rank)
+        if args:
+            rec["args"] = args
+        with self._lock:
+            self._events.append(ev)
+            self._write_jsonl(rec)
+
+    def current_span(self) -> Optional[str]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def flush(self) -> None:
+        """Write the Chrome trace JSON (idempotent — rewrites the whole
+        file from the buffer), flush the JSONL stream, snapshot the
+        process metrics registry next to them, and close an armed
+        profiler window. Safe to call repeatedly; the drivers call it
+        on every exit path."""
+        with self._lock:
+            events = list(self._events)
+            self._jsonl.flush()
+        doc = {
+            "traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": self.rank,
+                 "tid": 0, "args": {"name": f"rank{self.rank}"}},
+            ] + events,
+            "displayTimeUnit": "ms",
+        }
+        path = os.path.join(self.dir, f"trace_rank{self.rank}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        from . import metrics as _metrics
+
+        _metrics.registry().write(self.dir, rank=self.rank)
+        if self._profiling:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._profiling = False
+
+
+# ---------------------------------------------------------------------------
+# process-global tracer
+# ---------------------------------------------------------------------------
+
+_NULL = NullTracer()
+_TRACER: Optional[object] = None
+_ENV_RESOLVED = False
+_STATE_LOCK = threading.Lock()
+
+
+def from_env() -> object:
+    """Tracer per the PMMGTPU_TRACE contract (``dir[,profile]``), or
+    the shared NullTracer when unset."""
+    spec = os.environ.get("PMMGTPU_TRACE")
+    if not spec:
+        return _NULL
+    parts = [p.strip() for p in spec.split(",")]
+    dirpath, flags = parts[0], parts[1:]
+    return Tracer(dirpath, profile="profile" in flags)
+
+
+def get_tracer() -> object:
+    """The process tracer: an installed one, else the PMMGTPU_TRACE
+    environment resolution (performed once), else the NullTracer."""
+    global _TRACER, _ENV_RESOLVED
+    tr = _TRACER
+    if tr is not None:
+        return tr
+    with _STATE_LOCK:
+        if _TRACER is None and not _ENV_RESOLVED:
+            _ENV_RESOLVED = True
+            env_tr = from_env()
+            if env_tr.enabled:
+                _TRACER = env_tr
+        return _TRACER if _TRACER is not None else _NULL
+
+
+def install(tracer: Optional[object]):
+    """Install `tracer` as the process tracer; returns the previous
+    one (None if the environment resolution was still pending)."""
+    global _TRACER
+    with _STATE_LOCK:
+        prev = _TRACER
+        _TRACER = tracer
+    return prev
+
+
+def activate(tracer: Optional[object]):
+    """Driver entry: install an explicitly passed tracer (None keeps
+    the current/global one). Returns (tracer-in-effect, restore-token).
+    """
+    if tracer is None:
+        return get_tracer(), False
+    prev = install(tracer)
+    return tracer, (True, prev)
+
+
+def restore(token) -> None:
+    if token:
+        install(token[1])
+
+
+def emit_event(name: str, **args) -> None:
+    """Instant event on the process tracer (no-op when disabled) — the
+    hook used by call sites that hold no tracer handle (fault plan,
+    checkpoint store, preemption notices)."""
+    get_tracer().event(name, **args)
+
+
+def traced(span_name: str, **span_args):
+    """Decorator for driver entry points: accepts an extra ``tracer=``
+    keyword, installs it for the call, wraps the body in a root span
+    and flushes the exports on the way out (every exit path — normal,
+    typed failure, preemption — leaves trace.json/events.jsonl
+    consistent; the hard-kill path is covered by the per-line JSONL
+    flush)."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, tracer=None, **kwargs):
+            tr, token = activate(tracer)
+            try:
+                with tr.span(span_name, **span_args):
+                    return fn(*args, **kwargs)
+            finally:
+                try:
+                    tr.flush()
+                finally:
+                    restore(token)
+        return wrapper
+    return deco

@@ -41,6 +41,8 @@ import threading
 import jax
 import numpy as np
 
+from ..obs import metrics as obs_metrics, trace as obs_trace
+
 
 class MultihostConfigError(RuntimeError):
     """The PMMGTPU_* multi-host env contract is malformed (non-integer
@@ -226,6 +228,8 @@ _PREEMPT_CB = None
 
 def request_preemption_notice(reason: str = "") -> None:
     """Latch a pending preemption notice (idempotent)."""
+    if not _PREEMPT_NOTICE.is_set():
+        obs_trace.emit_event("preempt_notice", reason=reason)
     if reason:
         _PREEMPT_NOTICE_REASON.append(reason)
     _PREEMPT_NOTICE.set()
@@ -374,6 +378,7 @@ def barrier(tag: str = "parmmg-barrier",
     errors) are mapped to the same type."""
     if not is_multiprocess():
         return
+    obs_metrics.registry().counter("comm/barriers").inc()
     from ..failsafe import PeerLostError
 
     def _sync():
@@ -475,6 +480,7 @@ def gather_stacked(tree, timeout: float | None = None):
         if isinstance(a, jax.Array) and not a.is_fully_addressable
     ]
     if idx:
+        obs_metrics.registry().counter("comm/collectives").inc()
         sub = [leaves[i] for i in idx]
         dev = sub[0].sharding._device_assignment
 

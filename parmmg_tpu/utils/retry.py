@@ -90,6 +90,11 @@ def retry(
         except BaseException as e:
             if k == attempts - 1 or not _should_retry(e, retry_on):
                 raise
+            # observability: every re-attempt is counted (lazy import —
+            # this module must stay importable standalone)
+            from ..obs import metrics as _obs_metrics
+
+            _obs_metrics.registry().counter("retry/attempts").inc()
             if on_retry is not None:
                 on_retry(e, k)
             if backoff > 0:

@@ -36,10 +36,16 @@ class Timers:
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        # the legacy printim-style timers double as obs spans: a CLI
+        # run under PMMGTPU_TRACE gets its top-level phases in the
+        # same Perfetto timeline as the driver's internal spans
+        from ..obs import trace as obs_trace
+
         t0 = time.perf_counter()
         self._depth += 1
         try:
-            yield
+            with obs_trace.get_tracer().span(f"timer:{name}"):
+                yield
         finally:
             self._depth -= 1
             dt = time.perf_counter() - t0

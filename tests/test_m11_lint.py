@@ -298,6 +298,63 @@ def test_pml009_arange_with_dtype_clean(tmp_path):
     assert "PML009" not in rule_ids(out)
 
 
+# --- PML010 host clock under trace ---------------------------------------
+
+
+def test_pml010_host_clock_fires(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    import time
+
+    @jax.jit
+    def f(x):
+        t0 = time.perf_counter()
+        return jnp.sum(x) + 0 * t0
+    """)
+    assert "PML010" in rule_ids(out)
+
+
+def test_pml010_time_time_via_helper_fires(tmp_path):
+    # interprocedural: a helper REACHED from a jit entry point is
+    # jit-reachable code too
+    out = lint(tmp_path, HEADER + """
+    import time
+
+    def helper(x):
+        return jnp.sum(x) * time.time()
+
+    @jax.jit
+    def f(x):
+        return helper(x)
+    """)
+    assert "PML010" in rule_ids(out)
+
+
+def test_pml010_host_code_clean(tmp_path):
+    # host-side timing (bench loops, tools) is exactly where host
+    # clocks belong — no finding outside jit-reachable code
+    out = lint(tmp_path, HEADER + """
+    import time
+
+    def bench(fn, x):
+        t0 = time.perf_counter()
+        fn(x)
+        return time.perf_counter() - t0
+    """)
+    assert "PML010" not in rule_ids(out)
+
+
+def test_pml010_suppressible(tmp_path):
+    out = lint(tmp_path, HEADER + """
+    import time
+
+    @jax.jit
+    def f(x):
+        t0 = time.time()  # parmmg-lint: disable=PML010
+        return jnp.sum(x) + 0 * t0
+    """)
+    assert "PML010" not in rule_ids(out)
+
+
 # --- suppressions --------------------------------------------------------
 
 

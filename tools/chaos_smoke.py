@@ -160,6 +160,33 @@ def gen_schedule(rng: random.Random):
     return ",".join(faults), terminal, trajectory, rng.random() < 0.5
 
 
+def _timeline_kinds(obs_dir: str):
+    """(exists, injected-fault kinds) of a seed's JSONL timeline. The
+    parent must stay jax-free, so the lines are parsed with stdlib
+    json rather than through parmmg_tpu.obs.report."""
+    import glob
+    import json as _json
+
+    paths = glob.glob(os.path.join(obs_dir, "events_rank*.jsonl"))
+    kinds = []
+    n_lines = 0
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = _json.loads(line)
+                except _json.JSONDecodeError:
+                    continue
+                n_lines += 1
+                if rec.get("type") == "event" \
+                        and rec.get("name") == "fault_injected":
+                    kinds.append(rec.get("args", {}).get("kind"))
+    return bool(paths) and n_lines > 0, kinds
+
+
 def _run(ckdir: str, log: str, env_extra: dict) -> int:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -169,6 +196,11 @@ def _run(ckdir: str, log: str, env_extra: dict) -> int:
         # fast backoff so ioerror retries don't stretch the stage
         PMMGTPU_CKPT_TIMEOUT="2",
         PMMGTPU_CKPT_BACKOFF="0.01",
+        # every chaos run leaves a JSONL event timeline next to its
+        # log (the tracer is armed via the env contract) — the
+        # failure sequence is reconstructable post-mortem even for a
+        # hard-killed worker
+        PMMGTPU_TRACE=ckdir + "_obs",
     )
     env.update(env_extra)
     with open(log, "w") as lf:
@@ -234,6 +266,19 @@ def main() -> int:
             if "Traceback (most recent call last)" in text:
                 failures.append(
                     f"{label}: untyped traceback: …{text[-1500:]}"
+                )
+                continue
+            # every seed leaves a JSONL event timeline next to its log,
+            # and a terminal fault must be IN it — the per-line flush
+            # guarantee holds even through the worker's os._exit
+            has_tl, kinds = _timeline_kinds(ck + "_obs")
+            if not has_tl:
+                failures.append(f"{label}: no obs timeline written")
+                continue
+            if rc == KILL and terminal and terminal not in kinds:
+                failures.append(
+                    f"{label}: terminal fault {terminal!r} missing "
+                    f"from the obs timeline (saw {kinds})"
                 )
                 continue
             if rc == 0:

@@ -42,6 +42,14 @@ rc=$?
 echo "## chaos-smoke rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
+# observability smoke: one tiny traced run must yield a structurally
+# valid Chrome trace + JSONL timeline, exact op counters, and a
+# parseable obs_report — the never-go-blind gate for the perf arc
+timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
+rc=$?
+echo "## obs-smoke rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -65,11 +65,23 @@ from parmmg_tpu import failsafe  # noqa: E402
 from parmmg_tpu.core.tags import ReturnStatus  # noqa: E402
 from parmmg_tpu.io import medit  # noqa: E402
 from parmmg_tpu.models.adapt import AdaptOptions, adapt  # noqa: E402
+from parmmg_tpu.obs import trace as obs_trace  # noqa: E402
+from parmmg_tpu.obs.report import load_timeline  # noqa: E402
 from parmmg_tpu.utils.conformity import check_mesh  # noqa: E402
 from parmmg_tpu.utils.gen import unit_cube_mesh  # noqa: E402
 
 OPTS = dict(hsiz=0.35, niter=2, max_sweeps=4, hgrad=None,
             polish_sweeps=0)
+
+
+def _fault_kinds(obs_dir):
+    """Injected-fault kinds present in a trace directory's JSONL
+    timeline (what every chaos seed must leave next to its log)."""
+    return [
+        r.get("args", {}).get("kind")
+        for r in load_timeline(obs_dir)
+        if r.get("type") == "event" and r.get("name") == "fault_injected"
+    ]
 
 
 def _key(mesh, info):
@@ -92,15 +104,24 @@ def main() -> int:
     tmp = tempfile.mkdtemp(prefix="parmmg_fault_smoke_")
     try:
         # --- scenario 1: NaN -> LOWFAILURE + conformal + saveable -----
+        # run under an explicit tracer: the injected fault and the
+        # rollback that absorbed it must land in the JSONL timeline
+        obs_nan = os.path.join(tmp, "obs_nan")
         out, info = adapt(
             unit_cube_mesh(3),
             AdaptOptions(faults="it1:remesh:nan", **OPTS),
+            tracer=obs_trace.Tracer(obs_nan),
         )
         assert info["status"] == ReturnStatus.LOWFAILURE, info["status"]
         assert any("failure" in r for r in info["history"])
         assert check_mesh(out, check_boundary=False).ok
         medit.save_mesh(out, os.path.join(tmp, "nan.mesh"))
-        print("[fault-smoke] nan: LOWFAILURE + conformal + saved OK")
+        assert "nan" in _fault_kinds(obs_nan), _fault_kinds(obs_nan)
+        assert any(
+            r.get("name") == "rollback" for r in load_timeline(obs_nan)
+        ), "rollback missing from the event timeline"
+        print("[fault-smoke] nan: LOWFAILURE + conformal + saved OK "
+              "(+ fault/rollback events in the obs timeline)")
 
         # --- scenario 2: overflow -> grow-and-retry SUCCESS -----------
         out, info = adapt(
@@ -114,7 +135,9 @@ def main() -> int:
         # --- scenario 3: kill + resume --------------------------------
         ref, ref_info = adapt(unit_cube_mesh(3), AdaptOptions(**OPTS))
         ckdir = os.path.join(tmp, "ckpt")
-        env = dict(os.environ, PARMMG_FAULTS="it0:post:kill")
+        obs_kill = os.path.join(tmp, "obs_kill")
+        env = dict(os.environ, PARMMG_FAULTS="it0:post:kill",
+                   PMMGTPU_TRACE=obs_kill)
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--worker", ckdir],
             env=env, capture_output=True, text=True, timeout=1500,
@@ -125,6 +148,13 @@ def main() -> int:
         assert not [f for f in os.listdir(ckdir) if ".tmp." in f], (
             "atomic write left temp files behind"
         )
+        # the per-line JSONL flush must survive the worker's os._exit:
+        # the kill is IN the timeline even though flush() never ran
+        assert "kill" in _fault_kinds(obs_kill), _fault_kinds(obs_kill)
+        assert any(
+            r.get("name") == "checkpoint_commit"
+            for r in load_timeline(obs_kill)
+        ), "checkpoint commit missing from the killed worker's timeline"
         res, res_info = adapt(
             unit_cube_mesh(3), AdaptOptions(**OPTS), checkpoint_dir=ckdir
         )
@@ -132,7 +162,8 @@ def main() -> int:
             _key(res, res_info), _key(ref, ref_info),
         )
         print("[fault-smoke] kill/resume: resumed run matches "
-              "uninterrupted run")
+              "uninterrupted run (kill + ckpt commit in the obs "
+              "timeline)")
         return 0
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -1,0 +1,54 @@
+"""Render a run report from a trace directory (parmmg_tpu.obs).
+
+Usage:
+  python tools/obs_report.py <trace-dir>            # text report
+  python tools/obs_report.py <trace-dir> --json 1   # structured JSON
+  python tools/obs_report.py <trace-dir> --merge-metrics out.json
+                                  # one world metrics doc from the
+                                  # per-rank metrics_rank*.json files
+
+The trace directory is what a run under ``PMMGTPU_TRACE=<dir>`` (or an
+explicit ``tracer=Tracer(dir)``) leaves behind: ``trace_rank<r>.json``
+(Chrome trace events — load in Perfetto / chrome://tracing for the
+timeline view, alongside any ``profile/`` device capture),
+``events_rank<r>.jsonl`` (the durable line log, complete even after an
+``os._exit`` death) and ``metrics_rank<r>.json``. Pure stdlib + host
+code: never touches the accelerator.
+"""
+
+import json
+import sys
+
+from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
+
+from parmmg_tpu.obs import metrics as obs_metrics
+from parmmg_tpu.obs import report as obs_report
+
+
+def main():
+    pos, flags = parse_argv(sys.argv[1:])
+    if not pos:
+        print(__doc__)
+        return 2
+    trace_dir = pos[0]
+    if "merge-metrics" in flags:
+        merged = obs_metrics.merge_dir(trace_dir)
+        if merged is None:
+            print(f"no metrics_rank*.json under {trace_dir}",
+                  file=sys.stderr)
+            return 1
+        with open(flags["merge-metrics"], "w") as f:
+            json.dump(merged, f, indent=1)
+        print(f"merged {merged['world']} rank doc(s) -> "
+              f"{flags['merge-metrics']}")
+        return 0
+    if flags.get("json", "") not in ("", "0"):
+        print(json.dumps(obs_report.summarize(trace_dir), indent=1,
+                         default=str))
+        return 0
+    print(obs_report.render(trace_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Observability smoke for the CI gate (tools/check.sh, between the
+chaos stage and tier-1).
+
+One tiny traced adapt run on the hermetic CPU harness, then the
+contract checks of the obs subsystem end to end:
+
+1. the trace directory holds a structurally valid Chrome trace JSON
+   (loads via ``json``, every event carries name/ph/ts/pid/tid, at
+   least one complete "X" span with a duration) and a JSONL line log;
+2. span counts are nonzero and the span tree contains the driver's
+   root + phase + sweep spans;
+3. the metrics registry recorded the run (ops counters == the
+   driver-reported history totals) and its per-rank file merges;
+4. `tools/obs_report.py`'s renderer parses the directory and the
+   report names the phase table and operator counts.
+
+Exit 0 = the observability surface is live; any mismatch fails the
+gate — the perf arc must never go blind again.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+for _accel in ("axon", "tpu", "cuda", "rocm"):
+    _xb._backend_factories.pop(_accel, None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from parmmg_tpu.obs import metrics as obs_metrics  # noqa: E402
+from parmmg_tpu.obs import report as obs_report  # noqa: E402
+from parmmg_tpu.obs import trace as obs_trace  # noqa: E402
+from parmmg_tpu.models.adapt import AdaptOptions, adapt  # noqa: E402
+from parmmg_tpu.utils.gen import unit_cube_mesh  # noqa: E402
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="parmmg_obs_smoke_")
+    try:
+        tr = obs_trace.Tracer(tmp)
+        obs_metrics.registry().reset()
+        out, info = adapt(
+            unit_cube_mesh(2),
+            AdaptOptions(hsiz=0.5, niter=1, max_sweeps=3, hgrad=None,
+                         polish_sweeps=0),
+            tracer=tr,
+        )
+
+        # 1. Chrome trace JSON validity
+        path = os.path.join(tmp, "trace_rank0.json")
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc["traceEvents"]
+        spans = [e for e in events if e.get("ph") == "X"]
+        assert spans, "no complete spans in the Chrome trace"
+        for e in events:
+            for key in ("name", "ph", "pid", "tid"):
+                assert key in e, (key, e)
+            if e["ph"] != "M":   # metadata events carry no timestamp
+                assert "ts" in e, e
+        for e in spans:
+            assert "dur" in e and e["dur"] >= 0, e
+        assert os.path.exists(
+            os.path.join(tmp, "events_rank0.jsonl")
+        ), "no JSONL event log"
+        print(f"[obs-smoke] chrome trace valid: {len(spans)} spans, "
+              f"{len(events)} events")
+
+        # 2. the span tree covers the driver structure
+        names = {e["name"] for e in spans}
+        for want in ("adapt", "phase:sweeps", "iteration"):
+            assert want in names, (want, sorted(names))
+        print("[obs-smoke] span tree contains root/phase/iteration")
+
+        # 3. counter exactness vs the driver history
+        reg = obs_metrics.registry()
+        hist = [r for r in info["history"] if "nsplit" in r]
+        for key, col in (("ops/split_accepted", "nsplit"),
+                         ("ops/collapse_accepted", "ncollapse"),
+                         ("ops/swap_accepted", "nswap")):
+            want = sum(r[col] for r in hist)
+            got = reg.counter(key).value
+            assert got == want, (key, got, want)
+        merged = obs_metrics.merge_dir(tmp)
+        assert merged is not None and merged["world"] == 1
+        assert merged["counters"]["sweeps"] == len(hist)
+        print(f"[obs-smoke] counters exact over {len(hist)} sweeps; "
+              "rank merge OK")
+
+        # 4. the report renders
+        text = obs_report.render(tmp)
+        assert "phase breakdown" in text and "operators" in text
+        assert "adapt" in text
+        print("[obs-smoke] obs_report renders the run")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,11 +22,41 @@ Usage: python tools/scale_run.py [n] [hsiz] [--stall S] [--retries R]
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
+
+
+def partial_record(n, hsiz, died_in="startup", reason="stage deadline"):
+    """Committed-partial record for a stage that hit its time budget —
+    same shape as the full record, explicitly marked, naming the phase
+    the budget died in (the never-blind bench-ladder contract; closes
+    the BENCH_r03/r04 rc=124-with-nothing gap)."""
+    return {
+        "metric": "tets_per_sec_cold", "value": 0.0, "unit": "tet/s",
+        "includes_compile": True, "partial": True,
+        "stage": f"n{n}-hsiz{hsiz}", "died_in": died_in, "error": reason,
+    }
+
+
+def _arm_stage_deadline(on_expire):
+    """SIGALRM per the PARMMG_STAGE_BUDGET_S env contract (set by
+    tools/xl_stage.sh under each stage watchdog): fires `on_expire` at
+    the next Python-level checkpoint, well before the outer timeout's
+    SIGKILL — the worker commits its own partial record with the phase
+    context only it has."""
+    budget = os.environ.get("PARMMG_STAGE_BUDGET_S")
+    if not budget:
+        return
+
+    def _on_alarm(signum, frame):
+        on_expire()
+
+    signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(max(int(float(budget)), 1))
 
 
 def _parse_budgets(spec):
@@ -73,8 +103,25 @@ def worker(n, hsiz, tight=False):
     # steady_recompiles==0 contract lives in bench.py's in-process
     # steady phase). Unset = counts recorded in the JSON, not enforced.
     budgets = _parse_budgets(os.environ.get("PARMMG_RETRACE_BUDGETS"))
+    from parmmg_tpu.lint.contracts import RetraceCounter
+
+    counter = RetraceCounter()
+
+    def _expire():
+        # the partial record is printed FROM the signal handler: a
+        # deadline mid-sweep must still commit a parseable line before
+        # the stage watchdog's kill (value 0.0, explicitly partial)
+        print(json.dumps(partial_record(
+            n, hsiz, died_in=counter._phase,
+            reason="PARMMG_STAGE_BUDGET_S expired",
+        )), flush=True)
+        os._exit(3)
+
+    _arm_stage_deadline(_expire)
     t0 = time.perf_counter()
-    out, info = run_adapt_with_budget(mesh, opts, budgets=budgets)
+    out, info = run_adapt_with_budget(mesh, opts, budgets=budgets,
+                                      counter=counter)
+    signal.alarm(0)
     wall = time.perf_counter() - t0
     ne = int(out.ntet)
     h = quality.quality_histogram(out)
@@ -164,9 +211,25 @@ def main():
     stall = int(flags.get("stall", 1500))
     retries = int(flags.get("retries", 6))
     tight = flags.get("tight", "") not in ("", "0")
+    bench_json = flags.get("bench-json")
     rec = drive(n, hsiz, stall, retries, tight=tight)
     if rec is None:
-        print("## all attempts stalled", flush=True)
+        # all retries stalled without even a worker-side partial: the
+        # driver commits the partial record itself — the ladder's
+        # trajectory is never blind, whatever killed the workers
+        rec = partial_record(
+            n, hsiz, died_in="worker",
+            reason=f"all {retries} attempts stalled (no output for "
+                   f"{stall}s each)",
+        )
+        print(json.dumps(rec), flush=True)
+    if bench_json:
+        tmp = bench_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, bench_json)
+        print(f"## bench_json={bench_json}", flush=True)
+    if rec.get("partial"):
         sys.exit(1)
 
 

@@ -24,14 +24,24 @@ cd /root/repo || exit 1
 run_stage() {
     # run_stage <name> <timeout_s> <cmd...>: stage under a watchdog;
     # echoes the rc line the ladder logs key off and returns the rc.
+    # PARMMG_STAGE_BUDGET_S (the obs never-blind contract) is exported
+    # just under the outer timeout, so the python tools commit a
+    # PARTIAL BENCH JSON (marked "partial": true with the phase the
+    # budget died in) before the watchdog's SIGKILL can silence them —
+    # rc 124 now means "the partial record is the result", not "the
+    # trajectory is blind".
     local name=$1 tmo=$2 rc
     shift 2
-    timeout -k 30 "$tmo" "$@"
+    env PARMMG_STAGE_BUDGET_S=$((tmo - 300)) timeout -k 30 "$tmo" "$@"
     rc=$?
     if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
         echo "## stage $name rc=$rc (watchdog timeout after ${tmo}s)"
     else
         echo "## stage $name rc=$rc"
+    fi
+    if [ -n "${STAGE_JSON:-}" ] && [ -f "$STAGE_JSON" ]; then
+        # the committed (full or partial) record path, per stage
+        echo "## stage $name bench_json=$STAGE_JSON"
     fi
     return "$rc"
 }
@@ -54,8 +64,12 @@ run_stage rest 11700 \
 # sweep-phase compiles means something retraces per sweep — fail loudly
 # via lint.contracts.run_adapt_with_budget instead of recording a
 # silently-livelocked number.
-# watchdog: 2700 s stall x (1 + 4 retries) + slack
-run_stage run 15300 \
+# watchdog: 2700 s stall x (1 + 4 retries) + slack. The stage always
+# commits its record to BENCH_xl_run.json — a full measurement or a
+# "partial": true marker naming where the budget died (scale_run's
+# PARMMG_STAGE_BUDGET_S deadline + all-stalled fallback).
+STAGE_JSON=BENCH_xl_run.json run_stage run 15300 \
     env PARMMG_RETRACE_BUDGETS="sweeps=64" \
-    python tools/scale_run.py 16 0.02 --tight 1 --stall 2700 --retries 4
+    python tools/scale_run.py 16 0.02 --tight 1 --stall 2700 --retries 4 \
+        --bench-json BENCH_xl_run.json
 exit $?
