@@ -199,6 +199,68 @@ def _enable_compile_cache():
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 1)
 
 
+def measure_converged_sweep(out, reps=3):
+    """Converged-sweep cost probe (rounds 6/8): on an adapted
+    (converged) mesh, time one full-table sweep against one
+    empty-frontier sweep over clean tables — the cost of a no-op
+    verification sweep under active-set scheduling vs the legacy
+    full-capacity cost. This is the number the adapt-vs-distributed
+    parity check compares (same probe as tools/phase_times.py, shared
+    here so every BENCH JSON carries it). Returns
+    {"full_s", "frontier_s", "ratio"} in seconds."""
+    import functools
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from parmmg_tpu.core import adjacency as adj
+    from parmmg_tpu.core.mesh import compact
+    from parmmg_tpu.models.adapt import (
+        UNFUSED_TCAP, Frontier, _sweep_body, remesh_sweep,
+    )
+
+    mesh = compact(out)
+    ecap = int(mesh.tcap * 1.6) + 64
+    edges, emask, t2e, nu = adj.unique_edges(mesh, ecap)
+    mesh = adj.build_adjacency(mesh)
+    fr = Frontier(
+        changed=jnp.zeros(mesh.pcap, bool),
+        dirty=jnp.int32(0),
+        tables=(edges, emask, t2e, jnp.asarray(nu, jnp.int32)),
+        adja_ok=jnp.bool_(True),
+    )
+    # above the compile-budget threshold the fused whole-sweep program
+    # must not be built for a probe — dispatch per-op, and copy the
+    # input per call because the unfused op kernels donate their
+    # buffers (the copy is linear and small against sweeps this size)
+    unfused = mesh.tcap > UNFUSED_TCAP
+    if unfused:
+        body = functools.partial(_sweep_body, fused=False)
+
+        def call(**kw):
+            m = jax.tree_util.tree_map(jnp.copy, mesh)
+            return body(m, ecap, phase_skip=False, **kw)
+    else:
+        def call(**kw):
+            return remesh_sweep(mesh, ecap, phase_skip=False, **kw)
+
+    def timed(fn):
+        fn()  # compile + warm
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(jax.tree_util.tree_leaves(fn())[0])
+        return (_time.perf_counter() - t0) / reps
+
+    t_full = timed(lambda: call())
+    t_fr = timed(lambda: call(frontier=fr))
+    return {
+        "full_s": round(t_full, 6),
+        "frontier_s": round(t_fr, 6),
+        "ratio": round(t_full / max(t_fr, 1e-9), 2),
+    }
+
+
 def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         tight=False):
     import jax
@@ -269,6 +331,7 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         round(r["n_active"] / max(r["n_unique"], 1), 4)
         for r in info["history"] if "n_active" in r
     ]
+    _note_phase("converged-probe")
     return {
         "metric": "tets_per_sec",
         "value": round(tps, 1),
@@ -282,10 +345,108 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         "recompiles": dict(counter.counts),
         "steady_recompiles": steady_misses,
         "sweep_active_fraction": saf,
+        # cost of one converged (no-op) sweep, full-table vs drained
+        # frontier — the centralized half of the adapt-vs-distributed
+        # parity check (run_dist records the distributed half)
+        "converged_sweep_cost": measure_converged_sweep(out),
         # checkpoint wall time hidden behind compute by the async
         # staging writer (0.0 when the run checkpoints synchronously or
         # not at all — see PARMMG_BENCH_CKPT above)
         "ckpt_overlap_s": float(info.get("ckpt_overlap_s", 0.0)),
+    }
+
+
+def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
+             anchor=CPU_ANCHOR_TPS, frontier=True):
+    """Distributed-driver bench: warmup + timed `adapt_distributed`
+    with active-set sweeps, recording the per-sweep
+    `sweep_active_fraction` series and the converged-sweep cost parity
+    triple — distributed full-table vs distributed drained-frontier vs
+    the CENTRALIZED frontier probe on the merged mesh at the same tet
+    count. `frontier=False` is the A/B baseline (CLI -nofrontier)."""
+    import dataclasses
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_distributed, merge_adapted, remesh_phase,
+    )
+    from parmmg_tpu.ops import quality
+
+    _enable_compile_cache()
+    opts = DistOptions(
+        niter=niter, hsiz=hsiz, max_sweeps=max_sweeps, hgrad=None,
+        nparts=nparts, min_shard_elts=16, frontier=frontier,
+    )
+    _note_phase("dist-warmup")
+    adapt_distributed(_workload(n, hsiz), opts)
+    _note_phase("dist-steady")
+    t0 = time.perf_counter()
+    st, comm, info = adapt_distributed(_workload(n, hsiz), opts)
+    wall = time.perf_counter() - t0
+    merged = merge_adapted(st, comm)
+    ne = int(merged.ntet)
+    h = quality.quality_histogram(merged)
+    saf = [
+        r.get("active_fraction",
+              r.get("n_active", 0) / max(r.get("n_unique", 1), 1))
+        for r in info["history"] if "n_unique" in r
+    ]
+
+    _note_phase("dist-converged-probe")
+    # distributed converged-iteration cost: one full-table sweep on the
+    # converged stacked mesh (the legacy per-iteration floor) vs the
+    # drained-frontier skip path
+    hist: list = []
+    probe_opts = dataclasses.replace(opts, frontier=False, verbose=0)
+    hausd = 0.01
+
+    def timed(fn, reps=2):
+        fn()
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (_time.perf_counter() - t0) / reps
+
+    t_full = timed(lambda: remesh_phase(
+        st, probe_opts, [1.6], hist, 0, hausd
+    ))
+    fr_opts = dataclasses.replace(opts, frontier=True, verbose=0)
+    drained = jnp.zeros((st.vert.shape[0], st.vert.shape[1]), bool)
+    t_fr = timed(lambda: remesh_phase(
+        st, fr_opts, [1.6], hist, 0, hausd, fr0=drained
+    ))
+    central = measure_converged_sweep(merged)
+    return {
+        "metric": "tets_per_sec_distributed",
+        "value": round(ne / wall, 1),
+        "unit": "tet/s",
+        "vs_baseline": round(ne / wall / anchor, 3),
+        "ne": ne,
+        "nparts": nparts,
+        "frontier": bool(frontier),
+        "wall_s": round(wall, 2),
+        "platform": jax.devices()[0].platform,
+        "qmin": round(float(h.qmin), 5),
+        "qavg": round(float(h.qavg), 5),
+        "sweep_active_fraction": [round(x, 4) for x in saf],
+        # the acceptance triple: dist frontier must be within 1.5x of
+        # the centralized frontier sweep at equal tet count (was ~10x
+        # full-table)
+        "converged_sweep_cost": {
+            "dist_full_s": round(t_full, 6),
+            "dist_frontier_s": round(t_fr, 6),
+            "central_frontier_s": central["frontier_s"],
+            "central_full_s": central["full_s"],
+            "dist_vs_central_frontier": round(
+                t_fr / max(central["frontier_s"], 1e-9), 3
+            ),
+            "dist_full_vs_frontier": round(
+                t_full / max(t_fr, 1e-9), 2
+            ),
+        },
     }
 
 
@@ -356,7 +517,7 @@ def main():
         cfg = json.loads(sys.argv[-1])
         _arm_stage_deadline()
         try:
-            rec = run(**cfg)
+            rec = run_dist(**cfg) if cfg.pop("dist", False) else run(**cfg)
         except StageDeadline as e:
             rec = partial_record(cfg, reason=str(e))
         signal.alarm(0)
@@ -457,6 +618,18 @@ def main():
         # one failed rung doesn't preclude a LARGER warm one (cache
         # warming targets the scale rungs first); budget still gates
         fails = 1
+
+    # distributed-frontier rung (round 8): the adapt-vs-distributed
+    # converged-sweep parity record — small workload (compile cost
+    # dominates the distributed driver), admitted only with budget
+    # to spare; its line is additional, never replaces the headline
+    tmo = remaining()
+    if tmo > 240:
+        drec = _attempt(
+            dict(dist=True, n=8, hsiz=0.08, nparts=2), min(900, tmo)
+        )
+        if drec is not None:
+            print(json.dumps(drec), flush=True)
 
 
 if __name__ == "__main__":

@@ -69,6 +69,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-noinsert", action="store_true")
     p.add_argument("-noswap", action="store_true")
     p.add_argument("-nomove", action="store_true")
+    p.add_argument("-nofrontier", dest="nofrontier", action="store_true",
+                   help="disable active-set (frontier) sweeps: full-table "
+                        "candidate generation every sweep on every driver "
+                        "(the A/B baseline for the frontier speedup; "
+                        "frontier sweeps are exact-fallback-guarded and on "
+                        "by default, distributed included)")
     p.add_argument("-nosurf", action="store_true",
                    help="freeze the boundary surface exactly")
     p.add_argument("-opnbdy", action="store_true",
@@ -196,6 +202,7 @@ def main(argv=None) -> int:
         nobalancing=args.nobalancing,
         ifc_layers=args.ifc_layers,
         grps_ratio=args.grps_ratio,
+        frontier=not args.nofrontier,
     )
     if args.ckpt:
         # durable checkpoint/resume (failsafe layer): a path selects

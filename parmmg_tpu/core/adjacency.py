@@ -131,7 +131,7 @@ def boundary_faces(mesh: Mesh):
 
 
 # ---------------------------------------------------------------------------
-# incremental (frontier-compacted) rebuilds — round 6
+# incremental (frontier-compacted) rebuilds — rounds 6 and 8
 #
 # Both functions share one contract with the frontier sweeps
 # (models/adapt.py): the existing table was computed on the SAME
@@ -144,7 +144,10 @@ def boundary_faces(mesh: Mesh):
 # vertices of the modified side — so only those rows are recomputed,
 # gathered into a fixed-K compacted stream (static shape) and merged
 # into the previous table. Overflowing frontiers fall back to the full
-# rebuild via `lax.cond`, so the result is always exact.
+# rebuild via `lax.cond`, so the result is always exact. Round 8
+# generalized the edge-table path from append-only extension to a full
+# delta merge (`merge_unique_edges`: tombstone + slot reclamation), so
+# collapse/split/swap churn no longer forces the full re-sort.
 # ---------------------------------------------------------------------------
 
 
@@ -231,7 +234,7 @@ def update_adjacency(mesh: Mesh, changed_v: jax.Array, K: int) -> Mesh:
 
 # parmmg-lint: disable=PML005 -- table query/update only: the caller keeps using the mesh; the big tables are rebuilt functionally inside a lax.cond (donation would be dropped by the cond anyway)
 @partial(jax.jit, static_argnames=("K",))
-def append_unique_edges(
+def merge_unique_edges(
     mesh: Mesh,
     changed_v: jax.Array,
     edges: jax.Array,
@@ -240,20 +243,40 @@ def append_unique_edges(
     n_unique,
     K: int,
 ):
-    """Incrementally extend a `unique_edges` table after APPEND-ONLY
-    topology changes (tet rewrites/appends that never destroy an edge
-    and never renumber — exactly the 2-3 swap, which rewrites 2 tets,
-    appends 1, creates one apex edge and removes none).
+    """GENERAL incremental merge of a `unique_edges` table after
+    arbitrary topology deltas with STABLE numbering (no compaction since
+    the table was built): split bisections, collapse deletions and both
+    swap flavors may have rewritten, appended or killed tets in the hot
+    region, as long as `changed_v` covers every vertex of every tet row
+    created, deleted or rewritten since the build (the operators'
+    `changed_v` contract — see the module note above). Replaces the
+    former append-only extension (`append_unique_edges`), which bailed
+    to a full re-sort on any edge deletion.
 
-    Tets whose 4 vertices are all in `changed_v` (superset of the
-    modified rows, per the contract above) are gathered into a
-    K-compacted stream; their edges are matched against the existing
-    table, unmatched pairs become fresh slots appended at `n_unique`,
-    and only the hot tets' `t2e` rows are rewritten. Cold rows and all
-    existing edge slots are untouched — recomputing a hot-but-unmodified
-    tet reproduces its old slots by construction. Falls back to the full
-    re-sort when the frontier overflows K or the table overflows its
-    capacity. Returns (edges, emask, t2e, n_unique)."""
+    The delta is applied as tombstone + slot reclamation:
+
+      * hot tets (all 4 vertices in `changed_v`, live) are gathered into
+        a K-compacted stream and their 6 edges recomputed and matched
+        against the live table;
+      * every pre-existing edge slot is kept alive iff some live tet
+        still references it — cold live tets via their (unchanged) `t2e`
+        rows, hot tets via the fresh matches. A destroyed edge's slot is
+        tombstoned (`emask` cleared) in the same pass;
+      * unmatched hot pairs are deduplicated among themselves and each
+        representative takes a reclaimed (tombstoned or never-used)
+        slot, so tombstones never accumulate — the compaction is the
+        slot free-list itself and the table needs no separate cursor;
+      * dead tets' `t2e` rows are cleared; hot live rows are rewritten;
+        cold rows are untouched (their references cannot have changed).
+
+    Exactness: recomputing a hot-but-unmodified tet re-matches its old
+    slots, and an edge with any endpoint outside `changed_v` belongs
+    only to unmodified tets (a modified tet marks ALL its vertices), so
+    its slot keeps cold references and survives untouched. Falls back to
+    the exact full re-sort via `lax.cond` when the hot stream overflows
+    K or the worst-case fresh count could overflow the capacity.
+    Returns (edges, emask, t2e, n_unique) with `n_unique` = live edge
+    count (int32)."""
     from ..ops import common as _common
 
     tc = mesh.tcap
@@ -267,6 +290,18 @@ def append_unique_edges(
         return e, em, t2, jnp.asarray(nu, jnp.int32)
 
     def _incr(_):
+        # dead tets lose their rows; cold live rows are authoritative
+        cold = mesh.tmask & ~hot_t
+        t2e_base = jnp.where(mesh.tmask[:, None], t2e, -1)
+        # surviving references from OUTSIDE the hot region: one linear
+        # scatter-add over the cold rows (no sort — the whole point)
+        cold_idx = jnp.where(
+            cold[:, None] & (t2e >= 0), t2e, ecap
+        ).astype(jnp.int32)
+        cnt = jnp.zeros(ecap, jnp.int32).at[cold_idx.reshape(-1)].add(
+            1, mode="drop"
+        )
+        # K-compacted hot stream: recompute each hot tet's 6 edges
         rank = jnp.cumsum(hot_t.astype(jnp.int32)) - 1
         tgt = _common.unique_oob(hot_t & (rank < K), rank, K)
         tslot = jnp.full(K, -1, jnp.int32).at[tgt].set(
@@ -278,51 +313,68 @@ def append_unique_edges(
         lo = jnp.minimum(ev[..., 0], ev[..., 1]).reshape(-1)
         hi = jnp.maximum(ev[..., 0], ev[..., 1]).reshape(-1)
         live = jnp.broadcast_to(valid[:, None], (K, 6)).reshape(-1)
-        # slots already in the table (negative rows never match)
+        # match against the LIVE pre-merge slots (tombstoned/stale rows
+        # never match); a matched slot is referenced hot, so it survives
         q = jnp.stack(
             [jnp.where(live, lo, -1), jnp.where(live, hi, -1)], axis=1
         )
         old_keys = jnp.where(emask[:, None], edges, -1)
         eid = _common.match_rows(old_keys, q, bound=mesh.pcap)
+        matched = live & (eid >= 0)
+        cnt = cnt.at[jnp.where(matched, eid, ecap)].add(1, mode="drop")
+        # tombstone: a pre-existing slot lives iff still referenced
+        alive_old = emask & (cnt > 0)
+        # fresh pairs: dedup among themselves; live groups sort ahead of
+        # the shared dead sentinel, so their gids are dense
         fresh = live & (eid < 0)
-        # unique the fresh pairs among themselves; live groups sort
-        # ahead of the shared dead sentinel, so their gids are dense
         order, newgrp, live_s, slo, shi = _common.sorted_pair_groups(
             lo, hi, ~fresh, mesh.pcap
         )
         gid = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
         first = newgrp & live_s
-        n_new = jnp.sum(first.astype(jnp.int32))
-        new_slot_sorted = n_unique + gid
+        # reclaimed-slot map: slot_of[j] = the j-th free slot (free =
+        # tombstoned this merge or never used). The fallback predicate
+        # guarantees n_new <= free count, so every representative lands.
+        free = ~alive_old
+        free_pos = jnp.cumsum(free.astype(jnp.int32)) - 1
+        slot_of = jnp.full(ecap, ecap, jnp.int32).at[
+            _common.unique_oob(free, free_pos, ecap)
+        ].set(jnp.arange(ecap, dtype=jnp.int32), mode="drop",
+              unique_indices=True)
+        rep_slot = slot_of[jnp.clip(gid, 0, ecap - 1)]
         rep_tgt = _common.unique_oob(
-            first & (new_slot_sorted < ecap), new_slot_sorted, ecap
+            first & (rep_slot < ecap), rep_slot, ecap
         )
         kw = dict(mode="drop", unique_indices=True)
         edges_out = edges.at[rep_tgt, 0].set(slo.astype(jnp.int32), **kw)
         edges_out = edges_out.at[rep_tgt, 1].set(shi.astype(jnp.int32),
                                                  **kw)
-        emask_out = emask.at[rep_tgt].set(True, **kw)
-        # per-row final edge slot: matched -> old slot, fresh -> its
-        # group's appended slot (scatter sorted gids back to row order)
+        emask_out = alive_old.at[rep_tgt].set(True, **kw)
+        # per-row final edge slot: matched -> surviving old slot, fresh
+        # -> its group's reclaimed slot (sorted gids back to row order)
         gid_rows = jnp.zeros(K * 6, jnp.int32).at[order].set(
             gid, unique_indices=True
         )
-        eid_final = jnp.where(fresh, n_unique + gid_rows, eid)
         eid_final = jnp.where(
-            live & (eid_final < ecap), eid_final, -1
+            fresh, slot_of[jnp.clip(gid_rows, 0, ecap - 1)], eid
+        )
+        eid_final = jnp.where(
+            live & (eid_final >= 0) & (eid_final < ecap), eid_final, -1
         ).astype(jnp.int32)
         t2e_out = _common.scatter_rows(
-            t2e, _common.unique_oob(valid, tslot, tc),
+            t2e_base, _common.unique_oob(valid, tslot, tc),
             eid_final.reshape(K, 6), unique=True,
         )
         # int32 even under x64 (jnp.sum promotes): the frontier conds
         # demand identical branch dtypes against the stored int32 tables
-        return edges_out, emask_out, t2e_out, (
-            jnp.asarray(n_unique, jnp.int32) + n_new
+        return edges_out, emask_out, t2e_out, jnp.sum(
+            emask_out.astype(jnp.int32)
         ).astype(jnp.int32)
 
     n_hot = jnp.sum(hot_t.astype(jnp.int32))
-    # fresh-slot overflow bound: each hot tet appends at most 6 edges
+    # worst case each hot tet introduces 6 fresh edges; free slots are
+    # at least ecap - n_unique (live count), so this bound also covers
+    # the reclaimed-slot placement above
     fallback = (n_hot > K) | (
         jnp.asarray(n_unique, jnp.int32) + 6 * n_hot > ecap
     )

@@ -96,9 +96,12 @@ class AdaptOptions:
     # active-set (frontier) sweeps: each sweep records the vertices it
     # changed and the next sweep's candidate generation, analysis
     # rebuilds and apply phases address only entities near that
-    # frontier (round 6). False = full-table sweeps (the pre-frontier
-    # behavior, kept as the equivalence baseline; the distributed
-    # drivers always sweep full-table).
+    # frontier (round 6). Round 8 extended the carry through the
+    # distributed drivers too — per-shard frontier state through the
+    # vmapped/SPMD sweeps, remapped through migration so cells crossing
+    # a shard boundary arrive active on their new owner — so True is
+    # the default EVERYWHERE (CLI -nofrontier / False = full-table
+    # sweeps, the pre-frontier behavior kept as the A/B baseline).
     frontier: bool = True
     # --- fail-safe layer (parmmg_tpu.failsafe) ---------------------------
     # phase-boundary validation level: "off" | "basic" (device
@@ -167,14 +170,22 @@ class Frontier(NamedTuple):
     (geometry beyond smooth.MOVE_TOL, or 1-ring topology); each op gates
     on its one-ring closure, computed against the current topology.
     `dirty` is the staleness LEVEL of the compaction/edge tables:
-    0 = clean (reuse `tables` bit for bit), 1 = append-only topology
-    since the rebuild (2-3 swaps: no renumbering, no edge destroyed —
-    the tables are extended incrementally via
-    `adjacency.append_unique_edges`, no compaction), 2 = renumbering
-    topology (split/collapse/3-2 swap: full compact + re-sort).
-    `tables` is the (edges, emask, t2e, n_unique) tuple of the last
-    rebuild; `adja_ok` marks `mesh.adja` still valid for the CURRENT
-    numbering (lets a converged sweep skip `build_adjacency`)."""
+    0 = clean (reuse `tables` bit for bit), 1 = stable-numbering
+    topology deltas since the rebuild (2-3 swap appends, plus any
+    rewrites/tombstones that did not force a compaction — folded in by
+    the general `adjacency.merge_unique_edges`, no compaction),
+    2 = renumbering topology (a compaction with holes ran since the
+    rebuild, permuting tet rows: full compact + re-sort). `tables` is
+    the (edges, emask, t2e, n_unique) tuple of the last rebuild;
+    `adja_ok` marks `mesh.adja` still valid for the CURRENT numbering
+    (lets a converged sweep skip `build_adjacency`).
+
+    On the distributed paths every leaf gains a leading shard axis (see
+    `stacked_frontier`); `dirty`/`adja_ok` stay per-shard scalars under
+    `shard_map` (shard-varying cond skips — a converged shard stops
+    paying for its neighbors' work) and are host-shared conservative
+    scalars under the vmapped dispatch (where a batched predicate would
+    lower the skip to a both-branches select)."""
 
     changed: jax.Array      # [PC] bool
     dirty: jax.Array        # scalar int32 level (host int unfused)
@@ -194,6 +205,47 @@ def empty_frontier(mesh: Mesh, ecap: int, full: bool = True) -> Frontier:
         jnp.int32(0),
     )
     return Frontier(act, jnp.int32(2), tables, jnp.bool_(False))
+
+
+def stacked_frontier(
+    st: Mesh, ecap: int, changed=None, per_shard_state: bool = False,
+) -> Frontier:
+    """Stacked (leading shard axis) frontier for the distributed sweep
+    engines: per-shard changed masks (default all-active — the exact
+    full-sweep fallback) over stale tables.
+
+    `per_shard_state=True` makes `dirty`/`adja_ok` per-shard [D] arrays
+    (the SPMD `shard_map` layout, where each device branches on its own
+    staleness); the default keeps them shared scalars (the vmapped
+    layout — an unbatched predicate keeps the table conds real
+    conditionals instead of both-branches selects)."""
+    D, pc = st.vert.shape[0], st.vert.shape[1]
+    chg = (
+        jnp.ones((D, pc), bool) if changed is None
+        else jnp.asarray(changed, bool)
+    )
+    tables = (
+        jnp.zeros((D, ecap, 2), jnp.int32),
+        jnp.zeros((D, ecap), bool),
+        jnp.full((D, st.tet.shape[1], 6), -1, jnp.int32),
+        jnp.zeros((D,), jnp.int32),
+    )
+    if per_shard_state:
+        return Frontier(
+            chg, jnp.full((D,), 2, jnp.int32), tables,
+            jnp.zeros((D,), bool),
+        )
+    return Frontier(chg, jnp.int32(2), tables, jnp.bool_(False))
+
+
+def pad_changed(changed, pcap: int):
+    """Pad a stacked [D, PC_old] changed mask to a grown vertex capacity
+    (growth appends slots, so vertex ids are stable and the new tail is
+    inactive). Capacities never shrink (`Mesh.with_capacity`)."""
+    pad = pcap - changed.shape[1]
+    if pad > 0:
+        changed = jnp.pad(changed, ((0, 0), (0, pad)))
+    return changed
 
 
 def _sweep_body(
@@ -273,26 +325,28 @@ def _sweep_body(
         adja_ok = None
     else:
         act, dirty, tables_in, adja_ok = frontier
-        # append_unique_edges frontier-stream capacity: append-only
+        # merge_unique_edges frontier-stream capacity: stable-numbering
         # sweeps touch a few % of tets; tcap//4 gives the incremental
         # path a 4x-smaller sort with a fallback that stays exact
         k_edge = max(64, mesh.tcap // 4)
 
         def _entry_fresh(m, a):
-            # level 2: renumbering ops ran — compact and re-sort all
+            # level 2: a renumbering compaction ran — compact + re-sort
             m, a = compact_aux(m, a)
             e, em, t2, nu = adjacency.unique_edges(m, ecap)
             # int32 under x64 too: the reuse branch passes the stored
             # int32 tables and lax.cond demands identical branch types
             return m, a, e, em, t2, jnp.asarray(nu, jnp.int32), jnp.bool_(False)
 
-        def _entry_append(m, a):
-            # level 1: append-only ops (2-3 swaps) ran — the mesh is
-            # still prefix-packed and no edge was destroyed, so skip the
-            # compaction and extend the tables incrementally from the
-            # changed set (exact; overflow falls back to the full sort)
+        def _entry_merge(m, a):
+            # level 1: stable-numbering topology deltas (2-3 swap
+            # appends and rewrites) — the mesh is still prefix-packed,
+            # so skip the compaction and fold the delta into the cached
+            # tables with the general incremental merge (tombstone +
+            # slot reclamation; exact, overflow falls back to the full
+            # sort)
             e, em, t2, nu = tables_in
-            e, em, t2, nu = adjacency.append_unique_edges(
+            e, em, t2, nu = adjacency.merge_unique_edges(
                 m, a, e, em, t2, nu, K=k_edge
             )
             return m, a, e, em, t2, nu, jnp.asarray(adja_ok, bool)
@@ -304,7 +358,7 @@ def _sweep_body(
         if fused:
             def _entry_dirty(m, a):
                 return jax.lax.cond(
-                    dirty >= 2, _entry_fresh, _entry_append, m, a
+                    dirty >= 2, _entry_fresh, _entry_merge, m, a
                 )
 
             mesh, act, edges, emask, t2e, n_unique, adja_ok = jax.lax.cond(
@@ -314,7 +368,7 @@ def _sweep_body(
             lvl = _host_int(dirty)
             entry = (
                 _entry_fresh if lvl >= 2
-                else _entry_append if lvl >= 1
+                else _entry_merge if lvl >= 1
                 else _entry_reuse
             )
             mesh, act, edges, emask, t2e, n_unique, adja_ok = entry(
@@ -477,11 +531,17 @@ def _sweep_body(
                 adja_ok_out = jnp.bool_(True)
             nswap = s_32.nswap32 + s_23.nswap23
             if fr:
-                renum_tail = (
-                    (s_split.nsplit > 0) | (s_col.ncollapse > 0)
-                    | (s_32.nswap32 > 0)
-                )
-                append_tail = s_23.nswap23 > 0
+                # staleness of the EXIT tables (built at the latest of
+                # entry / post-split / post-collapse): only a 3-2 swap
+                # leaves tet holes, making the pre-swap23 compact a real
+                # row permutation that invalidates t2e (level 2). With
+                # no 3-2 swaps that compact is the identity (split
+                # appends packed, collapse was compacted in-sweep), so
+                # the 2-3 swap deltas are a stable-numbering merge
+                # (level 1) — the general merge_unique_edges absorbs
+                # them at the next entry without a full re-sort.
+                renum_tail = s_32.nswap32 > 0
+                merge_tail = s_23.nswap23 > 0
         else:
             # varying zero (not a literal): under shard_map the cond
             # branches must agree on varying-ness too
@@ -492,8 +552,12 @@ def _sweep_body(
                 if fr else None
             )
             if fr:
-                renum_tail = (s_split.nsplit > 0) | (s_col.ncollapse > 0)
-                append_tail = jnp.bool_(False)
+                # noswap: split/collapse deltas were folded into the
+                # in-sweep rebuilds, nothing renumbered since — the exit
+                # tables are current (varying False, shard_map
+                # discipline)
+                renum_tail = (s_col.ncollapse * 0) > 0
+                merge_tail = (s_col.ncollapse * 0) > 0
 
         if not nomove:
             g4 = _closure(mesh, av | chg) if fr else None
@@ -510,7 +574,7 @@ def _sweep_body(
         # branch output types
         dirty_tail = (
             jnp.where(
-                renum_tail, 2, jnp.where(append_tail, 1, 0)
+                renum_tail, 2, jnp.where(merge_tail, 1, 0)
             ).astype(jnp.int32)
             if fr else None
         )
@@ -525,8 +589,12 @@ def _sweep_body(
     adja_skip = (
         jnp.asarray(adja_ok, bool) & (s_split.nsplit == 0) if fr else None
     )
+    # the skipped tail leaves the POST-SPLIT tables (rebuilt inside the
+    # split phase when nsplit > 0, reused otherwise) — current either
+    # way, so the next entry reuses them instead of re-sorting (varying
+    # int32 zero, shard_map discipline)
     dirty_skip = (
-        jnp.where(s_split.nsplit > 0, 2, 0).astype(jnp.int32)
+        (s_split.nsplit * 0).astype(jnp.int32)
         if fr else None
     )
 

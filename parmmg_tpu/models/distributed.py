@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import adjacency, tags
-from ..core.mesh import Mesh, compact
+from ..core.mesh import Mesh, compact, compact_aux
 from ..failsafe import CapacityError
 from ..obs import metrics as obs_metrics, trace as obs_trace
 from ..ops import analysis, interp, quality
@@ -48,12 +48,15 @@ from ..parallel import partition as partition_mod
 from ..parallel.partition import sfc_partition
 from .adapt import (
     AdaptOptions,
+    Frontier,
     adapt as adapt_single,
     estimate_target_ntet,
+    pad_changed,
     prepare_metric,
     remesh_sweep,
     resolve_hausd,
     run_sweep_loop,
+    stacked_frontier,
 )
 
 
@@ -148,7 +151,8 @@ def ensure_capacity_stacked(st: Mesh, opts: AdaptOptions) -> Mesh:
 # stacked remesh phase (one outer iteration's operator sweeps)
 # ---------------------------------------------------------------------------
 
-def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions, hausd: float):
+def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions, hausd: float,
+            frontier: Optional[Frontier] = None):
     from .adapt import UNFUSED_TCAP, _sweep_body
 
     # same fused/unfused dispatch as the single-shard engine: above
@@ -161,15 +165,13 @@ def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions, hausd: float):
     total = st.tet.shape[0] * st.tet.shape[1]
     unfused = total > UNFUSED_TCAP
     body = _sweep_body if unfused else remesh_sweep
-    fn = partial(
-        body,
+    kw = dict(
         ecap=ecap,
         noinsert=opts.noinsert,
         noswap=opts.noswap,
         nomove=opts.nomove,
         nosurf=opts.nosurf,
         hausd=hausd,
-        fused=not unfused,
         # per-shard growth predicates are batched under vmap: the skip
         # would lower to select (both branches run) on the fused path
         # and is inexpressible on the unfused one — disabled so both
@@ -178,7 +180,24 @@ def _vsweep(st: Mesh, ecap: int, opts: AdaptOptions, hausd: float):
         # need the split phase and tail in separate vmapped calls)
         phase_skip=False,
     )
-    return jax.vmap(fn)(st)
+    if frontier is None:
+        return jax.vmap(partial(body, fused=not unfused, **kw))(st)
+    # frontier sweeps (round 8): `changed` and the cached tables are
+    # per-shard (batched), while `dirty`/`adja_ok` ride HOST-SHARED
+    # scalars (in_axes=None) — an unbatched predicate keeps the
+    # table-staleness lax.conds real conditionals under vmap instead of
+    # both-branches selects. fused=True on the unfused dispatch too:
+    # the frontier conds there wrap only table rebuilds
+    # (compact/unique_edges-class programs, which compile in seconds at
+    # any shape) while the operator kernels remain their own inner-jit
+    # compile boundaries under eager vmap.
+    fr_axes = Frontier(
+        changed=0, dirty=None, tables=(0, 0, 0, 0), adja_ok=None,
+    )
+    return jax.vmap(
+        lambda m, fr: body(m, fused=True, frontier=fr, **kw),
+        in_axes=(0, fr_axes),
+    )(st, frontier)
 
 
 def _use_spmd_sweeps() -> bool:
@@ -197,15 +216,45 @@ def _use_spmd_sweeps() -> bool:
 
 
 @lru_cache(maxsize=32)
-def _spmd_sweep_fn(dmesh, ecap, noinsert, noswap, nomove, nosurf):
+def _spmd_sweep_fn(dmesh, ecap, noinsert, noswap, nomove, nosurf,
+                   frontier=False):
     """One fused SPMD sweep program per (device mesh, capacity, flag)
     key. Memoized: building jit(shard_map(...)) inside `sweep_fn` made
     every sweep retrace from scratch (parmmg-lint PML004). `hausd` stays
     an OPERAND (replicated spec), not part of the key — it may be a
-    traced per-reference table from `local_hausd_table`."""
+    traced per-reference table from `local_hausd_table`.
+
+    With `frontier=True` the program additionally takes/returns a
+    per-shard `Frontier` (sharded like the mesh). Inside `shard_map`
+    every device runs its OWN program instance, so the frontier's
+    `dirty`/`adja_ok` scalars are shard-varying and the table-staleness
+    and no-candidate lax.conds branch PER DEVICE — a converged shard
+    genuinely skips the rebuild/apply work its neighbors still pay for
+    (the Omega_h compacted-candidate-stream discipline on the SPMD
+    path)."""
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.shard import AXIS, _squeeze, _unsqueeze
+
+    if frontier:
+        def body_fr(blk, hausd, frb):
+            m = _squeeze(blk)
+            fr = _squeeze(frb)
+            m, stats, fro = remesh_sweep(
+                m, ecap, noinsert=noinsert, noswap=noswap,
+                nomove=nomove, nosurf=nosurf, hausd=hausd,
+                fused=True, phase_skip=False, frontier=fr,
+            )
+            return (
+                _unsqueeze(m),
+                jax.tree_util.tree_map(lambda x: x[None], stats),
+                _unsqueeze(fro),
+            )
+
+        return jax.jit(jax.shard_map(
+            body_fr, mesh=dmesh, in_specs=(P(AXIS), P(), P(AXIS)),
+            out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+        ))
 
     def body(blk, hausd):
         m = _squeeze(blk)
@@ -224,10 +273,73 @@ def _spmd_sweep_fn(dmesh, ecap, noinsert, noswap, nomove, nosurf):
     ))
 
 
+def _rec_from_stats(s, stats) -> dict:
+    """One host history record from per-shard SweepStats (device arrays
+    on the vmapped path, gathered host numpy on the SPMD path):
+    cross-shard aggregates like the legacy recs, plus the active-set
+    telemetry — total candidates offered, the world active fraction and
+    the per-shard fractions `obs.metrics`/`tools/obs_report.py`
+    render."""
+    def g(x):
+        return np.asarray(jax.device_get(x))
+
+    na = g(stats.n_active).astype(np.int64)
+    nu = g(stats.n_unique).astype(np.int64)
+    return dict(
+        nsplit=int(g(stats.nsplit).sum()),
+        ncollapse=int(g(stats.ncollapse).sum()),
+        nswap=int(g(stats.nswap).sum()),
+        nmoved=int(g(stats.nmoved).sum()),
+        ne=int(g(s.tmask).sum()),
+        np=int(g(s.vmask).sum()),
+        n_unique=int(nu.max()),
+        capped=bool(g(stats.split_capped).any()),
+        n_active=int(na.sum()),
+        active_fraction=round(
+            float(na.sum()) / max(int(nu.sum()), 1), 6
+        ),
+        shard_active=[
+            round(float(a) / max(int(u), 1), 4)
+            for a, u in zip(na.tolist(), nu.tolist())
+        ],
+    )
+
+
+def _drained_rec(st: Mesh, history: List[dict]) -> dict:
+    """Synthetic zero-op record for a skipped (drained-frontier)
+    converged sweep — same keys as a real record so every consumer
+    (history sums, BENCH JSON series, `record_sweep`) stays uniform."""
+    D = st.vert.shape[0]
+    last_nu = 0
+    for r in reversed(history):
+        if r.get("n_unique"):
+            last_nu = int(r["n_unique"])
+            break
+    return dict(
+        nsplit=0, ncollapse=0, nswap=0, nmoved=0,
+        ne=int(jax.device_get(jnp.sum(st.tmask))),
+        np=int(jax.device_get(jnp.sum(st.vmask))),
+        n_unique=last_nu, capped=False, n_active=0,
+        active_fraction=0.0, shard_active=[0.0] * D,
+        skipped=True,
+    )
+
+
+def _frontier_stale(fr: Frontier, s: Mesh, ecap: int) -> bool:
+    """Capacity growth or an edge-cap (emult) event changed the table
+    shapes: the carried frontier must be re-seeded (changed masks
+    survive — growth pads, ids are stable — but tables restart stale)."""
+    return (
+        fr.changed.shape[1] != s.vert.shape[1]
+        or fr.tables[0].shape[1] != ecap
+        or fr.tables[2].shape[1] != s.tet.shape[1]
+    )
+
+
 def _remesh_phase_global(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
-    it: int, hausd, fs=None,
-) -> Mesh:
+    it: int, hausd, fs=None, fr0=None,
+):
     """Multi-process remesh phase: each sweep is ONE SPMD program over
     the global device mesh — with 2 processes owning 4 devices each, the
     per-shard sweeps execute on the devices of BOTH processes and any
@@ -237,7 +349,14 @@ def _remesh_phase_global(
     (capacity checks, convergence) is replicated-deterministic on every
     process, per the `parallel.multihost` contract: the stacked mesh is
     gathered back to host numpy after each sweep, so every other phase
-    of `_one_iteration` runs unchanged."""
+    of `_one_iteration` runs unchanged.
+
+    With `opts.frontier` the per-shard `Frontier` rides the sweep carry
+    DEVICE-RESIDENT (sharded like the mesh, never gathered between
+    sweeps); `dirty`/`adja_ok` are shard-varying, so each device's
+    staleness conds branch independently — a converged shard stops
+    paying for its neighbors' work. Returns (stacked, changed | None)
+    like `_remesh_phase_local`."""
     from ..parallel import multihost
     from ..parallel.shard import device_mesh
 
@@ -254,15 +373,46 @@ def _remesh_phase_global(
         # distribution of sweep COMPUTE across processes is then lost,
         # which is the documented trade until a per-op shard_map
         # dispatch exists.
-        return _remesh_phase_local(st, opts, emult, history, it, hausd)
+        return _remesh_phase_local(st, opts, emult, history, it, hausd,
+                                   fr0=fr0)
     dmesh = device_mesh(D)
+    use_fr = bool(opts.frontier)
+    fr_cell: list = [None]
+    wd = fs.watchdog if fs is not None else None
 
     def sweep_fn(s, ecap):
         sg = multihost.put_sharded_global(s, dmesh)
-        out, stats = _spmd_sweep_fn(
-            dmesh, ecap, opts.noinsert, opts.noswap, opts.nomove,
-            opts.nosurf,
-        )(sg, hausd)
+        if use_fr:
+            fr = fr_cell[0]
+            if fr is None or _frontier_stale(fr, s, ecap):
+                if fr is not None:
+                    # mid-loop growth: keep the changed masks (host
+                    # round trip only on the rare capacity event)
+                    chg = pad_changed(jnp.asarray(np.asarray(
+                        multihost.gather_stacked(fr.changed, timeout=wd)
+                    ), bool), s.vert.shape[1])
+                elif fr0 is not None:
+                    chg = pad_changed(
+                        jnp.asarray(fr0, bool), s.vert.shape[1]
+                    )
+                else:
+                    chg = None  # full frontier: exact full-table sweep
+                fr = multihost.put_sharded_global(
+                    stacked_frontier(
+                        s, ecap, changed=chg, per_shard_state=True
+                    ),
+                    dmesh,
+                )
+            out, stats, fro = _spmd_sweep_fn(
+                dmesh, ecap, opts.noinsert, opts.noswap, opts.nomove,
+                opts.nosurf, frontier=True,
+            )(sg, hausd, fr)
+            fr_cell[0] = fro
+        else:
+            out, stats = _spmd_sweep_fn(
+                dmesh, ecap, opts.noinsert, opts.noswap, opts.nomove,
+                opts.nosurf,
+            )(sg, hausd)
         if fs is not None:
             # device-resident validation (psum status inside the
             # shard_map): a poisoned shard is caught HERE, before its
@@ -270,69 +420,122 @@ def _remesh_phase_global(
             # validate="basic" costs one tiny device reduce, zero host
             # gathers of mesh arrays
             fs.validate_sharded(out, dmesh, it, phase="sweep")
-        wd = fs.watchdog if fs is not None else None
         s2 = multihost.gather_stacked(out, timeout=wd)
         stats = multihost.gather_stacked(stats, timeout=wd)
-        rec = dict(
-            nsplit=int(np.sum(stats.nsplit)),
-            ncollapse=int(np.sum(stats.ncollapse)),
-            nswap=int(np.sum(stats.nswap)),
-            nmoved=int(np.sum(stats.nmoved)),
-            ne=int(np.sum(s2.tmask)),
-            np=int(np.sum(s2.vmask)),
-            n_unique=int(np.max(stats.n_unique)),
-            capped=bool(np.any(stats.split_capped)),
-        )
-        return s2, rec
+        return s2, _rec_from_stats(s2, stats)
 
-    return run_sweep_loop(
+    st = run_sweep_loop(
         st, opts, emult, history, it,
         ensure_fn=lambda s: ensure_capacity_stacked(s, opts),
         tcap_fn=lambda s: int(s.tet.shape[1]),
         sweep_fn=sweep_fn,
     )
+    if not use_fr:
+        return st, None
+    if fr_cell[0] is not None:
+        chg = jnp.asarray(np.asarray(multihost.gather_stacked(
+            fr_cell[0].changed, timeout=wd
+        )), bool)
+    else:
+        chg = fr0 if fr0 is not None else jnp.ones(
+            (D, st.vert.shape[1]), bool
+        )
+    return st, pad_changed(jnp.asarray(chg, bool), st.vert.shape[1])
 
 
 def remesh_phase(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
-    it: int, hausd: float = 0.01, fs=None,
-) -> Mesh:
+    it: int, hausd: float = 0.01, fs=None, fr0=None,
+):
     """Operator sweeps to convergence on every shard at once (vmapped) —
     the batched analog of the per-group `MMG5_mmg3d1_delone` calls in the
     reference loop body (`src/libparmmg1.c:662-800`). Control flow is the
     shared `run_sweep_loop` engine with cross-shard-aggregated stats.
     `fs` (a FailsafeHarness) arms the device-resident per-sweep
-    validation on the SPMD path."""
+    validation on the SPMD path.
+
+    `fr0` (with `opts.frontier`) is the iteration's carried active-set:
+    per-shard [D, PC] bool vertex masks — what the previous iteration
+    changed, remapped through migration, plus the interface bands the
+    repartition unfroze. The first sweep gates on its one-ring closure
+    (None = all-active, the exact full-table fallback); a DRAINED carry
+    skips the sweep loop outright, because an empty-frontier sweep is
+    the identity (the converged no-op fast path the round-8 bench
+    measures). Returns (stacked, changed | None)."""
+    if opts.frontier and fr0 is not None:
+        n_act = int(jax.device_get(jnp.sum(fr0.astype(jnp.int32))))
+        if n_act == 0:
+            rec = _drained_rec(st, history)
+            rec.update(iter=it, sweep=0)
+            history.append(rec)
+            obs_metrics.record_sweep(rec)
+            if opts.verbose >= 2:
+                print(
+                    f"  it {it}: frontier drained — converged sweep "
+                    "skipped", flush=True,
+                )
+            return st, fr0
     if _use_spmd_sweeps():
         return _remesh_phase_global(st, opts, emult, history, it, hausd,
-                                    fs=fs)
-    return _remesh_phase_local(st, opts, emult, history, it, hausd)
+                                    fs=fs, fr0=fr0)
+    return _remesh_phase_local(st, opts, emult, history, it, hausd,
+                               fr0=fr0)
 
 
 def _remesh_phase_local(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
-    it: int, hausd,
-) -> Mesh:
-    def sweep_fn(s, ecap):
-        s, stats = _vsweep(s, ecap, opts, hausd)
-        rec = dict(
-            nsplit=int(jnp.sum(stats.nsplit)),
-            ncollapse=int(jnp.sum(stats.ncollapse)),
-            nswap=int(jnp.sum(stats.nswap)),
-            nmoved=int(jnp.sum(stats.nmoved)),
-            ne=int(jnp.sum(s.tmask)),
-            np=int(jnp.sum(s.vmask)),
-            n_unique=int(jnp.max(stats.n_unique)),
-            capped=bool(jnp.any(stats.split_capped)),
-        )
-        return s, rec
+    it: int, hausd, fr0=None,
+):
+    """Single-process (vmapped) remesh phase. With `opts.frontier` the
+    stacked Frontier is carried across sweeps with HOST-SHARED
+    `dirty`/`adja_ok` (conservative max/all over shards — a stricter
+    staleness level is always exact, and an unbatched predicate keeps
+    the table conds real conditionals under vmap). Returns
+    (stacked, changed | None)."""
+    use_fr = bool(opts.frontier)
+    fr_cell: list = [None]
 
-    return run_sweep_loop(
+    def sweep_fn(s, ecap):
+        if use_fr:
+            fr = fr_cell[0]
+            if fr is None or _frontier_stale(fr, s, ecap):
+                if fr is not None:
+                    chg = pad_changed(fr.changed, s.vert.shape[1])
+                elif fr0 is not None:
+                    chg = pad_changed(
+                        jnp.asarray(fr0, bool), s.vert.shape[1]
+                    )
+                else:
+                    chg = None  # full frontier: exact full-table sweep
+                fr = stacked_frontier(s, ecap, changed=chg)
+            s, stats, fro = _vsweep(s, ecap, opts, hausd, frontier=fr)
+            fr_cell[0] = fro._replace(
+                dirty=jnp.int32(
+                    int(jax.device_get(jnp.max(fro.dirty)))
+                ),
+                adja_ok=jnp.bool_(
+                    bool(jax.device_get(jnp.all(fro.adja_ok)))
+                ),
+            )
+        else:
+            s, stats = _vsweep(s, ecap, opts, hausd)
+        return s, _rec_from_stats(s, stats)
+
+    st = run_sweep_loop(
         st, opts, emult, history, it,
         ensure_fn=lambda s: ensure_capacity_stacked(s, opts),
         tcap_fn=lambda s: int(s.tet.shape[1]),
         sweep_fn=sweep_fn,
     )
+    if not use_fr:
+        return st, None
+    if fr_cell[0] is not None:
+        chg = fr_cell[0].changed
+    else:
+        chg = fr0 if fr0 is not None else jnp.ones(
+            (st.vert.shape[0], st.vert.shape[1]), bool
+        )
+    return st, pad_changed(jnp.asarray(chg, bool), st.vert.shape[1])
 
 
 def interp_phase(st: Mesh, old: Mesh,
@@ -426,9 +629,12 @@ def _elastic_recut(stacked: Mesh, opts: DistOptions) -> Mesh:
 def _resume_stacked(resume, opts: DistOptions):
     """Common driver-side handling of a distributed ResumeState:
     elastic re-cut when the checkpointed shard count differs from the
-    current layout (then the cached comm capacity is stale too)."""
+    current layout (then the cached comm capacity is stale too, and the
+    checkpointed frontier carry no longer maps onto the shards — it
+    restarts full). Returns (stacked, icap, fr0)."""
     stacked = resume.mesh
     icap = resume.meta.get("icap")
+    fr0 = resume.meta.get("aux_arrays", {}).get("frontier")
     if stacked.vert.shape[0] != opts.nparts:
         if opts.verbose >= 1:
             print(
@@ -438,7 +644,8 @@ def _resume_stacked(resume, opts: DistOptions):
             )
         stacked = _elastic_recut(stacked, opts)
         icap = None
-    return stacked, icap
+        fr0 = None
+    return stacked, icap, fr0
 
 
 @obs_trace.traced("adapt_distributed", driver="distributed")
@@ -467,7 +674,7 @@ def adapt_distributed(
 
     resume = fs.resume()
     if resume is not None:
-        stacked, icap0 = _resume_stacked(resume, opts)
+        stacked, icap0, fr0 = _resume_stacked(resume, opts)
         history: List[dict] = resume.history
         h_in = failsafe._histo_from_json(resume.meta.get("qual_in"))
         hausd = resume.meta.get("hausd")
@@ -485,6 +692,7 @@ def adapt_distributed(
             icap0=icap0, fs=fs,
             start_it=resume.it + 1, emult0=resume.emult,
             ckpt_meta=dict(qual_in=resume.meta.get("qual_in")),
+            fr0=fr0,
         )
         h_out = quality.merge_stacked_histograms(
             jax.vmap(quality.quality_histogram)(stacked)
@@ -570,7 +778,7 @@ def _grow_stacked_for_recovery(st: Mesh, opts: DistOptions) -> Mesh:
 def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                     history: List[dict], icap0: int | None = None,
                     fs=None, start_it: int = 0, emult0: float | None = None,
-                    ckpt_meta: dict | None = None):
+                    ckpt_meta: dict | None = None, fr0=None):
     """The niter remesh/interpolate/rebalance iterations shared by the
     centralized (`adapt_distributed`) and distributed-input
     (`adapt_stacked_input`) entry points — the `PMMG_parmmglib1` body
@@ -606,6 +814,14 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
     last_good = fs.snapshot(stacked)
     it = start_it
     attempts = 0
+    # active-set carry across iterations (opts.frontier): None = full
+    # first frontier (exact full-table sweep); thereafter the per-shard
+    # changed masks remapped through compaction and migration. Reset to
+    # full on every rollback — the restored snapshot predates the carry.
+    # `fr0` restores a CHECKPOINTED carry on resume, so a killed run's
+    # continuation gates its sweeps exactly like the uninterrupted run
+    # (bit-identical resume holds with the frontier on).
+    fr_carry = None if fr0 is None else jnp.asarray(fr0, bool)
     fs.arm_preemption()
     try:
         while it < opts.niter:
@@ -621,13 +837,13 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
             # collective of the iteration (no-op single-process)
             fs.heartbeat(it)
 
-            def _iteration(st, cm, ic):
-                st, cm, ic = _one_iteration(
+            def _iteration(st, cm, ic, fr):
+                st, cm, ic, fr = _one_iteration(
                     st, opts, hausd, history, it, cm, ic, emult, nparts,
-                    fs=fs,
+                    fs=fs, fr=fr,
                 )
                 fs.validate(st, it, comm=cm, phase="iteration")
-                return st, cm, ic
+                return st, cm, ic, fr
 
             try:
                 with tr.span("iteration", it=it):
@@ -636,12 +852,12 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                         # cleared caches) land in a recovery phase,
                         # exempt from the steady retrace budgets
                         with contracts.budget_exempt("iteration-retry"):
-                            stacked, comm, icap = _iteration(
-                                stacked, comm, icap
+                            stacked, comm, icap, fr_carry = _iteration(
+                                stacked, comm, icap, fr_carry
                             )
                     else:
-                        stacked, comm, icap = _iteration(
-                            stacked, comm, icap
+                        stacked, comm, icap, fr_carry = _iteration(
+                            stacked, comm, icap, fr_carry
                         )
             except failsafe.CapacityError as e:
                 history.append(dict(iter=it, phase="iteration",
@@ -653,6 +869,7 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                 stacked = failsafe.snapshot(last_good)
                 comm = None
                 icap = None
+                fr_carry = None
                 if attempts < fs.attempts:
                     attempts += 1
                     try:
@@ -677,6 +894,7 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                 stacked = failsafe.snapshot(last_good)
                 comm = None
                 icap = None
+                fr_carry = None
                 if attempts < fs.attempts:
                     attempts += 1
                     jax.clear_caches()
@@ -707,6 +925,7 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                 status = tags.ReturnStatus.LOWFAILURE
                 comm = None
                 icap = None
+                fr_carry = None
                 break
             attempts = 0
             last_good = fs.snapshot(stacked)
@@ -725,6 +944,12 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                     meta["hausd"] = float(hausd)
                 else:
                     aux["hausd"] = hausd
+                if fr_carry is not None:
+                    # the active-set carry is part of the trajectory:
+                    # without it a resumed run would restart from the
+                    # full frontier and gate its sweeps differently
+                    # than the uninterrupted run
+                    aux["frontier"] = fr_carry
                 with tr.span("checkpoint", it=it):
                     fs.save(it, {"mesh": stacked}, history=history,
                             emult=emult[0], meta=meta, aux_arrays=aux,
@@ -750,8 +975,16 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
     return stacked, comm, status
 
 
+@partial(jax.jit, donate_argnums=(0, 1))
+def _compact_aux_stacked(st: Mesh, changed):
+    """Stacked compact that remaps the per-shard frontier masks through
+    the same vertex renumbering (the single-shard `compact_aux`,
+    vmapped)."""
+    return jax.vmap(compact_aux)(st, changed)
+
+
 def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
-                   nparts, fs=None):
+                   nparts, fs=None, fr=None):
     if fs is None:
         from .. import failsafe
 
@@ -762,9 +995,14 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
     old = jax.vmap(adjacency.build_adjacency)(stacked)
 
     with tr.span("phase:remesh", it=it):
-        stacked = remesh_phase(stacked, opts, emult, history, it, hausd,
-                               fs=fs)
-        stacked = jax.vmap(compact)(stacked)
+        stacked, fr = remesh_phase(stacked, opts, emult, history, it,
+                                   hausd, fs=fs, fr0=fr)
+        if fr is not None:
+            # the frontier carry survives the pack: compact_aux remaps
+            # each shard's changed mask through the vertex renumbering
+            stacked, fr = _compact_aux_stacked(stacked, fr)
+        else:
+            stacked = jax.vmap(compact)(stacked)
     stacked = fs.fire(it, "remesh", stacked)
 
     # interpolate metric + fields from the snapshot
@@ -831,6 +1069,22 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
             cnts = np.asarray(jax.device_get(
                 migrate_mod.migration_counts(stacked, color, nparts)
             ))
+        fr_keys = None
+        if fr is not None:
+            # encode the active set as gid keys BEFORE the exchange:
+            # last sweep's changed vertices, every vertex of a
+            # migrating cell (its 1-ring context changes owner), and
+            # the CURRENT interface bands — the displacement unfreezes
+            # them, making them the next iteration's working set
+            # (ParMmg's interface-displacement loop). The gid encoding
+            # is immune to the growth/compaction/slot permutation of
+            # the exchange below.
+            par_pre = (stacked.vtag & tags.PARBDY) != 0
+            fr_keys = migrate_mod.frontier_gid_keys(
+                stacked,
+                jnp.asarray(fr, bool) | par_pre
+                | migrate_mod.migrating_vertices(stacked, color),
+            )
         # migration telemetry: cells crossing shards and an estimated
         # wire payload (tet row + its 4 vertex rows + amortized
         # surface/edge freight — the _pack stream contents), so the
@@ -861,6 +1115,11 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
             stacked, comm = _rebalance_full(stacked, comm, nparts)
             icap = None
             stacked = _presize_for_target(stacked, opts)
+            # the host merge+split rewrites every shard: restart the
+            # next iteration from the exact full frontier
+            fr = None if fr is None else jnp.ones(
+                (nparts, stacked.vert.shape[1]), bool
+            )
         elif cnts.max() > 0:
             slot_cap = int(cnts.max()) + 8
             if fs.faults.take(it, "migrate", "overflow"):
@@ -954,13 +1213,24 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
                 stacked, comm = _rebalance_full(stacked, comm, nparts)
                 icap = None
                 stacked = _presize_for_target(stacked, opts)
+                fr = None if fr is None else jnp.ones(
+                    (nparts, stacked.vert.shape[1]), bool
+                )
             else:
                 stacked = jax.vmap(compact)(moved)
                 stacked, comm = migrate_mod.retag_interfaces(stacked)
                 icap = comm.icap
                 stacked = _presize_for_target(stacked, opts)
+                if fr is not None:
+                    # decode the carried gid set on the new owners and
+                    # add the POST-exchange interface bands (the next
+                    # frozen regions border this iteration's work)
+                    par_post = (stacked.vtag & tags.PARBDY) != 0
+                    fr = migrate_mod.frontier_from_gid_keys(
+                        stacked, fr_keys
+                    ) | par_post
 
-    return stacked, comm, icap
+    return stacked, comm, icap, fr
 
 
 def _rebalance_full(stacked: Mesh, comm: ShardComm, nparts: int):
@@ -1001,7 +1271,7 @@ def adapt_stacked_input(
 
     resume = fs.resume()
     if resume is not None:
-        st, icap0 = _resume_stacked(resume, opts)
+        st, icap0, fr0 = _resume_stacked(resume, opts)
         history: List[dict] = resume.history
         h_in = failsafe._histo_from_json(resume.meta.get("qual_in"))
         hausd = resume.meta.get("hausd")
@@ -1013,6 +1283,7 @@ def adapt_stacked_input(
             st, opts, hausd, history, icap0=icap0,
             fs=fs, start_it=resume.it + 1, emult0=resume.emult,
             ckpt_meta=dict(qual_in=resume.meta.get("qual_in")),
+            fr0=fr0,
         )
         h_out = quality.merge_stacked_histograms(
             jax.vmap(quality.quality_histogram)(st)

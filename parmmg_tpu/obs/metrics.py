@@ -190,7 +190,14 @@ def record_sweep(rec: dict) -> None:
     """Fold one sweep history record (the drivers' HIST_COLS dict) into
     the registry — the single definition shared by the single-shard,
     vmapped and SPMD sweep engines, so `ops/*_accepted` is EXACTLY the
-    sum of the driver-reported history."""
+    sum of the driver-reported history.
+
+    Distributed records additionally carry `active_fraction` (world
+    candidates over world unique edges — the single-shard ratio falls
+    back to n_active/n_unique) and `shard_active` (per-shard fractions,
+    recorded as `sweep_active_fraction/shard<i>` gauges so
+    `tools/obs_report.py` can render a per-shard column and a drained
+    shard is visible even while its neighbors still churn)."""
     reg = _REGISTRY
     reg.counter("sweeps").inc()
     reg.counter("ops/split_accepted").inc(rec.get("nsplit", 0))
@@ -199,9 +206,14 @@ def record_sweep(rec: dict) -> None:
     reg.counter("ops/smooth_moved").inc(rec.get("nmoved", 0))
     n_act = rec.get("n_active", rec.get("n_unique", 0))
     reg.counter("ops/candidates").inc(n_act)
-    nu = rec.get("n_unique", 0)
-    if nu:
-        reg.gauge("sweep_active_fraction").set(n_act / nu)
+    if "active_fraction" in rec:
+        reg.gauge("sweep_active_fraction").set(rec["active_fraction"])
+    else:
+        nu = rec.get("n_unique", 0)
+        if nu:
+            reg.gauge("sweep_active_fraction").set(n_act / nu)
+    for i, frac in enumerate(rec.get("shard_active", ())):
+        reg.gauge(f"sweep_active_fraction/shard{i}").set(frac)
 
 
 # ---------------------------------------------------------------------------

@@ -83,6 +83,17 @@ def summarize(dirpath: str) -> dict:
     metrics = metrics_mod.merge_dir(dirpath)
     spans = _span_table(events)
     counters = (metrics or {}).get("counters", {})
+    gauges = (metrics or {}).get("gauges", {})
+
+    def _gval(v):
+        # merged docs store gauges as {"per_rank": ..., "max": x}
+        return v.get("max", 0.0) if isinstance(v, dict) else v
+
+    shard_active = {
+        int(k[len("sweep_active_fraction/shard"):]): _gval(v)
+        for k, v in gauges.items()
+        if k.startswith("sweep_active_fraction/shard")
+    }
     ops = {}
     for op in ("split", "collapse", "swap"):
         ops[op] = counters.get(f"ops/{op}_accepted", 0)
@@ -99,6 +110,10 @@ def summarize(dirpath: str) -> dict:
             candidates=candidates,
             acceptance=(accepted / candidates) if candidates else None,
             sweeps=counters.get("sweeps", 0),
+            active_fraction=_gval(
+                gauges.get("sweep_active_fraction", 0.0)
+            ),
+            shard_active=shard_active,
         ),
         comm=dict(
             barriers=counters.get("comm/barriers", 0),
@@ -175,6 +190,17 @@ def render(dirpath: str) -> str:
     )
     if o["acceptance"] is not None:
         lines.append(f"   acceptance rate {o['acceptance']:.3%}")
+    if o.get("shard_active"):
+        # per-shard active fraction at the last recorded sweep: a
+        # drained shard reads 0.000 while its neighbors still churn
+        cells = "  ".join(
+            f"s{i} {o['shard_active'][i]:.3f}"
+            for i in sorted(o["shard_active"])
+        )
+        lines.append(
+            f"   active fraction {o.get('active_fraction', 0.0):.3f}  "
+            f"per shard: {cells}"
+        )
 
     c = s["comm"]
     lines.append("")

@@ -1055,3 +1055,62 @@ def _retag_interfaces_host(stacked: Mesh, icap=None) -> Tuple[Mesh, ShardComm]:
         trmask=jnp.asarray(trmask),
     )
     return stacked, rebuild_comm(stacked, icap)
+
+
+# ---------------------------------------------------------------------------
+# frontier remap through the exchange — round 8
+#
+# The active-set carry of the distributed sweeps (models/distributed)
+# must survive the repartition: a cell that crosses a shard boundary
+# has to arrive ACTIVE on its new owner, and the interface bands the
+# displacement unfreezes are exactly the regions with pending work
+# (ParMmg's interface-displacement loop makes them the next
+# iteration's working set). Vertex identity across the exchange is the
+# persistent global id (`Mesh.vglob`, remapped through every compact),
+# so the remap is gid-set membership: encode the active set as gid
+# keys BEFORE the exchange, decode per shard AFTER it — one sort-merge
+# over [D*PC] rows, immune to capacity growth, slot permutation and
+# ownership changes in between.
+# ---------------------------------------------------------------------------
+
+
+# parmmg-lint: disable=PML005 -- pure query (leaving-cell vertex mask); the caller keeps migrating the mesh
+@jax.jit
+def migrating_vertices(stacked: Mesh, color: jax.Array) -> jax.Array:
+    """[D, PC] bool: vertices of tets about to leave their shard (their
+    whole 1-ring context changes owner, so they re-enter the frontier
+    on arrival)."""
+    d, pc = stacked.vmask.shape
+    own = jnp.arange(d, dtype=color.dtype)[:, None]
+    leaving = stacked.tmask & (color >= 0) & (color != own)
+
+    def per_shard(tet_s, lv_s):
+        idx = jnp.where(lv_s[:, None], tet_s, pc)
+        return jnp.zeros(pc, bool).at[idx.reshape(-1)].set(
+            True, mode="drop"
+        )
+
+    return jax.vmap(per_shard)(stacked.tet, leaving)
+
+
+# parmmg-lint: disable=PML005 -- pure query (gid encode); the caller exchanges the mesh next
+@jax.jit
+def frontier_gid_keys(stacked: Mesh, sel: jax.Array) -> jax.Array:
+    """[D*PC, 1] int32 gid rows of the selected live vertices (-1 rows
+    never match). Requires `assign_global_ids` to have run."""
+    g = jnp.where(sel & stacked.vmask, stacked.vglob, -1)
+    return g.reshape(-1, 1).astype(jnp.int32)
+
+
+# parmmg-lint: disable=PML005 -- pure query (gid decode) on the post-exchange mesh the caller keeps
+@jax.jit
+def frontier_from_gid_keys(stacked: Mesh, keys: jax.Array) -> jax.Array:
+    """[D, PC] bool: live vertices whose gid appears among `keys` — the
+    post-exchange decode of `frontier_gid_keys` (exact: gid membership
+    is ownership-independent, so a migrated cell's vertices land active
+    on the receiving shard)."""
+    q = jnp.where(
+        stacked.vmask, stacked.vglob, -1
+    ).reshape(-1, 1).astype(jnp.int32)
+    hit = common.sorted_membership(keys, q)
+    return hit.reshape(stacked.vmask.shape) & stacked.vmask

@@ -1,13 +1,16 @@
-"""Round-6 active-set (frontier) sweep tests.
+"""Round-6/round-8 active-set (frontier) sweep tests.
 
 Equivalence discipline: frontier sweeps gate candidate generation on the
 one-ring closure of the previous sweep's changes and rebuild analysis
 tables incrementally — the RESULT must match full-table sweeps on the
 seeded cube workload (same element count, quality histogram and
 conformity within fp jitter), on both the fused and unfused dispatch
-paths. The incremental rebuilds (`update_adjacency`,
-`append_unique_edges`) must be bit-exact against their full
-counterparts, including their overflow fallbacks.
+paths AND on the distributed drivers (round 8: per-shard frontier
+through the vmapped/SPMD sweeps, remapped through migration). The
+incremental rebuilds (`update_adjacency`, `merge_unique_edges`) must be
+bit-exact against their full counterparts, including their overflow
+fallbacks — `merge_unique_edges` across ARBITRARY randomized
+split/collapse/swap delta schedules, not just append-only ones.
 """
 
 import numpy as np
@@ -149,20 +152,13 @@ def test_update_adjacency_exact():
                                   np.asarray(fall.adja))
 
 
-def test_append_unique_edges_exact():
-    """Incremental edge-table extension after a 2-3 swap pass matches
-    the full re-sort: same edge set, same n_unique, and every live
-    tet2edge row references the same vertex pair."""
-    mesh = _jittered_cube(seed=1)
-    m0 = adjacency.build_adjacency(mesh)
-    ecap = int(m0.tcap * 1.7) + 64
-    edges, emask, t2e, nu = adjacency.unique_edges(m0, ecap)
-    m1, st = swap.swap_23(_copy(m0), edges, emask)
-    assert int(st.nswap23) > 0
-    e_i, em_i, t2e_i, nu_i = adjacency.append_unique_edges(
-        m1, st.changed_v, edges, emask, t2e, nu, K=m0.tcap
-    )
-    e_f, em_f, t2e_f, nu_f = adjacency.unique_edges(m1, ecap)
+def _assert_table_equiv(m1, tab_incr, tab_full):
+    """Semantic table equality: same live edge SET, same live count,
+    and every live tet's t2e row references the same vertex pairs (slot
+    NUMBERING may differ — the merge reclaims tombstoned slots, the
+    full rebuild assigns sorted-dense ids)."""
+    e_i, em_i, t2e_i, nu_i = tab_incr
+    e_f, em_f, t2e_f, nu_f = tab_full
     assert int(nu_i) == int(nu_f)
     set_i = {tuple(r) for r in np.asarray(e_i)[np.asarray(em_i)]}
     set_f = {tuple(r) for r in np.asarray(e_f)[np.asarray(em_f)]}
@@ -172,11 +168,264 @@ def test_append_unique_edges_exact():
     live = np.nonzero(np.asarray(m1.tmask))[0]
     assert (Ti[live] >= 0).all() and (Tf[live] >= 0).all()
     np.testing.assert_array_equal(Ei[Ti[live]], Ef[Tf[live]])
+    # dead tets carry no stale references
+    dead = np.nonzero(~np.asarray(m1.tmask))[0]
+    assert (Ti[dead] == -1).all()
+
+
+def test_merge_unique_edges_exact():
+    """General incremental merge after a 2-3 swap pass (the old
+    append-only case) matches the full re-sort — edge set, live count,
+    per-row pairs — including the K-overflow fallback."""
+    mesh = _jittered_cube(seed=1)
+    m0 = adjacency.build_adjacency(mesh)
+    ecap = int(m0.tcap * 1.7) + 64
+    edges, emask, t2e, nu = adjacency.unique_edges(m0, ecap)
+    m1, st = swap.swap_23(_copy(m0), edges, emask)
+    assert int(st.nswap23) > 0
+    tab_i = adjacency.merge_unique_edges(
+        m1, st.changed_v, edges, emask, t2e, nu, K=m0.tcap
+    )
+    _assert_table_equiv(m1, tab_i, adjacency.unique_edges(m1, ecap))
     # K-overflow fallback stays exact
-    _, _, _, nu_k = adjacency.append_unique_edges(
+    _, _, _, nu_k = adjacency.merge_unique_edges(
         m1, st.changed_v, edges, emask, t2e, nu, K=2
     )
-    assert int(nu_k) == int(nu_f)
+    assert int(nu_k) == int(adjacency.unique_edges(m1, ecap)[3])
+
+
+def test_merge_unique_edges_delta_schedule():
+    """PROPERTY: the merge is exact across a randomized schedule of
+    split/collapse/swap deltas — the cases the append-only extension
+    could not express (edge deletions, tombstoned slots, slot reuse).
+    Each delta applies a REAL operator pass under a random active gate
+    (stable numbering, no compaction — appending ops run before killing
+    ops, the same packing discipline the sweep's in-body compaction
+    points enforce), accumulates the operators' changed_v union, and
+    compares the single merged table against the full re-sort."""
+    from parmmg_tpu.ops import collapse as collapse_mod
+    from parmmg_tpu.ops import split as split_mod
+
+    def fresh_tables(m, ecap):
+        # valid current-topology tables for FEEDING the next operator;
+        # the merge under test still runs from the original tab0 +
+        # accumulated changed set
+        return adjacency.unique_edges(m, ecap)
+
+    rng = np.random.default_rng(7)
+    for trial in range(3):
+        mesh = _jittered_cube(n=4, seed=10 + trial)
+        # mixed random metric: fine spots make splits fire, coarse
+        # spots make collapses fire — the delta mix the merge must
+        # absorb in one pass
+        met = rng.uniform(0.08, 0.6, (mesh.pcap, 1))
+        mesh = mesh.replace(
+            met=jnp.asarray(met, mesh.vert.dtype), met_set=True
+        )
+        m = adjacency.build_adjacency(mesh)
+        ecap = int(m.tcap * 1.9) + 64
+        tab0 = adjacency.unique_edges(m, ecap)
+        changed = jnp.zeros(m.pcap, bool)
+        # appending ops (split / 2-3 swap) target the live-count cursor
+        # and must precede killing ops (collapse / 3-2 swap) when no
+        # compaction runs in between
+        appenders = [x for x in ("split", "swap23")
+                     if rng.random() < 0.7]
+        killers = [x for x in ("collapse", "swap32")
+                   if rng.random() < 0.7] or ["collapse"]
+        applied = []
+        for op in (
+            list(rng.permutation(appenders)) if appenders else []
+        ) + list(rng.permutation(killers)):
+            act = jnp.asarray(
+                rng.random(m.pcap) < rng.uniform(0.3, 1.0), bool
+            )
+            e, em, t2, _ = fresh_tables(m, ecap)
+            if op == "split":
+                m, st = split_mod.split_long_edges(
+                    m, e, em, t2, active=act
+                )
+                n_op, chg = int(st.nsplit), st.changed_v
+            elif op == "collapse":
+                m, st = collapse_mod.collapse_short_edges(
+                    m, e, em, t2, hausd=0.05, active=act
+                )
+                n_op, chg = int(st.ncollapse), st.changed_v
+            elif op == "swap23":
+                m, st = swap.swap_23(m, e, em, active=act)
+                n_op, chg = int(st.nswap23), st.changed_v
+            else:
+                m, st = swap.swap_32(m, e, em, t2, active=act)
+                n_op, chg = int(st.nswap32), st.changed_v
+            changed = changed | chg
+            applied.append((op, n_op))
+        assert any(n for _, n in applied), applied
+        tab_i = adjacency.merge_unique_edges(
+            m, changed, *tab0, K=m.tcap
+        )
+        _assert_table_equiv(m, tab_i, adjacency.unique_edges(m, ecap))
+
+
+# ---------------------------------------------------------------------------
+# round 8: the frontier carry through the distributed drivers
+# ---------------------------------------------------------------------------
+
+
+_DIST_BASE = dict(nparts=2, niter=2, hsiz=0.25, max_sweeps=6,
+                  min_shard_elts=16, hgrad=None)
+
+
+def _dist_run(frontier, **kw):
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_distributed,
+    )
+
+    base = dict(_DIST_BASE)
+    base.update(kw)
+    st, comm, info = adapt_distributed(
+        unit_cube_mesh(4), DistOptions(frontier=frontier, **base)
+    )
+    return st, comm, info
+
+
+@pytest.fixture(scope="module")
+def dist_frontier():
+    return _dist_run(True)
+
+
+def test_distributed_frontier_runs_and_reports(dist_frontier):
+    """Frontier-on distributed adaptation: green loop, conformal merged
+    output, and every sweep record carries the active-set telemetry
+    (world active_fraction + per-shard fractions)."""
+    from parmmg_tpu.models.distributed import merge_adapted
+
+    st, comm, info = dist_frontier
+    assert info["status"] == tags.ReturnStatus.SUCCESS
+    merged = merge_adapted(st, comm)
+    assert conformity.check_mesh(merged).ok
+    recs = [r for r in info["history"] if "n_unique" in r]
+    assert recs
+    for r in recs:
+        assert 0.0 <= r["active_fraction"] <= 1.0
+        assert len(r["shard_active"]) == 2
+
+
+@pytest.mark.slow
+def test_distributed_frontier_full_equivalence(dist_frontier):
+    """Frontier on/off must produce the same adapted mesh class on the
+    distributed driver: same element count (tight), quality histogram
+    and conformity — the driver-level extension of the single-shard
+    equivalence discipline."""
+    from parmmg_tpu.models.distributed import merge_adapted
+
+    st_f, comm_f, _ = dist_frontier
+    st_t, comm_t, _ = _dist_run(False)
+    m_f = merge_adapted(st_f, comm_f)
+    m_t = merge_adapted(st_t, comm_t)
+    ne_f, ne_t = int(m_f.ntet), int(m_t.ntet)
+    assert abs(ne_f - ne_t) <= max(0.02 * ne_t, 16), (ne_f, ne_t)
+    h_f = quality.quality_histogram(m_f)
+    h_t = quality.quality_histogram(m_t)
+    assert float(h_f.qmin) == pytest.approx(float(h_t.qmin), abs=0.05)
+    assert float(h_f.qavg) == pytest.approx(float(h_t.qavg), abs=0.02)
+    cf = np.asarray(h_f.counts, np.float64) / max(ne_f, 1)
+    ct = np.asarray(h_t.counts, np.float64) / max(ne_t, 1)
+    assert np.abs(cf - ct).max() < 0.05, (cf, ct)
+
+
+def test_distributed_noop_phase_identity(dist_frontier):
+    """A drained carry makes the converged distributed remesh phase the
+    IDENTITY: bit-identical stacked arrays, one zero-op `skipped`
+    record, and the carry stays drained — the converged fast path the
+    round-8 bench measures."""
+    from parmmg_tpu.models.distributed import DistOptions, remesh_phase
+
+    st, _, _ = dist_frontier
+    opts = DistOptions(frontier=True, **_DIST_BASE)
+    hist: list = []
+    zeros = jnp.zeros((st.vert.shape[0], st.vert.shape[1]), bool)
+    ref = _copy(st)
+    out, fr2 = remesh_phase(st, opts, [1.6], hist, 9, 0.01, fr0=zeros)
+    assert len(hist) == 1 and hist[0].get("skipped")
+    assert hist[0]["nsplit"] + hist[0]["ncollapse"] + hist[0]["nswap"] == 0
+    assert hist[0]["n_active"] == 0
+    for a, b in zip(jax.tree_util.tree_leaves(out),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(jnp.sum(fr2.astype(jnp.int32))) == 0
+
+
+@pytest.mark.slow
+def test_distributed_spmd_frontier(monkeypatch, dist_frontier):
+    """The SPMD (`shard_map`) dispatch with per-shard frontier state
+    (shard-varying staleness conds) must reproduce the vmapped path's
+    result on the same workload — the single-controller equivalence
+    run the multi-process path shares its program with."""
+    from parmmg_tpu.models.distributed import merge_adapted
+
+    monkeypatch.setenv("PMMGTPU_SPMD_SWEEPS", "1")
+    st_s, comm_s, info_s = _dist_run(True)
+    assert info_s["status"] == tags.ReturnStatus.SUCCESS
+    m_s = merge_adapted(st_s, comm_s)
+    assert conformity.check_mesh(m_s).ok
+    st_l, comm_l, _ = dist_frontier
+    m_l = merge_adapted(st_l, comm_l)
+    assert int(m_s.ntet) == int(m_l.ntet)
+    h_s = quality.quality_histogram(m_s)
+    h_l = quality.quality_histogram(m_l)
+    assert float(h_s.qmin) == pytest.approx(float(h_l.qmin), abs=1e-6)
+
+
+def test_frontier_remap_through_migration_exact():
+    """The gid-keyed frontier remap is EXACT through a real
+    displacement + migration + compaction + retag round: a vertex is
+    active on its (possibly new) owner iff its gid was in the encoded
+    active set — bit-equal against a host recomputation."""
+    from parmmg_tpu.models.distributed import grow_stacked
+    from parmmg_tpu.parallel import migrate as mig
+    from parmmg_tpu.parallel.distribute import (
+        assign_global_ids, rebuild_comm, split_mesh,
+    )
+    from parmmg_tpu.parallel.partition import sfc_partition
+
+    mesh = adjacency.build_adjacency(unit_cube_mesh(4))
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 2)))
+    st, _ = split_mesh(mesh, part, 2)
+    st = assign_global_ids(st)
+    comm = rebuild_comm(st)
+    st = jax.vmap(adjacency.build_adjacency)(st)
+    color = mig.displace_colors(st, comm, 2, round_id=0, layers=2)
+    cnts = np.asarray(jax.device_get(mig.migration_counts(st, color, 2)))
+    assert cnts.sum() > 0, "displacement moved nothing"
+
+    rng = np.random.default_rng(3)
+    chg = jnp.asarray(rng.random(st.vmask.shape) < 0.3, bool) & st.vmask
+    keys = mig.frontier_gid_keys(st, chg)
+    want = set(
+        np.asarray(st.vglob)[np.asarray(chg)].tolist()
+    )
+
+    st2 = grow_stacked(
+        st,
+        pcap=st.vert.shape[1] * 2, tcap=st.tet.shape[1] * 2,
+        fcap=st.tria.shape[1] * 2, ecap=st.edge.shape[1] * 2,
+    )
+    color = jnp.pad(
+        color, ((0, 0), (0, st2.tet.shape[1] - color.shape[1])),
+        constant_values=-1,
+    )
+    moved = mig.migrate(st2, color, 2, int(cnts.max()) + 8)
+    moved = jax.vmap(compact)(moved)
+    st3, _ = mig.retag_interfaces(moved)
+
+    got = np.asarray(jax.device_get(
+        mig.frontier_from_gid_keys(st3, keys)
+    ))
+    g3 = np.asarray(st3.vglob)
+    vm3 = np.asarray(st3.vmask)
+    exp = np.zeros_like(got)
+    exp[vm3] = np.isin(g3[vm3], sorted(want))
+    np.testing.assert_array_equal(got, exp)
 
 
 def test_mem_budget_autoderived():

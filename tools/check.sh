@@ -42,6 +42,15 @@ rc=$?
 echo "## chaos-smoke rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
+# distributed-frontier smoke: 2-shard tiny run — sweep_active_fraction
+# must drain to ~0 at convergence with the drained-skip path taken,
+# frontier on/off must stay result-equivalent, and the drained
+# converged phase must not cost more than the full-table one
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python tools/frontier_smoke.py
+rc=$?
+echo "## frontier-smoke rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
 # observability smoke: one tiny traced run must yield a structurally
 # valid Chrome trace + JSONL timeline, exact op counters, and a
 # parseable obs_report — the never-go-blind gate for the perf arc

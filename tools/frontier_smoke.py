@@ -1,0 +1,131 @@
+"""Distributed-frontier smoke gate (round 8, tools/check.sh stage).
+
+Asserts the active-set carry through the distributed SPMD/vmapped path
+actually behaves — on a 2-shard tiny fixture, CPU, minutes not hours:
+
+  1. DRAIN: with frozen interfaces (-nobalance) a converged run's
+     `sweep_active_fraction` must drain to 0 and the converged
+     iterations must take the drained-skip path (zero ops, identity).
+  2. EQUIVALENCE: frontier on/off on the balanced driver must produce
+     conformal merged meshes of the same element count and quality
+     class (the test_m12 discipline, driver-level).
+  3. COST: the drained-frontier converged phase must not cost more
+     than the full-table converged phase (the 10x lever this PR moves
+     to the distributed drivers; the committed BENCH JSON records the
+     real ratio at bench scale).
+  4. TELEMETRY: the metrics registry must carry the world
+     `sweep_active_fraction` gauge and the per-shard gauges the obs
+     report renders.
+
+Exit 0 on success; any assertion prints FAIL and exits 1.
+"""
+
+import dataclasses
+import sys
+import time
+
+from _cli import REPO, parse_argv  # noqa: F401
+
+
+def main() -> int:
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_distributed, merge_adapted, remesh_phase,
+    )
+    from parmmg_tpu.obs import metrics as obs_metrics
+    from parmmg_tpu.ops import quality
+    from parmmg_tpu.utils.conformity import check_mesh
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    obs_metrics.registry().reset()
+    t0 = time.time()
+    base = dict(nparts=2, niter=4, hsiz=0.25, max_sweeps=8,
+                min_shard_elts=16, hgrad=None)
+
+    # --- 1. drain + skip (frozen interfaces keep the carry honest) ----
+    opts = DistOptions(frontier=True, nobalancing=True, **base)
+    st, comm, info = adapt_distributed(unit_cube_mesh(4), opts)
+    hist = [r for r in info["history"] if "n_unique" in r]
+    assert hist, "no sweep records"
+    last = hist[-1]
+    assert last.get("active_fraction", 1.0) == 0.0, (
+        f"FAIL: active fraction did not drain: {last}"
+    )
+    assert last.get("skipped"), (
+        f"FAIL: converged iteration did not take the drained-skip "
+        f"path: {last}"
+    )
+    skips = sum(1 for r in hist if r.get("skipped"))
+    print(f"## drain: {skips} drained-skip iteration(s), final "
+          f"active_fraction={last['active_fraction']}", flush=True)
+
+    # --- 2. frontier on/off equivalence on the balanced driver --------
+    outs = {}
+    for frontier in (True, False):
+        opts = DistOptions(frontier=frontier, **base)
+        st, comm, info = adapt_distributed(unit_cube_mesh(4), opts)
+        merged = merge_adapted(st, comm)
+        rep = check_mesh(merged)
+        assert rep.ok, f"FAIL: frontier={frontier} not conformal: {rep}"
+        h = quality.quality_histogram(merged)
+        outs[frontier] = (int(merged.ntet), float(h.qmin), float(h.qavg))
+    ne_f, qmin_f, qavg_f = outs[True]
+    ne_t, qmin_t, qavg_t = outs[False]
+    assert abs(ne_f - ne_t) <= max(0.02 * ne_t, 16), (ne_f, ne_t)
+    assert abs(qmin_f - qmin_t) < 0.05, (qmin_f, qmin_t)
+    assert abs(qavg_f - qavg_t) < 0.02, (qavg_f, qavg_t)
+    print(f"## equivalence: frontier ne={ne_f} qmin={qmin_f:.4f} vs "
+          f"full ne={ne_t} qmin={qmin_t:.4f}", flush=True)
+
+    # --- 3. converged-phase cost: drained skip <= full table ----------
+    hist2: list = []
+    full_opts = dataclasses.replace(opts, frontier=False, verbose=0)
+    fr_opts = dataclasses.replace(opts, frontier=True, verbose=0)
+    drained = jnp.zeros((st.vert.shape[0], st.vert.shape[1]), bool)
+
+    def timed(fn, reps=2):
+        fn()
+        t = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t) / reps
+
+    t_full = timed(lambda: remesh_phase(
+        st, full_opts, [1.6], hist2, 0, 0.01
+    ))
+    t_fr = timed(lambda: remesh_phase(
+        st, fr_opts, [1.6], hist2, 0, 0.01, fr0=drained
+    ))
+    assert t_fr <= t_full * 1.05, (
+        f"FAIL: drained frontier phase ({t_fr * 1e3:.1f} ms) costs more "
+        f"than full table ({t_full * 1e3:.1f} ms)"
+    )
+    print(f"## converged phase: full {t_full * 1e3:.1f} ms vs drained "
+          f"{t_fr * 1e3:.1f} ms ({t_full / max(t_fr, 1e-9):.1f}x)",
+          flush=True)
+
+    # --- 4. telemetry: world + per-shard gauges -----------------------
+    doc = obs_metrics.registry().to_doc()
+    gauges = doc["gauges"]
+    assert "sweep_active_fraction" in gauges, gauges.keys()
+    shard_gauges = [k for k in gauges
+                    if k.startswith("sweep_active_fraction/shard")]
+    assert len(shard_gauges) >= 2, (
+        f"FAIL: per-shard active gauges missing: {sorted(gauges)}"
+    )
+    print(f"## telemetry: {len(shard_gauges)} per-shard gauges, world "
+          f"gauge={gauges['sweep_active_fraction']}", flush=True)
+
+    print(f"## frontier-smoke OK in {time.time() - t0:.1f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"FAIL: {e}", flush=True)
+        sys.exit(1)

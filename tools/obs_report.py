@@ -14,6 +14,11 @@ timeline view, alongside any ``profile/`` device capture),
 ``events_rank<r>.jsonl`` (the durable line log, complete even after an
 ``os._exit`` death) and ``metrics_rank<r>.json``. Pure stdlib + host
 code: never touches the accelerator.
+
+The operators section includes the active-set telemetry (round 8):
+the world ``sweep_active_fraction`` gauge plus a per-shard column from
+the ``sweep_active_fraction/shard<i>`` gauges the distributed drivers
+record — a drained shard reads 0.000 while its neighbors still churn.
 """
 
 import json

@@ -129,6 +129,11 @@ def worker(n, hsiz, tight=False):
         round(r["n_active"] / max(r["n_unique"], 1), 4)
         for r in info["history"] if "n_active" in r
     ]
+    # converged-sweep parity probe (round 8): full-table vs
+    # drained-frontier no-op sweep on the adapted mesh — the same
+    # numbers bench.py records, so the ladder's trajectory carries the
+    # frontier win at every rung (probe compiles respect UNFUSED_TCAP)
+    converged = bench.measure_converged_sweep(out, reps=2)
     # COLD timing: one adapt() with no warmup — compile time (or cache
     # hits) is folded in, so this number is NOT comparable to bench.py's
     # steady-state tets_per_sec; the metric name says so
@@ -140,6 +145,7 @@ def worker(n, hsiz, tight=False):
         "qmin": round(float(h.qmin), 5), "qavg": round(float(h.qavg), 5),
         "recompiles": info["recompiles"],
         "sweep_active_fraction": saf,
+        "converged_sweep_cost": converged,
     }
     print(json.dumps(rec), flush=True)
 
