@@ -77,18 +77,41 @@ def _note_phase(name: str) -> None:
     print(f"BENCH_PHASE {name}", flush=True)
 
 
+def _rung_for_cfg(cfg) -> str:
+    """The PERF_DB rung label of one bench config — shared by the full
+    and partial record paths so both land in the same baseline group."""
+    if cfg.get("dist"):
+        return f"dist-p{cfg.get('nparts', '?')}"
+    try:
+        return f"n{cfg.get('n', '?')}-hsiz{float(cfg['hsiz']):g}"
+    except (KeyError, TypeError, ValueError):
+        return f"n{cfg.get('n', '?')}-hsiz{cfg.get('hsiz', '?')}"
+
+
+def _envelope(rec, cfg):
+    """Stamp the PERF_DB envelope (schema/run_id/git_sha/timestamp/
+    platform/rung) via the ONE record constructor — worker-committed
+    and parent-synthesized records must be indistinguishable in shape
+    (obs.history.make_record; the r0x two-dict drift is gone)."""
+    from parmmg_tpu.obs import history as obs_history
+
+    return obs_history.make_record(rec, rung=_rung_for_cfg(cfg))
+
+
 def partial_record(cfg, died_in=None, reason="stage deadline"):
     """The committed-partial BENCH line: parseable by every consumer of
-    the full record, explicitly marked, and naming the stage/phase the
-    budget died in — the never-blind contract of the bench ladder."""
+    the full record, explicitly marked, enveloped like the full record,
+    and naming the stage/phase the budget died in — the never-blind
+    contract of the bench ladder."""
     try:
         import jax
 
         platform = jax.devices()[0].platform
     except Exception:
         platform = "unknown"
-    return {
-        "metric": "tets_per_sec",
+    return _envelope({
+        "metric": ("tets_per_sec_distributed" if cfg.get("dist")
+                   else "tets_per_sec"),
         "value": 0.0,
         "unit": "tet/s",
         "vs_baseline": 0.0,
@@ -97,7 +120,7 @@ def partial_record(cfg, died_in=None, reason="stage deadline"):
         "died_in": died_in or _PHASE_NOW[0],
         "error": reason,
         "platform": platform,
-    }
+    }, cfg)
 
 
 def _arm_stage_deadline() -> None:
@@ -332,7 +355,7 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         for r in info["history"] if "n_active" in r
     ]
     _note_phase("converged-probe")
-    return {
+    return _envelope({
         "metric": "tets_per_sec",
         "value": round(tps, 1),
         "unit": "tet/s",
@@ -353,7 +376,7 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         # staging writer (0.0 when the run checkpoints synchronously or
         # not at all — see PARMMG_BENCH_CKPT above)
         "ckpt_overlap_s": float(info.get("ckpt_overlap_s", 0.0)),
-    }
+    }, dict(n=n, hsiz=hsiz))
 
 
 def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
@@ -396,6 +419,7 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
     ]
 
     _note_phase("dist-converged-probe")
+    dist_cfg = dict(dist=True, n=n, hsiz=hsiz, nparts=nparts)
     # distributed converged-iteration cost: one full-table sweep on the
     # converged stacked mesh (the legacy per-iteration floor) vs the
     # drained-frontier skip path
@@ -419,7 +443,7 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
         st, fr_opts, [1.6], hist, 0, hausd, fr0=drained
     ))
     central = measure_converged_sweep(merged)
-    return {
+    return _envelope({
         "metric": "tets_per_sec_distributed",
         "value": round(ne / wall, 1),
         "unit": "tet/s",
@@ -447,7 +471,7 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
                 t_full / max(t_fr, 1e-9), 2
             ),
         },
-    }
+    }, dist_cfg)
 
 
 def _last_phase(text) -> str:
@@ -516,9 +540,13 @@ def main():
     if "--worker" in sys.argv:
         cfg = json.loads(sys.argv[-1])
         _arm_stage_deadline()
+        kw = {k: v for k, v in cfg.items() if k != "dist"}
         try:
-            rec = run_dist(**cfg) if cfg.pop("dist", False) else run(**cfg)
+            rec = run_dist(**kw) if cfg.get("dist") else run(**kw)
         except StageDeadline as e:
+            # cfg keeps its dist marker: the partial record's envelope
+            # (rung/metric) must match the full record this attempt
+            # would have committed
             rec = partial_record(cfg, reason=str(e))
         signal.alarm(0)
         print(json.dumps(rec), flush=True)
@@ -578,11 +606,13 @@ def main():
         # best full record, else the best partial (which names the
         # stage/phase the budget died in), never rc=124 silence
         best = cpu if cpu is not None else rec
-        print(json.dumps(best) if best is not None else json.dumps({
-            "metric": "tets_per_sec", "value": 0.0, "unit": "tet/s",
-            "vs_baseline": 0.0, "partial": True,
-            "error": "all attempts timed out",
-        }), flush=True)
+        if best is None:
+            best = _envelope({
+                "metric": "tets_per_sec", "value": 0.0, "unit": "tet/s",
+                "vs_baseline": 0.0, "partial": True,
+                "error": "all attempts timed out",
+            }, dict(n=10, hsiz=0.05))
+        print(json.dumps(best), flush=True)
         return
 
     # 2. opportunistic ladder toward the 10M-tet north star: n=12

@@ -22,7 +22,7 @@ import numpy as np
 
 from ..core import adjacency, metric as metric_mod, tags
 from ..core.mesh import Mesh, compact, compact_aux
-from ..obs import metrics as obs_metrics, trace as obs_trace
+from ..obs import costs as obs_costs, metrics as obs_metrics, trace as obs_trace
 from ..ops import analysis, collapse, common, quality, smooth, split, swap
 
 
@@ -1146,6 +1146,18 @@ def run_batched_sweep_loop(
             hist = _hist_row(stats, mesh.ntet, mesh.npoin)[None, :]
             n = 1
         else:
+            # XLA cost attribution (obs.costs): captured once per shape
+            # signature, only under a costs-armed tracer — the doc the
+            # report joins with this device_span's measured mean
+            obs_costs.capture(
+                "remesh_sweeps", remesh_sweeps,
+                (mesh, jnp.int32(budget - done), ecap, opts.max_sweeps),
+                dict(noinsert=opts.noinsert, noswap=opts.noswap,
+                     nomove=opts.nomove, nosurf=opts.nosurf,
+                     hausd=hausd, converge_frac=opts.converge_frac,
+                     grow_trigger=opts.grow_trigger,
+                     frontier=opts.frontier),
+            )
             with tr.device_span("remesh_sweeps", it=it, sweep=done):
                 mesh, hist, n_done = remesh_sweeps(
                     mesh, jnp.int32(budget - done), ecap, opts.max_sweeps,
@@ -1333,8 +1345,14 @@ def adapt(
     # span and opens the next, so the whole run partitions into
     # phase:<name> spans under the root (the `printim` boundaries)
     _phase_span = [None]
+    _phase_name = [None]
 
     def _close_phase():
+        if _phase_name[0] is not None:
+            # HBM watermark at the boundary, attributed to the phase
+            # just finished (device memory_stats, host-RSS fallback)
+            obs_costs.record_hbm(_phase_name[0])
+            _phase_name[0] = None
         if _phase_span[0] is not None:
             _phase_span[0].__exit__(None, None, None)
             _phase_span[0] = None
@@ -1346,8 +1364,11 @@ def adapt(
         # the first sweep prints — watchdogs key off them
         if phase_hook is not None:
             phase_hook(name)
+        # boundary bookkeeping (watermark + span close) runs even
+        # untraced: the hbm/* gauges are always-on metrics
+        _close_phase()
+        _phase_name[0] = name
         if tr.enabled:
-            _close_phase()
             _phase_span[0] = tr.span(f"phase:{name}")
             _phase_span[0].__enter__()
         if opts.verbose >= 2:
@@ -1516,6 +1537,9 @@ def adapt(
                 break
             attempts = 0
             last_good = fs.snapshot(mesh)
+            # per-iteration watermark: the sweeps phase spans the whole
+            # loop, so the boundary snapshot alone would miss the peak
+            obs_costs.record_hbm("sweeps")
             if tr.enabled:
                 obs_metrics.registry().snapshot(it)
             if fs.ckpt is not None and (

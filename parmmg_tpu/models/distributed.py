@@ -34,7 +34,7 @@ import numpy as np
 from ..core import adjacency, tags
 from ..core.mesh import Mesh, compact, compact_aux
 from ..failsafe import CapacityError
-from ..obs import metrics as obs_metrics, trace as obs_trace
+from ..obs import costs as obs_costs, metrics as obs_metrics, trace as obs_trace
 from ..ops import analysis, interp, quality
 from ..parallel.distribute import (
     ShardComm,
@@ -403,16 +403,22 @@ def _remesh_phase_global(
                     ),
                     dmesh,
                 )
-            out, stats, fro = _spmd_sweep_fn(
+            fn = _spmd_sweep_fn(
                 dmesh, ecap, opts.noinsert, opts.noswap, opts.nomove,
                 opts.nosurf, frontier=True,
-            )(sg, hausd, fr)
+            )
+            # cost doc for the SPMD sweep program, joined by the report
+            # with run_sweep_loop's "sweep" device span
+            obs_costs.capture("sweep", fn, (sg, hausd, fr))
+            out, stats, fro = fn(sg, hausd, fr)
             fr_cell[0] = fro
         else:
-            out, stats = _spmd_sweep_fn(
+            fn = _spmd_sweep_fn(
                 dmesh, ecap, opts.noinsert, opts.noswap, opts.nomove,
                 opts.nosurf,
-            )(sg, hausd)
+            )
+            obs_costs.capture("sweep", fn, (sg, hausd))
+            out, stats = fn(sg, hausd)
         if fs is not None:
             # device-resident validation (psum status inside the
             # shard_map): a poisoned shard is caught HERE, before its
@@ -553,6 +559,12 @@ def interp_phase(st: Mesh, old: Mesh,
         cw = -1.0  # no feature detection: nothing counts as cross-ridge
     else:
         cw = _math.cos(_math.radians(opts.angle))
+    # cost doc of the jitted all-shards locate+interp program, under
+    # the same name as the phase:interp device span that times it
+    obs_costs.capture(
+        "phase:interp", interp._interp_all_shards, (st, old),
+        dict(max_steps=64, surface=True, cos_wedge=cw),
+    )
     return interp.interp_stacked(st, old, cos_wedge=cw)
 
 
@@ -1003,11 +1015,13 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
             stacked, fr = _compact_aux_stacked(stacked, fr)
         else:
             stacked = jax.vmap(compact)(stacked)
+    obs_costs.record_hbm("remesh")
     stacked = fs.fire(it, "remesh", stacked)
 
     # interpolate metric + fields from the snapshot
     with tr.device_span("phase:interp", it=it):
         stacked = interp_phase(stacked, old, opts)
+    obs_costs.record_hbm("interp")
     stacked = fs.fire(it, "interp", stacked)
 
     if opts.check_comm:
@@ -1229,6 +1243,7 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
                     fr = migrate_mod.frontier_from_gid_keys(
                         stacked, fr_keys
                     ) | par_post
+        obs_costs.record_hbm("migrate")
 
     return stacked, comm, icap, fr
 

@@ -11,10 +11,17 @@ reference, extended to attribute time inside jitted/SPMD regions):
   default) it compiles down to no-op singletons.
 - `obs.metrics` — typed counters/gauges/histograms, per-rank under
   `jax.distributed`, with a rank merge so one JSON describes the world.
+- `obs.costs` — XLA cost/roofline attribution per jitted phase
+  (flops, bytes accessed, bound=compute|memory vs a per-platform peak
+  table) + HBM watermark gauges at phase boundaries.
+- `obs.history` — the PERF_DB record envelope (`schema`/`run_id`/
+  `git_sha`/`timestamp`/`platform`/`rung`), the historical-bench
+  backfill importer, and the noise-aware regression gate behind
+  `tools/perf_gate.py`.
 - `obs.report` — the post-mortem renderer behind `tools/obs_report.py`.
 """
 
-from . import metrics, report, trace  # noqa: F401
+from . import costs, history, metrics, report, trace  # noqa: F401
 from .metrics import MetricsRegistry, merge_rank_docs, registry  # noqa: F401
 from .trace import (  # noqa: F401
     NullTracer,

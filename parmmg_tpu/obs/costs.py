@@ -1,0 +1,425 @@
+"""Device cost attribution: XLA cost/roofline analytics + HBM watermarks.
+
+The PR-6 tracer answers *where the wall time goes* (span table per
+phase); this module answers *why* — which jitted phases are memory-
+vs compute-bound, and how far from the hardware roof they run. That is
+the selection instrument for the Pallas arc: hand-fusing a
+gather→compute→scatter chain only pays when the chain is memory-bound
+and far from the bandwidth roof, and the "after" kernel must prove its
+win against the numbers recorded here.
+
+Three surfaces, all host-side (nothing here is jit-reachable — the
+timing that feeds the roofline comes from the tracer's device spans,
+per lint rule PML010, never from host clocks inside traced code):
+
+- **cost capture** (`capture`/`cost_doc`): per-phase XLA cost
+  attribution via the AOT path — ``jit(...).lower(args).compile()``
+  then ``cost_analysis()`` (flops, bytes accessed) and
+  ``memory_analysis()`` (argument/output/temp/code bytes). Dispatch
+  sites call :func:`capture` with the same jit wrapper + args they are
+  about to execute; capture is once per (name, shape signature), armed
+  only while an enabled tracer with costs on is installed (the
+  ``PMMGTPU_TRACE=dir[,profile][,nocosts]`` contract — costs ride the
+  tracing opt-in, ``nocosts`` drops them), and degrades to a recorded
+  error rather than ever failing the run. Lowering never executes, so
+  donated input buffers are untouched.
+- **roofline verdicts** (`roofline`/`attribute`): arithmetic intensity
+  (flops / bytes accessed) against a small per-platform peak table
+  (:data:`PEAKS` — order-of-magnitude anchors, overridable via
+  ``PMMGTPU_PEAKS=<flops>,<bytes_per_s>``), classifying each phase
+  ``bound=compute|memory`` and, when a measured device-span time is
+  available, the achieved fraction of the binding roof.
+- **HBM watermarks** (`memory_watermark`/`record_hbm`): peak-bytes
+  snapshots at phase boundaries from ``device.memory_stats()``
+  (accelerator backends), falling back to the process peak RSS
+  (``/proc/self/status`` VmHWM) on the CPU backend whose allocator
+  draws from host RAM — recorded as ``hbm/*`` gauges in the metrics
+  registry and rendered by `obs.report` as the memory table.
+
+The shared timing helpers at the bottom (`timed_mean`,
+`chained_seconds`) are the single steady-state measurement definition
+the profiling tools (`tools/profile_ops.py`, `tools/profile_chain.py`,
+`tools/phase_times.py`) consolidate onto.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+__all__ = [
+    "PEAKS", "peaks_for", "roofline", "cost_doc", "capture",
+    "collector", "CostCollector", "load_cost_docs", "attribute",
+    "memory_watermark", "record_hbm", "timed_mean", "chained_seconds",
+]
+
+
+# ---------------------------------------------------------------------------
+# per-platform peak table
+# ---------------------------------------------------------------------------
+
+# Order-of-magnitude roofline anchors per PJRT platform name. These are
+# NOT calibrated device specs — they exist to classify phases as
+# memory- vs compute-bound (the ridge point) and to express achieved
+# throughput as a fraction of a plausible roof, which is stable under
+# 2x anchor error because the interesting phases sit 10-1000x below
+# the roof. Override with PMMGTPU_PEAKS="<flops_per_s>,<bytes_per_s>"
+# when a calibrated pair for the actual part is known.
+PEAKS: Dict[str, dict] = {
+    "tpu": dict(flops=2.0e14, bw=1.0e12,
+                label="TPU-class (~200 Tflop/s, HBM ~1 TB/s)"),
+    "gpu": dict(flops=5.0e13, bw=1.5e12,
+                label="datacenter-GPU-class (~50 Tflop/s f32, ~1.5 TB/s)"),
+    "cuda": dict(flops=5.0e13, bw=1.5e12,
+                 label="datacenter-GPU-class (~50 Tflop/s f32, ~1.5 TB/s)"),
+    "cpu": dict(flops=1.0e11, bw=2.0e10,
+                label="host-CPU-class (~100 Gflop/s, ~20 GB/s)"),
+}
+
+
+def peaks_for(platform: str) -> dict:
+    """Peak (flops/s, bytes/s) anchors for `platform`, honoring the
+    PMMGTPU_PEAKS override; unknown platforms fall back to the CPU
+    anchors (the most conservative roof)."""
+    spec = os.environ.get("PMMGTPU_PEAKS")
+    if spec:
+        try:
+            fl, bw = (float(x) for x in spec.split(",")[:2])
+            return dict(flops=fl, bw=bw, label="PMMGTPU_PEAKS override")
+        except ValueError:
+            pass
+    return PEAKS.get(platform, PEAKS["cpu"])
+
+
+def roofline(flops: float, bytes_accessed: float, seconds: float,
+             platform: str) -> dict:
+    """Roofline verdict for one program: arithmetic intensity vs the
+    platform ridge point, bound classification, and — when a measured
+    per-call `seconds` is available (a tracer device-span mean, never a
+    host clock under trace) — achieved rates as fractions of the
+    binding roof."""
+    p = peaks_for(platform)
+    ridge = p["flops"] / p["bw"]
+    out = dict(ridge=ridge, peak_flops=p["flops"], peak_bw=p["bw"])
+    if flops <= 0 and bytes_accessed <= 0:
+        out.update(intensity=0.0, bound="n/a")
+        return out
+    intensity = flops / max(bytes_accessed, 1.0)
+    bound = "compute" if intensity >= ridge else "memory"
+    out.update(intensity=intensity, bound=bound)
+    if seconds and seconds > 0:
+        achieved_flops = flops / seconds
+        achieved_bw = bytes_accessed / seconds
+        out.update(
+            seconds=seconds,
+            achieved_flops=achieved_flops,
+            achieved_bw=achieved_bw,
+            pct_peak_flops=achieved_flops / p["flops"],
+            pct_peak_bw=achieved_bw / p["bw"],
+            # fraction of the roof that binds this phase — the headroom
+            # number a kernel rewrite is judged against
+            pct_of_roof=(achieved_flops / p["flops"] if bound == "compute"
+                         else achieved_bw / p["bw"]),
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# XLA cost capture (AOT lower/compile analysis)
+# ---------------------------------------------------------------------------
+
+
+def cost_doc(fn, args=(), kwargs=None) -> dict:
+    """Static XLA cost/memory analysis of one jitted callable at the
+    given args: ``fn.lower(*args).compile()`` then ``cost_analysis()``
+    + ``memory_analysis()``. Lowering traces but never executes — safe
+    to call with buffers the subsequent real dispatch will donate."""
+    import jax
+
+    lowered = fn.lower(*args, **(kwargs or {}))
+    comp = lowered.compile()
+    ca = comp.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    doc = dict(
+        flops=float(ca.get("flops", 0.0)),
+        bytes_accessed=float(ca.get("bytes accessed", 0.0)),
+        transcendentals=float(ca.get("transcendentals", 0.0)),
+        platform=jax.devices()[0].platform,
+    )
+    ma = comp.memory_analysis()
+    if ma is not None:
+        doc.update(
+            argument_bytes=int(ma.argument_size_in_bytes),
+            output_bytes=int(ma.output_size_in_bytes),
+            temp_bytes=int(ma.temp_size_in_bytes),
+            alias_bytes=int(ma.alias_size_in_bytes),
+            code_bytes=int(ma.generated_code_size_in_bytes),
+        )
+    return doc
+
+
+def _signature(args, kwargs) -> str:
+    """Shape signature of a call: leaf (shape, dtype) pairs for arrays,
+    repr for everything else — the once-per-shape capture key."""
+    import jax
+
+    parts: List[str] = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs or {})):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{tuple(shape)}:{dtype}")
+        else:
+            parts.append(repr(leaf))
+    return "|".join(parts)
+
+
+class CostCollector:
+    """Process-global store of captured cost docs, one per span name.
+
+    A name captured at several shape signatures (capacity growth
+    re-buckets the arrays) keeps the doc with the largest
+    ``bytes_accessed`` — the dominant steady-state shape — and counts
+    the variants, so the report stays one row per phase."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._docs: Dict[str, dict] = {}
+        self._seen: set = set()
+
+    def capture(self, name: str, fn, args=(), kwargs=None) -> None:
+        key = (name, _signature(args, kwargs))
+        with self._lock:
+            if key in self._seen:
+                return
+            self._seen.add(key)
+        try:
+            doc = cost_doc(fn, args, kwargs)
+        except Exception as exc:  # never fail the run for analytics
+            doc = dict(flops=0.0, bytes_accessed=0.0,
+                       error=f"{type(exc).__name__}: {exc}")
+        with self._lock:
+            prev = self._docs.get(name)
+            if prev is None:
+                doc["variants"] = 1
+                self._docs[name] = doc
+            else:
+                doc["variants"] = prev.get("variants", 1) + 1
+                if doc.get("bytes_accessed", 0.0) >= prev.get(
+                        "bytes_accessed", 0.0):
+                    self._docs[name] = doc
+                else:
+                    prev["variants"] = doc["variants"]
+
+    def docs(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._docs.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._docs.clear()
+            self._seen.clear()
+
+    def write(self, dirpath: str, rank: int = 0) -> Optional[str]:
+        """Atomic per-rank cost-doc file in the trace directory (None
+        when nothing was captured)."""
+        docs = self.docs()
+        if not docs:
+            return None
+        path = os.path.join(dirpath, f"costs_rank{rank}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(docs, f)
+        os.replace(tmp, path)
+        return path
+
+
+_COLLECTOR = CostCollector()
+
+
+def collector() -> CostCollector:
+    return _COLLECTOR
+
+
+def capture(name: str, fn, args=(), kwargs=None) -> None:
+    """Dispatch-site hook: capture the XLA cost doc of `fn` at these
+    args under span name `name`, once per shape signature — a no-op
+    unless the installed tracer is enabled with costs armed, so
+    untraced runs pay one attribute read."""
+    from . import trace as trace_mod
+
+    tr = trace_mod.get_tracer()
+    if not (tr.enabled and getattr(tr, "costs", False)):
+        return
+    _COLLECTOR.capture(name, fn, args, kwargs)
+
+
+def load_cost_docs(dirpath: str) -> Dict[str, dict]:
+    """Merge every rank's costs_rank*.json (largest bytes_accessed doc
+    wins per name — ranks run the same programs)."""
+    import glob
+
+    merged: Dict[str, dict] = {}
+    for path in sorted(glob.glob(
+            os.path.join(dirpath, "costs_rank*.json"))):
+        with open(path) as f:
+            docs = json.load(f)
+        for name, doc in docs.items():
+            prev = merged.get(name)
+            if prev is None or doc.get("bytes_accessed", 0.0) > prev.get(
+                    "bytes_accessed", 0.0):
+                merged[name] = doc
+    return merged
+
+
+def attribute(cost_docs: Dict[str, dict], span_table: Dict[str, dict],
+              platform: Optional[str] = None) -> List[dict]:
+    """Combine captured cost docs with the tracer's measured span table
+    (per-call mean seconds = total_us / count) into one roofline row
+    per phase, sorted by bytes_accessed — the per-phase cost table
+    `obs.report` renders. Pure host arithmetic, no jax."""
+    rows: List[dict] = []
+    for name, doc in cost_docs.items():
+        span = span_table.get(name)
+        calls = int(span["count"]) if span else 0
+        mean_s = (span["total_us"] / calls / 1e6) if calls else 0.0
+        plat = platform or doc.get("platform", "cpu")
+        row = dict(
+            name=name, calls=calls, mean_s=mean_s,
+            flops=doc.get("flops", 0.0),
+            bytes_accessed=doc.get("bytes_accessed", 0.0),
+            variants=doc.get("variants", 1),
+            platform=plat,
+        )
+        if "error" in doc:
+            row["error"] = doc["error"]
+        row.update(roofline(row["flops"], row["bytes_accessed"],
+                            mean_s, plat))
+        rows.append(row)
+    rows.sort(key=lambda r: -r["bytes_accessed"])
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# HBM watermarks
+# ---------------------------------------------------------------------------
+
+
+def memory_watermark() -> Optional[dict]:
+    """Current device-memory watermark: ``device.memory_stats()`` where
+    the backend reports it (TPU/GPU HBM), else the process RSS /
+    peak-RSS from /proc (the CPU backend allocates from host RAM, so
+    VmHWM is the honest peak there). None when neither is readable."""
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        in_use = int(stats.get("bytes_in_use", 0))
+        return dict(
+            source="device",
+            bytes_in_use=in_use,
+            peak_bytes=int(stats.get("peak_bytes_in_use", in_use)),
+            bytes_limit=int(stats.get("bytes_limit", 0)),
+        )
+    try:
+        rss = peak = 0
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss = int(line.split()[1]) * 1024
+                elif line.startswith("VmHWM:"):
+                    peak = int(line.split()[1]) * 1024
+        if peak or rss:
+            return dict(source="host_rss", bytes_in_use=rss,
+                        peak_bytes=max(peak, rss), bytes_limit=0)
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def record_hbm(phase: Optional[str] = None) -> Optional[dict]:
+    """Phase-boundary HBM snapshot into the metrics registry (always
+    on, like every other metric — one stats call per boundary):
+
+    - ``hbm/peak_bytes``: monotone run-wide peak;
+    - ``hbm/bytes_in_use``: last boundary's live bytes;
+    - ``hbm/limit_bytes``: the device's reported capacity (0 unknown);
+    - ``hbm/device_source``: 1 when read from device.memory_stats(),
+      0 for the host-RSS fallback;
+    - ``hbm/phase_bytes/<phase>``: max live bytes observed at this
+      phase's boundaries (the per-phase watermark the report renders).
+    """
+    w = memory_watermark()
+    if w is None:
+        return None
+    from . import metrics as metrics_mod
+
+    reg = metrics_mod.registry()
+    g = reg.gauge("hbm/peak_bytes")
+    g.set(max(g.value, float(w["peak_bytes"])))
+    reg.gauge("hbm/bytes_in_use").set(float(w["bytes_in_use"]))
+    reg.gauge("hbm/limit_bytes").set(float(w.get("bytes_limit", 0)))
+    reg.gauge("hbm/device_source").set(
+        1.0 if w["source"] == "device" else 0.0
+    )
+    if phase:
+        pg = reg.gauge(f"hbm/phase_bytes/{phase}")
+        pg.set(max(pg.value, float(w["bytes_in_use"])))
+    return w
+
+
+# ---------------------------------------------------------------------------
+# shared steady-state timing (the profiler consolidation surface)
+# ---------------------------------------------------------------------------
+
+
+def timed_mean(fn, reps: int = 5) -> float:
+    """Warm once (compile), then mean wall seconds per call over `reps`
+    fully-synchronized calls — the single steady-state timing
+    definition shared by the profiling tools. Host-side harness code
+    only: timings INSIDE traced programs come from tracer device
+    spans (PML010)."""
+    import time
+
+    import jax
+
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(fn())
+    return (time.perf_counter() - t0) / reps
+
+
+def chained_seconds(step, carry, reps: int = 20) -> float:
+    """Per-iteration seconds of `step` run `reps` times inside ONE
+    jitted `lax.fori_loop` with `carry` as the loop state (true data
+    dependency) — real device compute on backends whose
+    block_until_ready does not synchronize (the remote TPU tunnel).
+    `step(carry) -> carry`. The shared engine of
+    tools/profile_chain.py."""
+    import time
+
+    import jax
+
+    @jax.jit
+    def run(c):
+        return jax.lax.fori_loop(0, reps, lambda i, cc: step(cc), c)
+
+    def force(out):
+        # a SCALAR device_get, not block_until_ready: the remote-tunnel
+        # backend returns from block_until_ready before the chain has
+        # executed — pulling one element is a true synchronization
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return jax.device_get(leaf.ravel()[0])
+
+    force(run(carry))
+    t0 = time.perf_counter()
+    force(run(carry))
+    return (time.perf_counter() - t0) / reps

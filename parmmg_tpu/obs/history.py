@@ -1,0 +1,375 @@
+"""Perf-history record envelope, PERF_DB store, and the regression gate.
+
+Before this module the bench trajectory was unreadable as data: six
+``BENCH_r0*.json`` files (two different shapes — a driver wrapper with
+``parsed``/``tail`` and raw records) plus ``SCALE_RUNS.jsonl`` shared
+no record envelope, so no tool could answer "did PR N regress phase X".
+Three pieces fix that:
+
+- **envelope** (:func:`make_record`): every record — full or partial —
+  carries ``schema`` / ``run_id`` / ``git_sha`` / ``timestamp`` /
+  ``platform`` / ``rung`` stamped by ONE constructor. `bench.py` and
+  `tools/scale_run.py` route both their worker-committed and
+  parent-synthesized partial records through it, so the two paths can
+  never drift apart again.
+- **PERF_DB** (:func:`append_db`/:func:`load_db`): an append-only
+  JSONL of enveloped records, one line per measurement, plus the
+  backfill importer (:func:`backfill_records`) that normalizes the
+  historical ``BENCH_r01–r06`` + ``SCALE_RUNS.jsonl`` into it —
+  git-archaeology fills ``git_sha``/``timestamp`` from the commit that
+  added each file, and the workload rung is inferred from the output
+  element count via the bench's own sizing formula.
+- **gate** (:func:`gate`): a noise-aware regression verdict — per
+  metric key, rolling median ± MAD-scaled tolerance over the last
+  `window` non-partial records of the same (platform, rung, metric)
+  group. MAD (scaled by 1.4826 to estimate sigma) absorbs the shared-
+  TPU run-to-run swings; the relative floor keeps a zero-MAD group
+  (single baseline) from gating at zero tolerance. One-sided per key:
+  only the bad direction (lower value, higher wall) regresses, so
+  improvements always pass and ratchet the baseline when appended
+  (``tools/perf_gate.py --update-baseline``).
+
+Pure stdlib + git subprocess — safe to import from tools that must not
+touch the accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+import uuid
+from typing import Dict, List, Optional
+
+__all__ = [
+    "SCHEMA", "REGRESSION_EXIT", "GATE_KEYS", "make_record", "git_sha",
+    "append_db", "load_db", "normalize", "infer_rung",
+    "backfill_records", "gate", "GateResult",
+]
+
+SCHEMA = "parmmg-perfdb/1"
+
+# typed exit code of the gate CLI on a detected regression (the
+# failsafe taxonomy owns 86-89; 91 is the perf-gate verdict)
+REGRESSION_EXIT = 91
+
+# gated metric keys and their good direction: "higher" regresses when
+# the candidate falls below median - tol, "lower" when it rises above
+# median + tol. Keys absent from a record or its baseline are skipped.
+GATE_KEYS: Dict[str, str] = {
+    "value": "higher",
+    "wall_s": "lower",
+    "steady_recompiles": "lower",
+    "qmin": "higher",
+}
+
+_ENVELOPE = ("schema", "run_id", "git_sha", "timestamp", "platform",
+             "rung")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+_GIT_SHA_CACHE: List[Optional[str]] = []
+
+
+def _git(args: List[str], cwd: str) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git"] + args, capture_output=True, text=True, cwd=cwd,
+            timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    val = out.stdout.strip()
+    return val if out.returncode == 0 and val else None
+
+
+def git_sha() -> str:
+    """HEAD sha of the repo this module lives in (cached; env override
+    PMMGTPU_GIT_SHA for detached/archival runs; "unknown" when git is
+    unavailable)."""
+    env = os.environ.get("PMMGTPU_GIT_SHA")
+    if env:
+        return env
+    if not _GIT_SHA_CACHE:
+        _GIT_SHA_CACHE.append(
+            _git(["rev-parse", "HEAD"], _repo_root()) or "unknown"
+        )
+    return _GIT_SHA_CACHE[0] or "unknown"
+
+
+def make_record(payload: dict, rung: Optional[str] = None,
+                platform: Optional[str] = None,
+                run_id: Optional[str] = None,
+                sha: Optional[str] = None,
+                timestamp: Optional[str] = None) -> dict:
+    """The one record constructor: envelope fields first, then the
+    payload (payload keys win over inferred envelope values except
+    ``schema``). Stamps full AND partial records — a record without
+    this envelope cannot enter PERF_DB."""
+    rec = dict(
+        schema=SCHEMA,
+        run_id=run_id or uuid.uuid4().hex[:12],
+        git_sha=sha or git_sha(),
+        timestamp=timestamp or time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        platform=payload.get("platform", platform or "unknown"),
+        # explicit rung > inferred; a legacy payload "rung" tag (the
+        # old SCALE_RUNS ladder letters) is consumed by infer_rung, not
+        # copied verbatim — the envelope owns this key
+        rung=rung or infer_rung(payload),
+    )
+    rec.update({k: v for k, v in payload.items()
+                if k not in ("schema", "rung")})
+    rec["platform"] = rec.get("platform") or "unknown"
+    return rec
+
+
+def append_db(path: str, rec: dict) -> None:
+    """Append one enveloped record line (the DB is append-only; no
+    rewrite, no compaction — history is the point)."""
+    if rec.get("schema") != SCHEMA:
+        rec = make_record(rec)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+
+
+def load_db(path: str) -> List[dict]:
+    """All parseable record lines (a truncated tail line — a killed
+    appender — is skipped, like the tracer's timeline loader)."""
+    recs: List[dict] = []
+    if not os.path.exists(path):
+        return recs
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                recs.append(rec)
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# normalization + backfill of the historical trajectory
+# ---------------------------------------------------------------------------
+
+# the bench ladder's workload classes: hsiz -> (n, est output tets via
+# bench.est_out_tets = 12/hsiz^3). Used ONLY to label historical bare
+# records with the rung they came from; new records carry their rung
+# explicitly from the tool that measured them.
+_RUNG_CLASSES = (
+    ("n10-hsiz0.05", 12.0 / 0.05**3),
+    ("n12-hsiz0.04", 12.0 / 0.04**3),
+    ("n14-hsiz0.03", 12.0 / 0.03**3),
+    ("n16-hsiz0.02", 12.0 / 0.02**3),
+)
+
+
+def infer_rung(rec: dict) -> str:
+    """Best-effort rung label for a bare (pre-envelope) record: dist
+    records key on nparts, cold scale records keep their own rung tag,
+    headline records map the output tet count onto the nearest bench
+    workload class."""
+    metric = rec.get("metric", "")
+    if rec.get("nparts") or metric.endswith("_distributed"):
+        return f"dist-p{rec.get('nparts', '?')}"
+    if "rung" in rec:
+        return f"xl-{rec['rung']}"
+    ne = rec.get("ne")
+    if not ne:
+        return rec.get("stage", "unknown")
+    best = min(_RUNG_CLASSES, key=lambda c: abs(ne - c[1]) / c[1])
+    return best[0]
+
+
+def normalize(rec: dict, **env) -> dict:
+    """Normalize any historical record shape into one enveloped record:
+    already-enveloped records pass through untouched (idempotent), bare
+    records get stamped, BENCH driver wrappers are unwrapped by the
+    caller (they may hold several records — see backfill_records)."""
+    if rec.get("schema") == SCHEMA:
+        return rec
+    return make_record(rec, **env)
+
+
+def _wrapper_records(doc: dict) -> List[dict]:
+    """Records inside one BENCH driver wrapper ({n, cmd, rc, tail,
+    parsed}): every JSON line in the tail (r04 carried two), else the
+    parsed record, else one synthesized partial that keeps the blind
+    round visible in the trajectory (r01/r03's rc=124-with-nothing)."""
+    recs: List[dict] = []
+    for line in (doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    if not recs and doc.get("parsed"):
+        recs.append(doc["parsed"])
+    if not recs:
+        recs.append({
+            "metric": "tets_per_sec", "value": 0.0, "unit": "tet/s",
+            "partial": True, "platform": "unknown",
+            "error": f"no record committed (driver rc={doc.get('rc')})",
+        })
+    return recs
+
+
+def backfill_records(repo_dir: str) -> List[dict]:
+    """Normalize the historical trajectory under `repo_dir` —
+    ``BENCH_r*.json`` (driver wrappers AND raw records) +
+    ``SCALE_RUNS.jsonl`` — into enveloped records. ``git_sha`` /
+    ``timestamp`` come from the commit that last touched each source
+    file (the measurement landed with that commit); ``run_id`` is the
+    deterministic source tag so re-running the backfill is
+    reproducible."""
+    import glob
+
+    out: List[dict] = []
+
+    def _env_for(path: str) -> dict:
+        sha = _git(["log", "-1", "--format=%H", "--", os.path.basename(
+            path)], repo_dir)
+        ts = _git(["log", "-1", "--format=%cI", "--",
+                   os.path.basename(path)], repo_dir)
+        return dict(sha=sha or git_sha(), timestamp=ts)
+
+    for path in sorted(glob.glob(os.path.join(repo_dir,
+                                              "BENCH_r*.json"))):
+        tag = os.path.splitext(os.path.basename(path))[0].lower()
+        env = _env_for(path)
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except json.JSONDecodeError:
+                continue
+        if "cmd" in doc and "tail" in doc:
+            recs = _wrapper_records(doc)
+        else:
+            recs = [doc]  # raw record file (r06 shape)
+        for i, rec in enumerate(recs):
+            rid = tag if len(recs) == 1 else f"{tag}.{i}"
+            out.append(normalize(rec, run_id=rid, **env))
+
+    scale = os.path.join(repo_dir, "SCALE_RUNS.jsonl")
+    if os.path.exists(scale):
+        env = _env_for(scale)
+        for i, rec in enumerate(load_db(scale)):
+            out.append(normalize(rec, run_id=f"scale-runs.{i}", **env))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the noise-aware regression gate
+# ---------------------------------------------------------------------------
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _group_key(rec: dict) -> tuple:
+    return (rec.get("platform", "unknown"), rec.get("rung", "unknown"),
+            rec.get("metric", "unknown"))
+
+
+class GateResult:
+    """Structured gate verdict: per-key rows plus the overall call."""
+
+    def __init__(self, group: tuple, baseline_n: int):
+        self.group = group
+        self.baseline_n = baseline_n
+        self.rows: List[dict] = []
+
+    @property
+    def regressions(self) -> List[str]:
+        return [r["key"] for r in self.rows if r["verdict"] == "REGRESS"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions
+
+    @property
+    def no_baseline(self) -> bool:
+        return self.baseline_n == 0
+
+    def lines(self) -> List[str]:
+        plat, rung, metric = self.group
+        out = [f"[perf-gate] platform={plat} rung={rung} "
+               f"metric={metric} baseline_n={self.baseline_n}"]
+        for r in self.rows:
+            out.append(
+                f"  {r['key']:<18s} {r['candidate']:>12.4g} vs median "
+                f"{r['median']:>12.4g} (tol ±{r['tol']:.4g})  "
+                f"{r['verdict']}"
+            )
+        if self.no_baseline:
+            out.append("  (no baseline for this group yet — record "
+                       "admitted; gate arms on the next run)")
+        out.append(
+            f"[perf-gate] {'OK' if self.ok else 'REGRESSION: ' + ','.join(self.regressions)}"
+        )
+        return out
+
+
+def gate(db: List[dict], rec: dict, window: int = 8,
+         rel_floor: float = 0.5, mad_k: float = 4.0) -> GateResult:
+    """Gate `rec` against its rolling baseline in `db`.
+
+    Baseline = the last `window` non-partial records sharing the
+    candidate's (platform, rung, metric) group — falling back to
+    (platform, metric) when the exact rung has no history, so a renamed
+    rung degrades to a coarser baseline instead of gating nothing. Per
+    gated key the tolerance is ``max(mad_k * 1.4826 * MAD, rel_floor *
+    |median|)`` and only the bad direction regresses. A partial
+    candidate is never gated on its zeroed measurement keys (its
+    partial-ness already exits nonzero at the tool that produced it) —
+    it reports SKIP rows instead."""
+    rec = normalize(rec)
+    key = _group_key(rec)
+    base = [r for r in db
+            if _group_key(r) == key and not r.get("partial")]
+    if not base:
+        base = [r for r in db
+                if (r.get("platform"), r.get("metric")) == (key[0], key[2])
+                and not r.get("partial")]
+    base = base[-window:]
+    res = GateResult(key, len(base))
+    partial = bool(rec.get("partial"))
+    for mkey, direction in GATE_KEYS.items():
+        if mkey not in rec:
+            continue
+        vals = [float(r[mkey]) for r in base
+                if isinstance(r.get(mkey), (int, float))]
+        if not vals:
+            continue
+        cand = float(rec[mkey])
+        med = _median(vals)
+        mad = _median([abs(v - med) for v in vals])
+        tol = max(mad_k * 1.4826 * mad, rel_floor * abs(med))
+        if partial:
+            verdict = "SKIP(partial)"
+        elif direction == "higher":
+            verdict = "REGRESS" if cand < med - tol else "OK"
+        else:
+            verdict = "REGRESS" if cand > med + tol else "OK"
+        res.rows.append(dict(key=mkey, candidate=cand, median=med,
+                             mad=mad, tol=tol, direction=direction,
+                             verdict=verdict))
+    return res

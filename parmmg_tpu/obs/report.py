@@ -7,6 +7,13 @@ snapshots) — and renders the post-mortem a run operator wants first:
 
 - phase breakdown: wall time per span name (count / total / mean),
   top-level phases separated from nested op spans;
+- cost attribution (``costs_rank*.json``, captured by `obs.costs` on
+  traced runs): per-phase flops / bytes accessed / arithmetic
+  intensity and the roofline verdict (bound=compute|memory, achieved
+  fraction of the binding roof) using the MEASURED device-span mean as
+  the per-call time;
+- memory: HBM watermark gauges (run-wide peak bytes, live bytes per
+  phase boundary, device vs host-RSS source);
 - operator acceptance: candidates offered vs accepted per operator;
 - comm / migration / checkpoint volume (collectives, cells moved,
   payload and checkpoint bytes, store retry and latency summary);
@@ -25,6 +32,7 @@ import json
 import os
 from typing import Dict, List, Optional
 
+from . import costs as costs_mod
 from . import metrics as metrics_mod
 
 __all__ = ["load_trace_events", "load_timeline", "summarize", "render"]
@@ -94,6 +102,23 @@ def summarize(dirpath: str) -> dict:
         for k, v in gauges.items()
         if k.startswith("sweep_active_fraction/shard")
     }
+    # cost attribution: captured XLA cost docs x measured span means
+    cost_docs = costs_mod.load_cost_docs(dirpath)
+    cost_rows = costs_mod.attribute(cost_docs, spans)
+    # HBM watermarks from the hbm/* gauges (obs.costs.record_hbm)
+    phase_bytes = {
+        k[len("hbm/phase_bytes/"):]: _gval(v)
+        for k, v in gauges.items() if k.startswith("hbm/phase_bytes/")
+    }
+    memory = dict(
+        peak_bytes=_gval(gauges.get("hbm/peak_bytes", 0.0)),
+        bytes_in_use=_gval(gauges.get("hbm/bytes_in_use", 0.0)),
+        limit_bytes=_gval(gauges.get("hbm/limit_bytes", 0.0)),
+        source=("device"
+                if _gval(gauges.get("hbm/device_source", 0.0))
+                else "host_rss"),
+        phase_bytes=phase_bytes,
+    )
     ops = {}
     for op in ("split", "collapse", "swap"):
         ops[op] = counters.get(f"ops/{op}_accepted", 0)
@@ -103,6 +128,8 @@ def summarize(dirpath: str) -> dict:
         dir=dirpath,
         n_spans=sum(r["count"] for r in spans.values()),
         spans=spans,
+        costs=cost_rows,
+        memory=memory,
         ops=dict(
             accepted=accepted,
             accepted_per_op=ops,
@@ -151,6 +178,15 @@ def _fmt_us(us: int) -> str:
     return f"{us / 1e3:9.3f} ms"
 
 
+def _fmt_qty(x: float) -> str:
+    """Engineering-style quantity (flops, bytes): 1.23G, 45.6M, 789."""
+    for thresh, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                           (1e3, "k")):
+        if abs(x) >= thresh:
+            return f"{x / thresh:.2f}{suffix}"
+    return f"{x:.0f}"
+
+
 def _fmt_bytes(n: int) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if n < 1024 or unit == "GiB":
@@ -177,6 +213,52 @@ def render(dirpath: str) -> str:
             f"total {_fmt_us(row['total_us'])}  "
             f"max {_fmt_us(row['max_us'])}"
         )
+
+    lines.append("")
+    lines.append("-- cost attribution (roofline per jitted phase) --")
+    if not s["costs"]:
+        lines.append("   (no cost docs captured — trace with costs "
+                     "armed: PMMGTPU_TRACE=<dir> without ,nocosts)")
+    else:
+        lines.append(
+            f"   {'phase':<20s} {'calls':>5s} {'mean/call':>11s} "
+            f"{'flops':>9s} {'bytes':>9s} {'F/B':>7s} {'%roof':>7s} "
+            f"bound"
+        )
+        for r in s["costs"]:
+            if r.get("error"):
+                lines.append(f"   {r['name']:<20s}  (capture failed: "
+                             f"{r['error']})")
+                continue
+            pct = (f"{r['pct_of_roof']:.2%}" if "pct_of_roof" in r
+                   else "-")
+            mean = _fmt_us(int(r["mean_s"] * 1e6)) if r["calls"] else "  (no span)"
+            lines.append(
+                f"   {r['name']:<20s} x{r['calls']:<4d} {mean:>11s} "
+                f"{_fmt_qty(r['flops']):>9s} "
+                f"{_fmt_qty(r['bytes_accessed']):>9s} "
+                f"{r['intensity']:>7.2f} {pct:>7s} {r['bound']}"
+            )
+
+    m = s["memory"]
+    lines.append("")
+    lines.append("-- memory (HBM watermarks) --")
+    if m["peak_bytes"]:
+        limit = (f" of {_fmt_bytes(int(m['limit_bytes']))}"
+                 if m["limit_bytes"] else "")
+        lines.append(
+            f"   HBM peak bytes {_fmt_bytes(int(m['peak_bytes']))}"
+            f"{limit}  in use {_fmt_bytes(int(m['bytes_in_use']))}  "
+            f"(source: {m['source']})"
+        )
+        if m["phase_bytes"]:
+            cells = "  ".join(
+                f"{ph} {_fmt_bytes(int(v))}"
+                for ph, v in sorted(m["phase_bytes"].items())
+            )
+            lines.append(f"   per phase boundary: {cells}")
+    else:
+        lines.append("   (no watermark gauges recorded)")
 
     o = s["ops"]
     lines.append("")

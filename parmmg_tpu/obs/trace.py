@@ -27,12 +27,15 @@ story:
 
 Env contract::
 
-  PMMGTPU_TRACE=<dir>[,profile]
+  PMMGTPU_TRACE=<dir>[,profile][,nocosts]
 
 ``<dir>`` receives ``trace.json`` + ``events.jsonl`` +
 ``metrics_rank<r>.json`` (one per process under `jax.distributed`);
 ``,profile`` additionally opens a `jax.profiler` capture window for the
 tracer's lifetime, writing the device profile under the same directory.
+Traced runs also capture per-phase XLA cost docs (`obs.costs`, written
+as ``costs_rank<r>.json``); ``,nocosts`` opts out of that capture's
+extra AOT lower/compile per entry point.
 
 The process-global tracer is resolved once from the environment
 (`get_tracer`); drivers accept an explicit ``tracer=`` argument which
@@ -79,6 +82,7 @@ class NullTracer:
 
     enabled = False
     dir: Optional[str] = None
+    costs = False
 
     def span(self, name, **args):
         return _NULL_SPAN
@@ -150,9 +154,14 @@ class Tracer:
     enabled = True
 
     def __init__(self, dirpath: str, profile: bool = False,
-                 rank: Optional[int] = None):
+                 rank: Optional[int] = None, costs: bool = True):
         self.dir = dirpath
         os.makedirs(dirpath, exist_ok=True)
+        # XLA cost capture (obs.costs): traced runs record per-phase
+        # cost docs by default — one extra AOT lower/compile per
+        # (entry point, shape signature); `,nocosts` opts out when the
+        # trace must stay compile-cheap
+        self.costs = bool(costs)
         self.rank = self._rank() if rank is None else int(rank)
         self._t0 = time.perf_counter_ns()
         self._lock = threading.Lock()
@@ -278,9 +287,13 @@ class Tracer:
         with open(tmp, "w") as f:
             json.dump(doc, f)
         os.replace(tmp, path)
+        from . import costs as _costs
         from . import metrics as _metrics
 
         _metrics.registry().write(self.dir, rank=self.rank)
+        # captured XLA cost docs land beside the metrics (no file when
+        # nothing was captured — e.g. `,nocosts` runs)
+        _costs.collector().write(self.dir, rank=self.rank)
         if self._profiling:
             try:
                 import jax
@@ -302,14 +315,16 @@ _STATE_LOCK = threading.Lock()
 
 
 def from_env() -> object:
-    """Tracer per the PMMGTPU_TRACE contract (``dir[,profile]``), or
-    the shared NullTracer when unset."""
+    """Tracer per the PMMGTPU_TRACE contract
+    (``dir[,profile][,nocosts]``), or the shared NullTracer when
+    unset."""
     spec = os.environ.get("PMMGTPU_TRACE")
     if not spec:
         return _NULL
     parts = [p.strip() for p in spec.split(",")]
     dirpath, flags = parts[0], parts[1:]
-    return Tracer(dirpath, profile="profile" in flags)
+    return Tracer(dirpath, profile="profile" in flags,
+                  costs="nocosts" not in flags)
 
 
 def get_tracer() -> object:

@@ -673,6 +673,15 @@ def migrate(stacked: Mesh, color: jax.Array, nparts: int,
     growth decision)."""
     tria_cap = slot_cap + 8
     edge_cap = max(slot_cap // 2, 64)
+    # cost doc for the exchange's pack program (the bandwidth-dominant
+    # leg of the migration — the integrate side is a vmapped scatter of
+    # the same payload), under the migrate_exchange device-span name
+    from ..obs import costs as obs_costs
+
+    obs_costs.capture(
+        "migrate_exchange", _pack, (stacked, color),
+        dict(slot_cap=slot_cap, tria_cap=tria_cap, edge_cap=edge_cap),
+    )
     (bti, btf, bfi, bei, tria_keep, edge_keep, pack_n), out_t = jit_retry(
         _pack, stacked, color, slot_cap, tria_cap, edge_cap
     )

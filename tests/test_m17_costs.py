@@ -1,0 +1,380 @@
+"""M17: device cost attribution + perf-history regression gate.
+
+Covers the PR-8 tentpole contracts:
+- XLA cost-doc schema via the AOT path on a tiny jitted fn (flops /
+  bytes accessed / memory_analysis sizes present and positive);
+- roofline classification math on synthetic flops/bytes fixtures
+  (ridge point, bound verdict, achieved fraction of the binding roof);
+- PERF_DB envelope stamping: `obs.history.make_record` populates every
+  envelope field, and `bench.partial_record` routes through the SAME
+  constructor as the full records (the two-dict drift bugfix);
+- backfill of a fixture BENCH dir (wrapper with multi-line tail, blind
+  wrapper, raw record, SCALE_RUNS lines);
+- gate pass / regress / ratchet behavior with seeded noise;
+- HBM watermark gauges + captured cost docs + report cost/memory
+  sections on one shared tiny traced adapt run.
+"""
+
+import json
+import random
+
+import pytest
+
+from parmmg_tpu.obs import costs as obs_costs
+from parmmg_tpu.obs import history as obs_history
+from parmmg_tpu.obs import metrics as obs_metrics
+from parmmg_tpu.obs import report as obs_report
+from parmmg_tpu.obs import trace as obs_trace
+
+
+# --- cost docs ------------------------------------------------------------
+
+
+def test_cost_doc_schema_tiny_jit():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: (x @ x.T).sum(axis=0) * 2.0)
+    doc = obs_costs.cost_doc(f, (jnp.ones((48, 48), jnp.float32),))
+    assert doc["flops"] > 0
+    assert doc["bytes_accessed"] > 0
+    for key in ("transcendentals", "argument_bytes", "output_bytes",
+                "temp_bytes", "code_bytes", "platform"):
+        assert key in doc, (key, sorted(doc))
+    assert doc["argument_bytes"] >= 48 * 48 * 4
+    assert doc["platform"] == "cpu"
+
+
+def test_capture_once_per_signature_and_requires_armed_tracer(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2.0)
+    col = obs_costs.collector()
+    col.reset()
+    # no tracer installed: capture must be inert
+    obs_costs.capture("twice", f, (jnp.ones(8),))
+    assert "twice" not in col.docs()
+    tr = obs_trace.Tracer(str(tmp_path))
+    prev = obs_trace.install(tr)
+    try:
+        obs_costs.capture("twice", f, (jnp.ones(8),))
+        obs_costs.capture("twice", f, (jnp.ones(8),))       # same sig
+        obs_costs.capture("twice", f, (jnp.ones(16),))      # new sig
+        docs = col.docs()
+        assert docs["twice"]["variants"] == 2
+        # the larger-bytes variant wins the stored doc
+        assert docs["twice"]["bytes_accessed"] >= 16 * 4
+        tr.flush()
+        on_disk = obs_costs.load_cost_docs(str(tmp_path))
+        assert "twice" in on_disk
+    finally:
+        obs_trace.install(prev)
+        col.reset()
+
+
+def test_capture_failure_never_raises(tmp_path):
+    tr = obs_trace.Tracer(str(tmp_path))
+    prev = obs_trace.install(tr)
+    col = obs_costs.collector()
+    col.reset()
+    try:
+        obs_costs.capture("broken", object(), (1,))  # no .lower
+        doc = col.docs()["broken"]
+        assert "error" in doc and doc["flops"] == 0.0
+    finally:
+        obs_trace.install(prev)
+        col.reset()
+
+
+# --- roofline math --------------------------------------------------------
+
+
+def test_roofline_classification_synthetic():
+    p = obs_costs.peaks_for("cpu")
+    ridge = p["flops"] / p["bw"]
+    # intensity 10x above the ridge: compute-bound
+    r = obs_costs.roofline(flops=ridge * 10 * 1e6, bytes_accessed=1e6,
+                           seconds=0.0, platform="cpu")
+    assert r["bound"] == "compute"
+    assert r["intensity"] == pytest.approx(ridge * 10)
+    assert r["ridge"] == pytest.approx(ridge)
+    # intensity 10x below: memory-bound
+    r = obs_costs.roofline(flops=ridge * 0.1 * 1e6, bytes_accessed=1e6,
+                           seconds=0.0, platform="cpu")
+    assert r["bound"] == "memory"
+    # measured seconds: achieved fractions of the binding roof
+    r = obs_costs.roofline(flops=1e6, bytes_accessed=1e9, seconds=0.1,
+                           platform="cpu")
+    assert r["bound"] == "memory"
+    assert r["achieved_bw"] == pytest.approx(1e10)
+    assert r["pct_peak_bw"] == pytest.approx(1e10 / p["bw"])
+    assert r["pct_of_roof"] == pytest.approx(r["pct_peak_bw"])
+    # degenerate: no flops, no bytes
+    assert obs_costs.roofline(0, 0, 0, "cpu")["bound"] == "n/a"
+
+
+def test_roofline_peaks_env_override(monkeypatch):
+    monkeypatch.setenv("PMMGTPU_PEAKS", "2e12,1e11")
+    p = obs_costs.peaks_for("tpu")
+    assert p["flops"] == 2e12 and p["bw"] == 1e11
+    monkeypatch.delenv("PMMGTPU_PEAKS")
+    assert obs_costs.peaks_for("nosuch") == obs_costs.PEAKS["cpu"]
+
+
+# --- envelope -------------------------------------------------------------
+
+
+def test_make_record_envelope_fields():
+    rec = obs_history.make_record(
+        dict(metric="m", value=1.0, platform="cpu"), rung="r1"
+    )
+    assert rec["schema"] == obs_history.SCHEMA
+    for key in ("run_id", "git_sha", "timestamp", "platform", "rung"):
+        assert rec.get(key), key
+    assert rec["rung"] == "r1" and rec["platform"] == "cpu"
+    # timestamp is ISO-8601 UTC
+    import time as _t
+
+    _t.strptime(rec["timestamp"], "%Y-%m-%dT%H:%M:%SZ")
+    # idempotent normalization: an enveloped record passes through
+    assert obs_history.normalize(rec) is rec
+
+
+def test_bench_partial_record_carries_envelope():
+    """The bugfix contract: parent-synthesized partials and worker
+    records are built by ONE constructor, so a partial carries the
+    same envelope fields as a full record."""
+    import bench
+
+    pr = bench.partial_record(dict(n=10, hsiz=0.05),
+                              died_in="steady:sweeps", reason="test")
+    assert pr["schema"] == obs_history.SCHEMA
+    for key in ("run_id", "git_sha", "timestamp", "platform", "rung"):
+        assert pr.get(key), key
+    assert pr["partial"] is True
+    assert pr["rung"] == "n10-hsiz0.05"
+    assert pr["died_in"] == "steady:sweeps"
+    # dist configs group under the dist rung with the dist metric
+    pd = bench.partial_record(dict(dist=True, n=8, hsiz=0.08, nparts=2))
+    assert pd["rung"] == "dist-p2"
+    assert pd["metric"] == "tets_per_sec_distributed"
+
+
+def test_infer_rung_maps_historical_records():
+    assert obs_history.infer_rung(dict(ne=93788)) == "n10-hsiz0.05"
+    assert obs_history.infer_rung(dict(ne=232546)) == "n12-hsiz0.04"
+    assert obs_history.infer_rung(
+        dict(metric="tets_per_sec_distributed", nparts=2)
+    ) == "dist-p2"
+    assert obs_history.infer_rung(
+        dict(metric="tets_per_sec_cold", rung="m")
+    ) == "xl-m"
+
+
+# --- backfill -------------------------------------------------------------
+
+
+def test_backfill_fixture_bench_dir(tmp_path):
+    # wrapper with a 2-record tail (the r04 shape)
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(dict(
+        n=1, cmd="python bench.py", rc=124,
+        tail=json.dumps(dict(metric="tets_per_sec", value=100.0,
+                             ne=93788, wall_s=9.0, platform="tpu"))
+        + "\n"
+        + json.dumps(dict(metric="tets_per_sec", value=120.0,
+                          ne=232546, wall_s=19.0, platform="tpu"))
+        + "\n",
+        parsed=None,
+    )))
+    # blind wrapper (the r01/r03 shape): synthesized partial
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(dict(
+        n=2, cmd="python bench.py", rc=124, tail="", parsed=None,
+    )))
+    # raw record file (the r06 shape)
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(dict(
+        metric="tets_per_sec_distributed", value=542.6, ne=20024,
+        nparts=2, wall_s=36.9, platform="cpu",
+    )))
+    (tmp_path / "SCALE_RUNS.jsonl").write_text(json.dumps(dict(
+        metric="tets_per_sec_cold", value=1651.5, ne=333679,
+        wall_s=202.0, platform="tpu", rung="m",
+    )) + "\n")
+    recs = obs_history.backfill_records(str(tmp_path))
+    assert len(recs) == 5
+    for rec in recs:
+        for key in ("schema", "run_id", "git_sha", "timestamp",
+                    "platform", "rung"):
+            assert rec.get(key), (key, rec)
+    by_id = {r["run_id"]: r for r in recs}
+    assert by_id["bench_r01.0"]["rung"] == "n10-hsiz0.05"
+    assert by_id["bench_r01.1"]["rung"] == "n12-hsiz0.04"
+    assert by_id["bench_r02"]["partial"] is True
+    assert by_id["bench_r03"]["rung"] == "dist-p2"
+    assert by_id["scale-runs.0"]["rung"] == "xl-m"
+
+
+def test_repo_perf_db_backfilled():
+    """Acceptance: the committed PERF_DB.jsonl holds the normalized
+    historical trajectory — >= 7 records, every envelope field
+    populated."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PERF_DB.jsonl")
+    recs = obs_history.load_db(path)
+    assert len(recs) >= 7, len(recs)
+    for rec in recs:
+        for key in ("schema", "run_id", "git_sha", "timestamp",
+                    "platform"):
+            assert rec.get(key), (key, rec.get("run_id"))
+
+
+# --- gate -----------------------------------------------------------------
+
+
+def _noisy_db(tmp_path, n=6, seed=0, value=1000.0, wall=10.0):
+    rng = random.Random(seed)
+    path = tmp_path / "db.jsonl"
+    for i in range(n):
+        obs_history.append_db(str(path), obs_history.make_record(dict(
+            metric="m",
+            value=value * (1 + rng.uniform(-0.02, 0.02)),
+            wall_s=wall * (1 + rng.uniform(-0.05, 0.05)),
+            platform="cpu",
+        ), rung="g", run_id=f"base.{i}"))
+    return str(path)
+
+
+def test_gate_pass_within_noise(tmp_path):
+    db = obs_history.load_db(_noisy_db(tmp_path))
+    cand = obs_history.make_record(dict(
+        metric="m", value=990.0, wall_s=10.4, platform="cpu",
+    ), rung="g")
+    res = obs_history.gate(db, cand)
+    assert res.ok and res.baseline_n == 6
+    assert not res.no_baseline
+    assert any("OK" in ln for ln in res.lines())
+
+
+def test_gate_regress_value_and_wall(tmp_path):
+    db = obs_history.load_db(_noisy_db(tmp_path))
+    slow = obs_history.make_record(dict(
+        metric="m", value=1000.0, wall_s=31.0, platform="cpu",
+    ), rung="g")
+    res = obs_history.gate(db, slow)
+    assert not res.ok and res.regressions == ["wall_s"]
+    low = obs_history.make_record(dict(
+        metric="m", value=400.0, wall_s=10.0, platform="cpu",
+    ), rung="g")
+    res = obs_history.gate(db, low)
+    assert not res.ok and res.regressions == ["value"]
+    # one-sided: a large IMPROVEMENT never regresses
+    fast = obs_history.make_record(dict(
+        metric="m", value=5000.0, wall_s=1.0, platform="cpu",
+    ), rung="g")
+    assert obs_history.gate(db, fast).ok
+
+
+def test_gate_no_baseline_and_partial_skip(tmp_path):
+    db = obs_history.load_db(_noisy_db(tmp_path))
+    other = obs_history.make_record(dict(
+        metric="other_metric", value=5.0, platform="cpu",
+    ), rung="nowhere")
+    res = obs_history.gate(db, other)
+    assert res.ok and res.no_baseline
+    # a partial candidate's zeroed keys are SKIPped, not failed
+    part = obs_history.make_record(dict(
+        metric="m", value=0.0, partial=True, platform="cpu",
+    ), rung="g")
+    res = obs_history.gate(db, part)
+    assert res.ok
+    assert all(r["verdict"] == "SKIP(partial)" for r in res.rows)
+    # and partial records never enter a baseline
+    obs_history.append_db(str(tmp_path / "db.jsonl"), part)
+    db2 = obs_history.load_db(str(tmp_path / "db.jsonl"))
+    res2 = obs_history.gate(db2, obs_history.make_record(dict(
+        metric="m", value=990.0, wall_s=10.0, platform="cpu",
+    ), rung="g"))
+    assert res2.baseline_n == 6
+
+
+def test_gate_ratchet_moves_baseline(tmp_path):
+    """Appending improved records shifts the rolling median, so a
+    return to the OLD level becomes a regression — the ratchet."""
+    path = _noisy_db(tmp_path, n=4, wall=10.0)
+    old_level = obs_history.make_record(dict(
+        metric="m", value=1000.0, wall_s=10.0, platform="cpu",
+    ), rung="g")
+    assert obs_history.gate(obs_history.load_db(path), old_level).ok
+    for i in range(8):  # the window fills with the improved level
+        obs_history.append_db(path, obs_history.make_record(dict(
+            metric="m", value=3000.0 + i, wall_s=2.0, platform="cpu",
+        ), rung="g", run_id=f"fast.{i}"))
+    res = obs_history.gate(obs_history.load_db(path), old_level)
+    assert not res.ok
+    assert set(res.regressions) == {"value", "wall_s"}
+
+
+# --- HBM watermarks + capture on a real run -------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_cost_run(tmp_path_factory):
+    """One tiny traced adapt run shared by the watermark/capture/report
+    tests (costs armed — the Tracer default)."""
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    d = str(tmp_path_factory.mktemp("cost_run"))
+    tr = obs_trace.Tracer(d)
+    obs_metrics.registry().reset()
+    obs_costs.collector().reset()
+    out, info = adapt(
+        unit_cube_mesh(2),
+        AdaptOptions(hsiz=0.5, niter=1, max_sweeps=3, hgrad=None,
+                     polish_sweeps=0),
+        tracer=tr,
+    )
+    return d, out, info
+
+
+def test_hbm_watermark_gauges_present(traced_cost_run):
+    d, _, _ = traced_cost_run
+    reg = obs_metrics.registry()
+    assert reg.gauge("hbm/peak_bytes").value > 0
+    assert reg.gauge("hbm/bytes_in_use").value > 0
+    # per-phase boundary watermarks for the driver phases
+    phases = [k for k in reg.to_doc()["gauges"]
+              if k.startswith("hbm/phase_bytes/")]
+    assert any(k.endswith("/sweeps") for k in phases), phases
+    assert any(k.endswith("/analysis") for k in phases), phases
+    # peak is monotone >= every boundary snapshot
+    doc = reg.to_doc()["gauges"]
+    assert all(doc["hbm/peak_bytes"] >= doc[k] for k in phases)
+
+
+def test_memory_watermark_shape():
+    w = obs_costs.memory_watermark()
+    assert w is not None
+    assert w["source"] in ("device", "host_rss")
+    assert w["peak_bytes"] >= w["bytes_in_use"] >= 0
+
+
+def test_cost_docs_captured_and_report_renders(traced_cost_run):
+    d, _, _ = traced_cost_run
+    docs = obs_costs.load_cost_docs(d)
+    assert "remesh_sweeps" in docs, sorted(docs)
+    assert docs["remesh_sweeps"]["flops"] > 0
+    assert docs["remesh_sweeps"]["bytes_accessed"] > 0
+    s = obs_report.summarize(d)
+    row = next(r for r in s["costs"] if r["name"] == "remesh_sweeps")
+    assert row["bound"] in ("compute", "memory")
+    assert row["calls"] > 0 and row["mean_s"] > 0
+    assert 0 < row["pct_of_roof"]
+    assert s["memory"]["peak_bytes"] > 0
+    assert s["memory"]["source"] in ("device", "host_rss")
+    text = obs_report.render(d)
+    assert "cost attribution" in text
+    assert "HBM peak bytes" in text
+    assert "remesh_sweeps" in text

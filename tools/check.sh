@@ -52,11 +52,21 @@ echo "## frontier-smoke rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
 # observability smoke: one tiny traced run must yield a structurally
-# valid Chrome trace + JSONL timeline, exact op counters, and a
+# valid Chrome trace + JSONL timeline, exact op counters, captured XLA
+# cost docs (cost table + HBM watermark line in the report), and a
 # parseable obs_report — the never-go-blind gate for the perf arc
 timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 rc=$?
 echo "## obs-smoke rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
+# perf gate: a freshly-generated tiny CPU bench record must carry the
+# full PERF_DB envelope and gate CLEAN against the committed fixture
+# baseline (wide tolerance — deterministic across containers), and a
+# forced 1000x wall_s regression must exit the TYPED code (91)
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/perf_gate_smoke.py
+rc=$?
+echo "## perf-gate rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
 set -o pipefail

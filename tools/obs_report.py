@@ -19,6 +19,12 @@ The operators section includes the active-set telemetry (round 8):
 the world ``sweep_active_fraction`` gauge plus a per-shard column from
 the ``sweep_active_fraction/shard<i>`` gauges the distributed drivers
 record — a drained shard reads 0.000 while its neighbors still churn.
+
+Round 9: the *cost attribution* section joins captured XLA cost docs
+(``costs_rank*.json``) with the measured span means into roofline
+verdicts per jitted phase, and the *memory* section renders the
+``hbm/*`` watermark gauges — see README "Cost attribution & perf
+gating" for the capture recipe.
 """
 
 import json

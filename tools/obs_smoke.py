@@ -43,6 +43,7 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+from parmmg_tpu.obs import costs as obs_costs  # noqa: E402
 from parmmg_tpu.obs import metrics as obs_metrics  # noqa: E402
 from parmmg_tpu.obs import report as obs_report  # noqa: E402
 from parmmg_tpu.obs import trace as obs_trace  # noqa: E402
@@ -55,6 +56,7 @@ def main() -> int:
     try:
         tr = obs_trace.Tracer(tmp)
         obs_metrics.registry().reset()
+        obs_costs.collector().reset()
         out, info = adapt(
             unit_cube_mesh(2),
             AdaptOptions(hsiz=0.5, niter=1, max_sweeps=3, hgrad=None,
@@ -103,11 +105,38 @@ def main() -> int:
         print(f"[obs-smoke] counters exact over {len(hist)} sweeps; "
               "rank merge OK")
 
-        # 4. the report renders
+        # 4. cost attribution (PR 8): the traced run captured an XLA
+        # cost doc for the fused sweep program, and the HBM watermark
+        # gauges recorded phase-boundary snapshots
+        docs = obs_costs.load_cost_docs(tmp)
+        assert "remesh_sweeps" in docs, sorted(docs)
+        assert docs["remesh_sweeps"].get("flops", 0) > 0, docs
+        assert docs["remesh_sweeps"].get("bytes_accessed", 0) > 0, docs
+        s = obs_report.summarize(tmp)
+        cost_row = next(
+            (r for r in s["costs"] if r["name"] == "remesh_sweeps"),
+            None,
+        )
+        assert cost_row is not None and cost_row["bound"] in (
+            "compute", "memory",
+        ), s["costs"]
+        assert cost_row["calls"] > 0 and cost_row["mean_s"] > 0
+        assert s["memory"]["peak_bytes"] > 0, s["memory"]
+        assert s["memory"]["phase_bytes"], s["memory"]
+        print(f"[obs-smoke] cost doc captured "
+              f"(bound={cost_row['bound']}, "
+              f"intensity={cost_row['intensity']:.2f}); HBM peak "
+              f"{s['memory']['peak_bytes'] / 1e6:.1f} MB "
+              f"({s['memory']['source']})")
+
+        # 5. the report renders, including the new cost/memory sections
         text = obs_report.render(tmp)
         assert "phase breakdown" in text and "operators" in text
         assert "adapt" in text
-        print("[obs-smoke] obs_report renders the run")
+        assert "cost attribution" in text, text
+        assert "HBM peak bytes" in text, text
+        print("[obs-smoke] obs_report renders the run incl. "
+              "cost/memory sections")
         return 0
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -1,0 +1,108 @@
+"""Perf-history regression gate over PERF_DB.jsonl (parmmg_tpu.obs.history).
+
+Usage:
+  python tools/perf_gate.py --db PERF_DB.jsonl <record.json>
+      Gate one record against its rolling baseline (same platform +
+      rung + metric group; last --window non-partial records; per-key
+      tolerance = max(--mad-k * 1.4826 * MAD, --rel-floor * |median|)).
+      Exit 0 = pass (or no baseline yet), 91 = typed regression,
+      2 = unreadable inputs.
+
+  python tools/perf_gate.py --db PERF_DB.jsonl <record.json> --update-baseline 1
+      Same, then append the (enveloped) record to the DB — the ratchet:
+      improvements shift the rolling median, so the next run is gated
+      against the better level. The append happens whatever the
+      verdict (the DB is the append-only history; the robust median
+      absorbs a bad row), but the exit code still reports it.
+
+  python tools/perf_gate.py --backfill <repo-dir> --db PERF_DB.jsonl
+      Normalize the historical BENCH_r*.json + SCALE_RUNS.jsonl under
+      <repo-dir> into enveloped records and REWRITE the DB with them
+      (the one non-append operation; refuses when the DB already has
+      records unless --force 1).
+
+<record.json> may be a raw bench record, an already-enveloped record,
+or a BENCH driver wrapper ({"parsed": ..., "tail": ...}) — wrappers
+gate their best committed record. Flags: --window N (8), --rel-floor X
+(0.5), --mad-k K (4.0). Pure host code: never touches the accelerator.
+"""
+
+import json
+import sys
+
+from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
+
+from parmmg_tpu.obs import history as obs_history
+
+
+def _load_candidate(path: str):
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        return None
+    if "cmd" in doc and "tail" in doc:
+        recs = obs_history._wrapper_records(doc)
+        # gate the best committed record of the wrapper (full > partial)
+        recs.sort(key=lambda r: 0 if r.get("partial") else 1)
+        return obs_history.normalize(recs[-1])
+    return obs_history.normalize(doc)
+
+
+def main():
+    pos, flags = parse_argv(sys.argv[1:])
+    db_path = flags.get("db", "PERF_DB.jsonl")
+
+    if "backfill" in flags:
+        recs = obs_history.backfill_records(flags["backfill"])
+        if not recs:
+            print(f"[perf-gate] nothing to backfill under "
+                  f"{flags['backfill']}", file=sys.stderr)
+            return 2
+        existing = obs_history.load_db(db_path)
+        if existing and flags.get("force", "") in ("", "0"):
+            print(f"[perf-gate] {db_path} already holds "
+                  f"{len(existing)} record(s) — refusing to rewrite "
+                  "(pass --force 1)", file=sys.stderr)
+            return 2
+        with open(db_path, "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+        print(f"[perf-gate] backfilled {len(recs)} record(s) -> "
+              f"{db_path}")
+        for rec in recs:
+            print(f"  {rec['run_id']:<16s} {rec.get('metric', '?'):<28s}"
+                  f" platform={rec['platform']:<8s} rung={rec['rung']}"
+                  + ("  PARTIAL" if rec.get("partial") else ""))
+        return 0
+
+    if not pos:
+        print(__doc__)
+        return 2
+    try:
+        rec = _load_candidate(pos[0])
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"[perf-gate] unreadable record {pos[0]}: {exc}",
+              file=sys.stderr)
+        return 2
+    if rec is None:
+        print(f"[perf-gate] {pos[0]} holds no record", file=sys.stderr)
+        return 2
+
+    db = obs_history.load_db(db_path)
+    res = obs_history.gate(
+        db, rec,
+        window=int(flags.get("window", 8)),
+        rel_floor=float(flags.get("rel-floor", 0.5)),
+        mad_k=float(flags.get("mad-k", 4.0)),
+    )
+    for line in res.lines():
+        print(line)
+    if flags.get("update-baseline", "") not in ("", "0"):
+        obs_history.append_db(db_path, rec)
+        print(f"[perf-gate] record {rec['run_id']} appended to "
+              f"{db_path} (baseline ratchet)")
+    return 0 if res.ok else obs_history.REGRESSION_EXIT
+
+
+if __name__ == "__main__":
+    sys.exit(main())

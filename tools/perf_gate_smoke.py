@@ -1,0 +1,122 @@
+"""Perf-gate smoke for the CI gate (tools/check.sh, between the obs
+smoke and tier-1): deterministic end-to-end exercise of the PERF_DB
+envelope + regression gate on the hermetic CPU harness.
+
+1. Measure one tiny CPU adapt (the obs-smoke workload) and commit it as
+   a fresh PERF_DB-envelope record — asserting every envelope field is
+   populated (schema / run_id / git_sha / timestamp / platform / rung).
+2. Gate it through the REAL CLI (`tools/perf_gate.py`) against the
+   committed fixture baseline `tests/fixtures/perf_db_smoke.jsonl`
+   with wide tolerance (--rel-floor 8: a machine 8x slower than the
+   fixture median still passes — the pass path must be deterministic
+   across containers) — must exit 0.
+3. Force a regression (wall_s x1000 on the same record) — must exit
+   with the TYPED code (obs.history.REGRESSION_EXIT = 91), and the
+   verdict must name wall_s.
+
+Exit 0 = both gate paths behave; anything else fails the CI stage.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+for _accel in ("axon", "tpu", "cuda", "rocm"):
+    _xb._backend_factories.pop(_accel, None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from parmmg_tpu.obs import history as obs_history  # noqa: E402
+from parmmg_tpu.models.adapt import AdaptOptions, adapt  # noqa: E402
+from parmmg_tpu.utils.gen import unit_cube_mesh  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                       "perf_db_smoke.jsonl")
+
+
+def _gate(db, rec_path, extra=()):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "--db", db, rec_path, "--rel-floor", "8"] + list(extra),
+        capture_output=True, text=True, cwd=REPO,
+    )
+    sys.stdout.write(out.stdout)
+    sys.stderr.write(out.stderr)
+    return out
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="parmmg_perf_gate_smoke_")
+    try:
+        # 1. a freshly-generated tiny CPU bench record
+        t0 = time.perf_counter()
+        out, info = adapt(
+            unit_cube_mesh(2),
+            AdaptOptions(hsiz=0.5, niter=1, max_sweeps=3, hgrad=None,
+                         polish_sweeps=0),
+        )
+        wall = time.perf_counter() - t0
+        ne = int(out.ntet)
+        rec = obs_history.make_record(dict(
+            metric="smoke_tets_per_sec", value=round(ne / wall, 2),
+            unit="tet/s", ne=ne, wall_s=round(wall, 3), platform="cpu",
+        ), rung="smoke-n2")
+        for key in ("schema", "run_id", "git_sha", "timestamp",
+                    "platform", "rung"):
+            assert rec.get(key), f"envelope field {key} not populated"
+        rec_path = os.path.join(tmp, "rec.json")
+        with open(rec_path, "w") as f:
+            json.dump(rec, f)
+        print(f"[perf-gate-smoke] record: ne={ne} wall={wall:.2f}s "
+              f"run_id={rec['run_id']} git_sha={rec['git_sha'][:12]}")
+
+        # 2. pass path against the committed fixture baseline
+        db = os.path.join(tmp, "db.jsonl")
+        shutil.copy(FIXTURE, db)
+        res = _gate(db, rec_path)
+        assert res.returncode == 0, (
+            f"pass path exited {res.returncode}: {res.stdout}"
+        )
+        print("[perf-gate-smoke] pass path OK (rc=0)")
+
+        # 3. forced regression: typed failure naming the key
+        bad = dict(rec, wall_s=rec["wall_s"] * 1000.0)
+        bad_path = os.path.join(tmp, "bad.json")
+        with open(bad_path, "w") as f:
+            json.dump(bad, f)
+        res = _gate(db, bad_path)
+        assert res.returncode == obs_history.REGRESSION_EXIT, (
+            f"forced regression exited {res.returncode}, wanted "
+            f"{obs_history.REGRESSION_EXIT}"
+        )
+        assert "wall_s" in res.stdout and "REGRESS" in res.stdout, (
+            res.stdout
+        )
+        print(f"[perf-gate-smoke] forced regression OK "
+              f"(rc={obs_history.REGRESSION_EXIT}, names wall_s)")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

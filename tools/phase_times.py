@@ -55,50 +55,18 @@ def main():
             for r in info["history"] if "n_active" in r
         ]
         _tprint(f"   sweep_active_fraction={saf}")
-        _noop_probe(out)
+        # converged-sweep cost probe: the ONE shared definition
+        # (bench.measure_converged_sweep — the same numbers every BENCH
+        # record carries), not a local re-implementation
+        probe = bench.measure_converged_sweep(out)
+        _tprint(
+            f"== no-op sweep probe: full-table "
+            f"{probe['full_s'] * 1e3:.1f} ms vs empty-frontier "
+            f"{probe['frontier_s'] * 1e3:.1f} ms "
+            f"({probe['ratio']:.1f}x cheaper)"
+        )
     finally:
         builtins.print = _orig
-
-
-def _noop_probe(out, reps=3):
-    """Converged-sweep cost probe (round 6): on the adapted mesh, time a
-    full-table sweep against a frontier sweep whose active set is EMPTY
-    and whose tables are clean — the cost of a no-op verification sweep
-    under active-set scheduling vs the legacy full-capacity cost."""
-    import jax
-    import jax.numpy as jnp
-
-    from parmmg_tpu.core import adjacency as adj
-    from parmmg_tpu.core.mesh import compact
-    from parmmg_tpu.models.adapt import Frontier, remesh_sweep
-
-    mesh = compact(out)
-    ecap = int(mesh.tcap * 1.6) + 64
-    edges, emask, t2e, nu = adj.unique_edges(mesh, ecap)
-    mesh = adj.build_adjacency(mesh)
-    fr = Frontier(
-        changed=jnp.zeros(mesh.pcap, bool),
-        dirty=jnp.int32(0),
-        tables=(edges, emask, t2e, jnp.asarray(nu, jnp.int32)),
-        adja_ok=jnp.bool_(True),
-    )
-
-    def timed(fn):
-        fn()  # compile + warm
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            jax.block_until_ready(fn())
-        return (time.perf_counter() - t0) / reps
-
-    t_full = timed(lambda: remesh_sweep(mesh, ecap, phase_skip=False))
-    t_noop = timed(
-        lambda: remesh_sweep(mesh, ecap, phase_skip=False, frontier=fr)
-    )
-    _tprint(
-        f"== no-op sweep probe: full-table {t_full * 1e3:.1f} ms vs "
-        f"empty-frontier {t_noop * 1e3:.1f} ms "
-        f"({t_full / max(t_noop, 1e-9):.1f}x cheaper)"
-    )
 
 
 if __name__ == "__main__":

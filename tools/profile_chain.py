@@ -2,27 +2,29 @@
 block_until_ready does not synchronize (the remote TPU tunnel).
 
 Each op runs R times inside one jitted lax.fori_loop with the mesh as
-loop carry (true data dependency), so the measured wall time is actual
-device compute. Usage:
+loop carry (true data dependency) — `parmmg_tpu.obs.costs.
+chained_seconds`, the shared chained-timing definition — so the
+measured wall time is actual device compute. Usage:
 
     python tools/profile_chain.py [n] [hsiz] [R]
 """
 # parmmg-lint: disable-file=PML005 -- profiling harness reuses the same mesh across timed repeats
 
-import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
 
 import jax
-import jax.numpy as jnp
+
+from parmmg_tpu.obs import costs as obs_costs
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    hsiz = float(sys.argv[2]) if len(sys.argv) > 2 else 0.08
-    R = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    pos, _ = parse_argv(sys.argv[1:])
+    n = int(pos[0]) if pos else 8
+    hsiz = float(pos[1]) if len(pos) > 1 else 0.08
+    R = int(pos[2]) if len(pos) > 2 else 20
 
     from parmmg_tpu.core import adjacency
     from parmmg_tpu.core.mesh import compact
@@ -52,16 +54,7 @@ def main():
     jax.block_until_ready(mesh)
 
     def timeit(name, step):
-        @jax.jit
-        def run(m):
-            return jax.lax.fori_loop(0, R, lambda i, mm: step(mm), m)
-
-        out = run(mesh)
-        _ = float(out.vert[0, 0])          # force full execution
-        t0 = time.perf_counter()
-        out = run(mesh)
-        _ = float(out.vert[0, 0])
-        dt = (time.perf_counter() - t0) / R * 1000
+        dt = obs_costs.chained_seconds(step, mesh, reps=R) * 1000
         print(f"  {name:18s} {dt:8.1f} ms", flush=True)
         return dt
 

@@ -1,38 +1,70 @@
-"""Per-op steady-state profiler for the remeshing kernels.
+"""Per-op steady-state profiler for the remeshing kernels, on the
+shared `parmmg_tpu.obs.costs` timing/attribution helpers.
 
-Times each kernel of the sweep (warm jit, block_until_ready) on whatever
-backend jax resolves — run as-is for the TPU tunnel, or with
-`env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu` for the host anchor.
-Produces the PERF_NOTES.md table. Usage:
+Times each kernel of the sweep (warm jit, `obs.costs.timed_mean`) on
+whatever backend jax resolves — run as-is for the TPU tunnel, or with
+`env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu` for the host anchor —
+and attributes each kernel's XLA cost (flops, bytes accessed,
+arithmetic intensity, roofline bound vs the platform peak table): the
+selection table for the Pallas arc, and the regenerable source of the
+PERF_NOTES roofline tables.
 
-    python tools/profile_ops.py [n] [hsiz] [reps]
+Usage:
+
+    python tools/profile_ops.py [n] [hsiz] [reps] [--json <path>]
+
+`--json <path>` additionally commits the whole table as ONE
+PERF_DB-envelope record (metric ``profile_ops``, per-op rows under
+``ops``) — append it with `tools/perf_gate.py --update-baseline`, or
+regenerate a PERF_NOTES table from the file instead of copy-pasting
+stdout.
 """
 # parmmg-lint: disable-file=PML004,PML005 -- one-shot profiling harness: wrappers are built once per process and meshes are deliberately reused across repeats
 
+import json
 import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
 
 import jax
-import jax.numpy as jnp
+
+from parmmg_tpu.obs import costs as obs_costs
+from parmmg_tpu.obs import history as obs_history
 
 
-def timeit(fn, *args, reps=5):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-        jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps * 1000.0, out
+def profile_op(name, jitfn, args, reps=5):
+    """One per-op row: measured steady-state mean (shared timed_mean
+    definition) + the kernel's XLA cost doc + its roofline verdict."""
+    sec = obs_costs.timed_mean(lambda: jitfn(*args), reps=reps)
+    try:
+        doc = obs_costs.cost_doc(jitfn, args)
+    except Exception as exc:  # analysis never sinks the measurement
+        doc = dict(flops=0.0, bytes_accessed=0.0,
+                   error=f"{type(exc).__name__}: {exc}")
+    row = dict(
+        op=name, ms=round(sec * 1e3, 3),
+        flops=doc.get("flops", 0.0),
+        bytes_accessed=doc.get("bytes_accessed", 0.0),
+    )
+    if "error" in doc:
+        row["cost_error"] = doc["error"]
+    row.update({
+        k: v for k, v in obs_costs.roofline(
+            row["flops"], row["bytes_accessed"], sec,
+            doc.get("platform", jax.devices()[0].platform),
+        ).items()
+        if k in ("intensity", "bound", "pct_of_roof")
+    })
+    return row
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    hsiz = float(sys.argv[2]) if len(sys.argv) > 2 else 0.08
-    reps = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    pos, flags = parse_argv(sys.argv[1:])
+    n = int(pos[0]) if pos else 8
+    hsiz = float(pos[1]) if len(pos) > 1 else 0.08
+    reps = int(pos[2]) if len(pos) > 2 else 5
 
     from parmmg_tpu.core import adjacency
     from parmmg_tpu.core.mesh import compact
@@ -55,24 +87,23 @@ def main():
 
     rows = []
 
-    ms, mesh2 = timeit(jax.jit(lambda m: compact(m)), mesh, reps=reps)
-    rows.append(("compact", ms))
-    mesh = mesh2
+    run_compact = jax.jit(lambda m: compact(m))
+    rows.append(profile_op("compact", run_compact, (mesh,), reps))
+    mesh = run_compact(mesh)
 
     ue = jax.jit(adjacency.unique_edges, static_argnames=("ecap",))
-    ms, (edges, emask, t2e, nu) = timeit(lambda m: ue(m, ecap), mesh,
-                                         reps=reps)
-    rows.append(("unique_edges", ms))
+    run_ue = jax.jit(lambda m: ue(m, ecap))
+    rows.append(profile_op("unique_edges", run_ue, (mesh,), reps))
+    edges, emask, t2e, nu = run_ue(mesh)
 
-    ms, mesh_adj = timeit(adjacency.build_adjacency, mesh, reps=reps)
-    rows.append(("build_adjacency", ms))
-    mesh = mesh_adj
+    rows.append(profile_op("build_adjacency", adjacency.build_adjacency,
+                           (mesh,), reps))
+    mesh = adjacency.build_adjacency(mesh)
 
-    ms, _ = timeit(analysis.tria_normals, mesh, reps=reps)
-    rows.append(("tria_normals", ms))
-
-    ms, _ = timeit(analysis.vertex_normals, mesh, reps=reps)
-    rows.append(("vertex_normals", ms))
+    rows.append(profile_op("tria_normals", analysis.tria_normals,
+                           (mesh,), reps))
+    rows.append(profile_op("vertex_normals", analysis.vertex_normals,
+                           (mesh,), reps))
 
     @jax.jit
     def run_split(m):
@@ -80,42 +111,57 @@ def main():
         # invalidate the reused input buffer on TPU between reps
         return split.split_long_edges(m, edges, emask, t2e)[0]
 
-    ms, _ = timeit(run_split, mesh, reps=reps)
-    rows.append(("split", ms))
+    rows.append(profile_op("split", run_split, (mesh,), reps))
 
     @jax.jit
     def run_col(m):
         return collapse.collapse_short_edges(m, edges, emask, t2e)[0]
 
-    ms, _ = timeit(run_col, mesh, reps=reps)
-    rows.append(("collapse", ms))
+    rows.append(profile_op("collapse", run_col, (mesh,), reps))
 
     @jax.jit
     def run_s32(m):
         return swap.swap_32(m, edges, emask, t2e)[0]
 
-    ms, _ = timeit(run_s32, mesh, reps=reps)
-    rows.append(("swap32", ms))
+    rows.append(profile_op("swap32", run_s32, (mesh,), reps))
 
     @jax.jit
     def run_s23(m):
         return swap.swap_23(m, edges, emask)[0]
 
-    ms, _ = timeit(run_s23, mesh, reps=reps)
-    rows.append(("swap23", ms))
+    rows.append(profile_op("swap23", run_s23, (mesh,), reps))
 
     @jax.jit
     def run_sm(m):
         return smooth.smooth_vertices(m, edges, emask)[0]
 
-    ms, _ = timeit(run_sm, mesh, reps=reps)
-    rows.append(("smooth", ms))
+    rows.append(profile_op("smooth", run_sm, (mesh,), reps))
 
-    print(f"\nper-op steady state (ms, mean of {reps}), "
+    print(f"\nper-op steady state (ms, mean of {reps}) + roofline, "
           f"ne={int(mesh.ntet)} tcap={mesh.tcap}:")
-    for name, ms in rows:
-        print(f"  {name:16s} {ms:8.1f}")
-    print(f"  TOTAL            {sum(ms for _, ms in rows):8.1f}")
+    print(f"  {'op':<16s} {'ms':>8s} {'flops':>10s} {'bytes':>10s} "
+          f"{'F/B':>6s} {'%roof':>7s}  bound")
+    for r in rows:
+        pct = f"{r['pct_of_roof']:.2%}" if "pct_of_roof" in r else "-"
+        print(f"  {r['op']:<16s} {r['ms']:8.1f} {r['flops']:>10.3g} "
+              f"{r['bytes_accessed']:>10.3g} {r['intensity']:>6.2f} "
+              f"{pct:>7s}  {r['bound']}")
+    print(f"  TOTAL            {sum(r['ms'] for r in rows):8.1f}")
+
+    if "json" in flags:
+        rec = obs_history.make_record(dict(
+            metric="profile_ops",
+            value=round(sum(r["ms"] for r in rows), 3),
+            unit="ms_total",
+            ne=int(mesh.ntet), tcap=int(mesh.tcap), reps=reps,
+            platform=jax.devices()[0].platform,
+            ops=rows,
+        ), rung=f"ops-n{n}-hsiz{hsiz:g}")
+        tmp = flags["json"] + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(tmp, flags["json"])
+        print(f"## profile_ops record -> {flags['json']}")
 
 
 if __name__ == "__main__":

@@ -30,16 +30,25 @@ import time
 from _cli import REPO, parse_argv  # noqa: F401 (REPO bootstraps sys.path)
 
 
+def _envelope(rec, n, hsiz):
+    """PERF_DB envelope via the one shared constructor
+    (obs.history.make_record) — full and partial records of a rung are
+    indistinguishable in shape and land in the same baseline group."""
+    from parmmg_tpu.obs import history as obs_history
+
+    return obs_history.make_record(rec, rung=f"xl-n{n}-hsiz{hsiz:g}")
+
+
 def partial_record(n, hsiz, died_in="startup", reason="stage deadline"):
     """Committed-partial record for a stage that hit its time budget —
     same shape as the full record, explicitly marked, naming the phase
     the budget died in (the never-blind bench-ladder contract; closes
     the BENCH_r03/r04 rc=124-with-nothing gap)."""
-    return {
+    return _envelope({
         "metric": "tets_per_sec_cold", "value": 0.0, "unit": "tet/s",
         "includes_compile": True, "partial": True,
         "stage": f"n{n}-hsiz{hsiz}", "died_in": died_in, "error": reason,
-    }
+    }, n, hsiz)
 
 
 def _arm_stage_deadline(on_expire):
@@ -117,6 +126,12 @@ def worker(n, hsiz, tight=False):
         )), flush=True)
         os._exit(3)
 
+    # warm the envelope machinery (module import + git-sha subprocess
+    # cache) OUTSIDE the signal handler: _expire must only format and
+    # print
+    from parmmg_tpu.obs import history as obs_history
+
+    obs_history.git_sha()
     _arm_stage_deadline(_expire)
     t0 = time.perf_counter()
     out, info = run_adapt_with_budget(mesh, opts, budgets=budgets,
@@ -137,7 +152,7 @@ def worker(n, hsiz, tight=False):
     # COLD timing: one adapt() with no warmup — compile time (or cache
     # hits) is folded in, so this number is NOT comparable to bench.py's
     # steady-state tets_per_sec; the metric name says so
-    rec = {
+    rec = _envelope({
         "metric": "tets_per_sec_cold", "value": round(ne / wall, 1),
         "unit": "tet/s", "includes_compile": True,
         "ne": ne, "wall_s": round(wall, 2),
@@ -146,7 +161,7 @@ def worker(n, hsiz, tight=False):
         "recompiles": info["recompiles"],
         "sweep_active_fraction": saf,
         "converged_sweep_cost": converged,
-    }
+    }, n, hsiz)
     print(json.dumps(rec), flush=True)
 
 

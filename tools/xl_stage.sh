@@ -72,4 +72,16 @@ STAGE_JSON=BENCH_xl_run.json run_stage run 15300 \
     env PARMMG_RETRACE_BUDGETS="sweeps=64" \
     python tools/scale_run.py 16 0.02 --tight 1 --stall 2700 --retries 4 \
         --bench-json BENCH_xl_run.json
-exit $?
+run_rc=$?
+
+# perf-history gate (PR 8): every rung's committed record — full or
+# partial — is appended to the PERF_DB trajectory and gated against its
+# rolling (platform, rung) baseline; the verdict line per rung is part
+# of the ladder log. A regression does not retro-fail the measurement
+# (the record IS the result) but the typed rc is surfaced.
+if [ -f BENCH_xl_run.json ]; then
+    python tools/perf_gate.py --db PERF_DB.jsonl BENCH_xl_run.json \
+        --update-baseline 1
+    echo "## stage run perf-gate rc=$? (record appended to PERF_DB.jsonl)"
+fi
+exit $run_rc
