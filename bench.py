@@ -79,13 +79,18 @@ def _note_phase(name: str) -> None:
 
 def _rung_for_cfg(cfg) -> str:
     """The PERF_DB rung label of one bench config — shared by the full
-    and partial record paths so both land in the same baseline group."""
+    and partial record paths so both land in the same baseline group.
+    A kernels-on config gets a distinct `-pk` rung: Pallas-kernel and
+    lax measurements must never share a gate baseline (tools/
+    perf_gate.py keys on the rung, and its coarse fallback honors the
+    marker too)."""
+    pk = "-pk" if cfg.get("kernels") == "on" else ""
     if cfg.get("dist"):
-        return f"dist-p{cfg.get('nparts', '?')}"
+        return f"dist-p{cfg.get('nparts', '?')}{pk}"
     try:
-        return f"n{cfg.get('n', '?')}-hsiz{float(cfg['hsiz']):g}"
+        return f"n{cfg.get('n', '?')}-hsiz{float(cfg['hsiz']):g}{pk}"
     except (KeyError, TypeError, ValueError):
-        return f"n{cfg.get('n', '?')}-hsiz{cfg.get('hsiz', '?')}"
+        return f"n{cfg.get('n', '?')}-hsiz{cfg.get('hsiz', '?')}{pk}"
 
 
 def _envelope(rec, cfg):
@@ -285,16 +290,25 @@ def measure_converged_sweep(out, reps=3):
 
 
 def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
-        tight=False):
+        tight=False, kernels=None):
     import jax
 
+    from parmmg_tpu.kernels import registry as kernels_registry
     from parmmg_tpu.lint.contracts import RetraceCounter
     from parmmg_tpu.models.adapt import AdaptOptions, adapt
     from parmmg_tpu.ops import quality
 
     _enable_compile_cache()
 
-    opts = AdaptOptions(niter=niter, hsiz=hsiz, max_sweeps=max_sweeps, hgrad=None)
+    opts = AdaptOptions(niter=niter, hsiz=hsiz, max_sweeps=max_sweeps, hgrad=None,
+                        kernels=kernels)
+    if kernels is not None:
+        kernels_registry.set_mode(kernels)
+    # the EFFECTIVE backend this run measured (auto resolves per
+    # platform): recorded in the line and in the rung via the cfg
+    kernels_on = any(
+        kernels_registry.enabled(nm) for nm in kernels_registry.names()
+    )
     # PARMMG_BENCH_CKPT=1: checkpoint the TIMED run (fresh dir — the
     # warmup must not leave a checkpoint the timed run would resume
     # from) through the async staging path, so the record carries a
@@ -376,11 +390,15 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         # staging writer (0.0 when the run checkpoints synchronously or
         # not at all — see PARMMG_BENCH_CKPT above)
         "ckpt_overlap_s": float(info.get("ckpt_overlap_s", 0.0)),
-    }, dict(n=n, hsiz=hsiz))
+        # Pallas kernel subsystem state of THIS measurement — on/off
+        # also keys the rung (…-pk) so the perf gate never mixes
+        # kernel-on and kernel-off baselines
+        "kernels": "on" if kernels_on else "off",
+    }, dict(n=n, hsiz=hsiz, kernels="on" if kernels_on else "off"))
 
 
 def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
-             anchor=CPU_ANCHOR_TPS, frontier=True):
+             anchor=CPU_ANCHOR_TPS, frontier=True, kernels=None):
     """Distributed-driver bench: warmup + timed `adapt_distributed`
     with active-set sweeps, recording the per-sweep
     `sweep_active_fraction` series and the converged-sweep cost parity
@@ -398,10 +416,18 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
     )
     from parmmg_tpu.ops import quality
 
+    from parmmg_tpu.kernels import registry as kernels_registry
+
     _enable_compile_cache()
     opts = DistOptions(
         niter=niter, hsiz=hsiz, max_sweeps=max_sweeps, hgrad=None,
         nparts=nparts, min_shard_elts=16, frontier=frontier,
+        kernels=kernels,
+    )
+    if kernels is not None:
+        kernels_registry.set_mode(kernels)
+    kernels_on = any(
+        kernels_registry.enabled(nm) for nm in kernels_registry.names()
     )
     _note_phase("dist-warmup")
     adapt_distributed(_workload(n, hsiz), opts)
@@ -419,7 +445,8 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
     ]
 
     _note_phase("dist-converged-probe")
-    dist_cfg = dict(dist=True, n=n, hsiz=hsiz, nparts=nparts)
+    dist_cfg = dict(dist=True, n=n, hsiz=hsiz, nparts=nparts,
+                    kernels="on" if kernels_on else "off")
     # distributed converged-iteration cost: one full-table sweep on the
     # converged stacked mesh (the legacy per-iteration floor) vs the
     # drained-frontier skip path
@@ -471,6 +498,7 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
                 t_full / max(t_fr, 1e-9), 2
             ),
         },
+        "kernels": "on" if kernels_on else "off",
     }, dist_cfg)
 
 

@@ -684,6 +684,10 @@ _FINGERPRINT_EXCLUDE = frozenset({
     "mem_budget_mb", "validate", "validate_every", "recovery_attempts",
     "checkpoint_keep", "watchdog_timeout", "checkpoint_store",
     "checkpoint_async",
+    # kernels is a backend-selection knob (Pallas vs lax reference, the
+    # same computation to documented tolerance), like the platform the
+    # run executes on — which was never fingerprinted either
+    "kernels",
     # nparts is a RESOURCE layout, not a trajectory option, under
     # elastic resume: a checkpoint taken at one shard count may be
     # re-cut onto another (the drivers merge + re-partition through

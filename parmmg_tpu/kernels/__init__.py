@@ -1,0 +1,56 @@
+"""Hand-fused Pallas TPU kernels for the memory-bound sweep hot paths.
+
+PERF_NOTES round 9 measured every sweep op memory-bound at 0.24–0.55
+flop/byte and 0.8–2.5 % of the bandwidth roof: the lax versions lower
+to unfused gather→compute→scatter chains that re-stream the
+vertex/tet tables from HBM many times per op. This package hand-fuses
+the worst offenders as Pallas kernels over int32 index streams and
+flat f32 arrays, each paired with its exact lax reference behind the
+:mod:`registry` dispatch so every call site stays backend-agnostic:
+
+- ``collapse_cavity`` — tet quality + cavity evaluation for collapse
+  (the round-9 740 ms / 0.81 %-of-roof target);
+- ``quality_vol`` — fused per-tet quality + volume (swap 3-2/2-3,
+  collapse hoists, smoothing, quality histograms);
+- ``split_midpoint`` — split's curvature-corrected midpoint validity;
+- ``interp_bary`` — barycentric locate + metric interpolation for
+  `ops/interp.py`.
+
+Selection: ``AdaptOptions.kernels`` / ``PMMGTPU_KERNELS`` =
+``auto | off | on | <csv-allowlist>`` (auto = Pallas on TPU, lax
+elsewhere; non-TPU backends run Pallas in ``interpret=True`` mode so
+tier-1 and check.sh exercise the kernel bodies — see
+tools/kernel_smoke.py). ``off`` routes every call to the lax
+reference, which *is* the pre-kernel code path: bit-identical A/B.
+"""
+
+from .registry import (  # noqa: F401
+    Kernel, dispatch, enabled, get, interpret, names, register,
+    resolve_mode, set_mode, use_mode,
+)
+
+# importing the kernel modules registers them
+from . import cavity_k, interp_k, quality_k  # noqa: F401, E402
+
+
+def quality_vol(vert, met, tet):
+    """(q [N], vol [N]) of packed tet rows — fused quality + volume."""
+    return dispatch("quality_vol", vert, met, tet)
+
+
+def collapse_cavity(vert, met, new_tet, vol_floor):
+    """Gated cavity quality of the retargeted one-ring: q_new where
+    vol_new clears `vol_floor`, else -inf (the ball-min operand)."""
+    return dispatch("collapse_cavity", vert, met, new_tet, vol_floor)
+
+
+def split_midpoint(vert, tet, newp, li, lj):
+    """[N] bool — both children of the midpoint substitution keep the
+    positivity floor of the parent volume."""
+    return dispatch("split_midpoint", vert, tet, newp, li, lj)
+
+
+def interp_bary(vert, met, vids, pts):
+    """(clamped bary [Q,4], interpolated metric [Q,C]) at located
+    points."""
+    return dispatch("interp_bary", vert, met, vids, pts)

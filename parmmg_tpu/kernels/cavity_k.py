@@ -1,0 +1,188 @@
+"""Cavity-evaluation Pallas kernels for collapse and split.
+
+`collapse_cavity` is the PERF_NOTES round-9 740 ms target: inside the
+collapse MIS loop every evaluation round re-streams the vertex/metric
+tables to score the tentative (retargeted) one-ring — quality of the
+would-be cavity, its new volumes, and the positivity gate that feeds
+the per-winner ball minimum. The fused kernel gathers each candidate
+tet's corners once from the VMEM-resident tables and emits the gated
+quality directly (`q_new` where `vol_new` clears the scale-relative
+floor, else -inf), exactly the value the ball min-scatter consumes.
+
+`split_midpoint` fuses split's curvature-corrected-midpoint validity:
+gather the corners of every incident tet, substitute the offset
+midpoint into both child configurations (one-hot select — the batched
+equivalent of the `.at[rows, l].set` pair), and compare both child
+volumes against the positivity floor of the parent volume, in one
+pass.
+
+Calling conventions (both impls each):
+
+    collapse_cavity(vert [P,3], met [P,C], new_tet [N,4] i32,
+                    vol_floor [N]) -> gated quality [N]
+    split_midpoint(vert [P,3], tet [N,4] i32, newp [N,3],
+                   li [N] i32, lj [N] i32) -> ok [N] bool
+
+Both lax references are the pre-kernel expression DAGs verbatim, so
+`off` mode is bit-identical to the historical code path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import registry
+from .quality_k import BLK, pad_rows, quality_vol_math, stream_spec, table_spec
+
+
+# ---------------------------------------------------------------------------
+# collapse cavity
+# ---------------------------------------------------------------------------
+
+
+def _collapse_cavity_ref(vert, met, new_tet, vol_floor):
+    from ..ops import common
+
+    q_new = common.quality_of(vert, met, new_tet)
+    vol_new = common.vol_of(vert, new_tet)
+    return jnp.where(vol_new > vol_floor, q_new, -jnp.inf)
+
+
+def collapse_cavity_kernel(vert_ref, met_ref, tet_ref, floor_ref, out_ref):
+    verts = vert_ref[...]
+    mets = met_ref[...]
+    idx = tet_ref[...]
+    q, vol = quality_vol_math(verts[idx], mets[idx])
+    gate = jnp.where(vol > floor_ref[..., 0], q, -jnp.inf)
+    out_ref[...] = gate[:, None]
+
+
+def _collapse_cavity_pallas(vert, met, new_tet, vol_floor):
+    import jax.experimental.pallas as pl
+
+    n = new_tet.shape[0]
+    tetp = pad_rows(new_tet.astype(jnp.int32), BLK)
+    floorp = pad_rows(vol_floor[:, None], BLK)
+    npad = tetp.shape[0]
+    out = pl.pallas_call(
+        collapse_cavity_kernel,
+        grid=(npad // BLK,),
+        in_specs=[
+            table_spec(vert.shape),
+            table_spec(met.shape),
+            stream_spec(4),
+            stream_spec(1),
+        ],
+        out_specs=stream_spec(1),
+        out_shape=jax.ShapeDtypeStruct((npad, 1), vert.dtype),
+        interpret=registry.interpret(),
+    )(vert, met, tetp, floorp)
+    return out[:n, 0]
+
+
+def _collapse_cavity_cost(vert, met, new_tet, vol_floor):
+    n = new_tet.shape[0]
+    itemsize = jnp.dtype(vert.dtype).itemsize
+    table_b = (vert.size + met.size) * itemsize
+    stream_b = new_tet.size * 4 + 2 * n * itemsize
+    per_row = 170 if met.shape[1] == 1 else 430
+    return dict(flops=float(per_row * n),
+                bytes_accessed=float(table_b + stream_b))
+
+
+registry.register(
+    "collapse_cavity", _collapse_cavity_pallas, _collapse_cavity_ref,
+    doc="collapse MIS evaluation: gated cavity quality of the "
+        "retargeted one-ring in one VMEM-resident pass (the round-9 "
+        "740 ms fusion target)",
+    est_cost=_collapse_cavity_cost,
+)
+
+
+# ---------------------------------------------------------------------------
+# split midpoint validity
+# ---------------------------------------------------------------------------
+
+
+def _tet_vol(cc):
+    d1 = cc[:, 1] - cc[:, 0]
+    d2 = cc[:, 2] - cc[:, 0]
+    d3 = cc[:, 3] - cc[:, 0]
+    return jnp.einsum("ti,ti->t", jnp.cross(d1, d2), d3) / 6.0
+
+
+def _split_midpoint_ref(vert, tet, newp, li, lj):
+    from ..ops import common
+
+    c = vert[tet]                                   # [N,4,3]
+    rows = jnp.arange(tet.shape[0], dtype=jnp.int32)
+    cA = c.at[rows, lj].set(newp)
+    cB = c.at[rows, li].set(newp)
+    vol_p = jnp.abs(_tet_vol(c))
+    floor = common.POS_VOL_FRAC * vol_p
+    return (_tet_vol(cA) > floor) & (_tet_vol(cB) > floor)
+
+
+def split_midpoint_kernel(vert_ref, tet_ref, newp_ref, li_ref, lj_ref,
+                          ok_ref):
+    from ..ops.common import POS_VOL_FRAC
+
+    verts = vert_ref[...]
+    idx = tet_ref[...]
+    newp = newp_ref[...]
+    li = li_ref[..., 0]
+    lj = lj_ref[..., 0]
+    c = verts[idx]                                  # [B,4,3]
+    slot = jax.lax.broadcasted_iota(jnp.int32, (c.shape[0], 4), 1)
+    selA = (slot == lj[:, None])[..., None]
+    selB = (slot == li[:, None])[..., None]
+    cA = jnp.where(selA, newp[:, None, :], c)
+    cB = jnp.where(selB, newp[:, None, :], c)
+    floor = POS_VOL_FRAC * jnp.abs(_tet_vol(c))
+    ok = (_tet_vol(cA) > floor) & (_tet_vol(cB) > floor)
+    ok_ref[...] = ok.astype(jnp.int32)[:, None]
+
+
+def _split_midpoint_pallas(vert, tet, newp, li, lj):
+    import jax.experimental.pallas as pl
+
+    n = tet.shape[0]
+    tetp = pad_rows(tet.astype(jnp.int32), BLK)
+    newpp = pad_rows(newp, BLK)
+    lip = pad_rows(li.astype(jnp.int32)[:, None], BLK)
+    ljp = pad_rows(lj.astype(jnp.int32)[:, None], BLK)
+    npad = tetp.shape[0]
+    ok = pl.pallas_call(
+        split_midpoint_kernel,
+        grid=(npad // BLK,),
+        in_specs=[
+            table_spec(vert.shape),
+            stream_spec(4),
+            stream_spec(3),
+            stream_spec(1),
+            stream_spec(1),
+        ],
+        out_specs=stream_spec(1),
+        out_shape=jax.ShapeDtypeStruct((npad, 1), jnp.int32),
+        interpret=registry.interpret(),
+    )(vert, tetp, newpp, lip, ljp)
+    return ok[:n, 0] != 0
+
+
+def _split_midpoint_cost(vert, tet, newp, li, lj):
+    n = tet.shape[0]
+    itemsize = jnp.dtype(vert.dtype).itemsize
+    table_b = vert.size * itemsize
+    stream_b = tet.size * 4 + newp.size * itemsize + 2 * n * 4 + n * 4
+    return dict(flops=float(130 * n),
+                bytes_accessed=float(table_b + stream_b))
+
+
+registry.register(
+    "split_midpoint", _split_midpoint_pallas, _split_midpoint_ref,
+    doc="split curvature-corrected midpoint validity: both child "
+        "volumes of every incident tet vs the parent positivity floor "
+        "in one fused pass",
+    est_cost=_split_midpoint_cost,
+)

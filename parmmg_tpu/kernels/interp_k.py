@@ -1,0 +1,102 @@
+"""Barycentric locate + metric interpolation Pallas kernel
+(`interp_bary`) for `ops/interp.py`.
+
+The interpolation pull after a walk-locate runs three chained
+memory-bound passes per query point: gather the containing tet's
+corner rows, evaluate + clamp the barycentric coordinates, then gather
+the corner metrics and interpolate (harmonic-in-1/h for iso). The
+fused kernel keeps the vertex and metric tables VMEM-resident and
+emits (clamped barycentric weights, interpolated metric) in one pass
+over the packed query stream.
+
+Calling convention (both impls):
+
+    interp_bary(vert [P,3], met [P,C], vids [Q,4] i32, pts [Q,3])
+        -> (bary [Q,4], met_q [Q,C])
+
+The barycentric expression is exactly `ops.locate.tet_barycoords` +
+`clamp_bary`, and the metric rule exactly `core.metric.interp_metric`,
+so recomputing them here agrees bit-for-bit with the walk's own
+output. The anisotropic (C == 6) metric rule is log-Euclidean — an
+eigendecomposition per point, outside what a TPU Pallas body can
+express — so the Pallas wrapper routes aniso calls to the lax
+reference (documented tolerance story: there is none to justify;
+aniso simply stays on the reference path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import metric as metric_mod
+from ..ops import locate as locate_mod
+from . import registry
+from .quality_k import BLK, pad_rows, stream_spec, table_spec
+
+
+def _interp_bary_ref(vert, met, vids, pts):
+    lam = locate_mod.tet_barycoords(vert[vids], pts)
+    bary = locate_mod.clamp_bary(lam)
+    return bary, metric_mod.interp_metric(met[vids], bary)
+
+
+def interp_bary_kernel(vert_ref, met_ref, vids_ref, pts_ref,
+                       bary_ref, met_out_ref):
+    verts = vert_ref[...]
+    mets = met_ref[...]
+    vids = vids_ref[...]
+    pts = pts_ref[...]
+    lam = locate_mod.tet_barycoords(verts[vids], pts)
+    bary = locate_mod.clamp_bary(lam)
+    bary_ref[...] = bary
+    met_out_ref[...] = metric_mod.interp_metric(mets[vids], bary)
+
+
+def _interp_bary_pallas(vert, met, vids, pts):
+    import jax.experimental.pallas as pl
+
+    if met.shape[-1] != 1:
+        # log-Euclidean aniso interpolation needs an eigh per point —
+        # not expressible in a TPU Pallas body; stay on the reference
+        return _interp_bary_ref(vert, met, vids, pts)
+    q = vids.shape[0]
+    vidsp = pad_rows(vids.astype(jnp.int32), BLK)
+    ptsp = pad_rows(pts, BLK)
+    npad = vidsp.shape[0]
+    bary, met_q = pl.pallas_call(
+        interp_bary_kernel,
+        grid=(npad // BLK,),
+        in_specs=[
+            table_spec(vert.shape),
+            table_spec(met.shape),
+            stream_spec(4),
+            stream_spec(3),
+        ],
+        out_specs=(stream_spec(4), stream_spec(met.shape[1])),
+        out_shape=(
+            jax.ShapeDtypeStruct((npad, 4), vert.dtype),
+            jax.ShapeDtypeStruct((npad, met.shape[1]), met.dtype),
+        ),
+        interpret=registry.interpret(),
+    )(vert, met, vidsp, ptsp)
+    return bary[:q], met_q[:q]
+
+
+def _interp_bary_cost(vert, met, vids, pts):
+    q = vids.shape[0]
+    itemsize = jnp.dtype(vert.dtype).itemsize
+    table_b = (vert.size + met.size) * itemsize
+    stream_b = vids.size * 4 + (pts.size + q * 4 + q * met.shape[1]) * itemsize
+    return dict(flops=float(140 * q),
+                bytes_accessed=float(table_b + stream_b))
+
+
+registry.register(
+    "interp_bary", _interp_bary_pallas, _interp_bary_ref,
+    doc="fused barycentric coordinates (clamped) + metric "
+        "interpolation at located points (ops/interp.py pull phase; "
+        "aniso metrics route to the lax reference — log-Euclidean "
+        "needs eigh)",
+    est_cost=_interp_bary_cost,
+)

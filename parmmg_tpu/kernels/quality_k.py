@@ -1,0 +1,155 @@
+"""Fused tet quality + volume Pallas kernel (`quality_vol`).
+
+The lax chain the sweep ops ran before this subsystem —
+`common.quality_of(vert, met, tet)` followed by `common.vol_of(vert,
+tet)` — lowers to two gathers of the corner rows plus a string of
+HBM-materialized intermediates (`e` [T,6,3], `l2` [T,6], the sym6
+tensor mean), which is why PERF_NOTES round 9 measures every consumer
+memory-bound at 0.24–0.55 flop/byte. The fused kernel keeps the
+vertex/metric tables VMEM-resident, gathers the 4 corner rows of each
+packed tet row once, and produces (quality, signed volume) in one
+pass: its bytes-moved contract is exactly tables + index stream +
+two output columns.
+
+Shared calling convention (both impls, enforced by the m18
+equivalence tests):
+
+    quality_vol(vert [P,3], met [P,C], tet [N,4] int32) -> (q [N], vol [N])
+
+with C == 1 (iso size) or 6 (sym6 tensor), dtype following `vert`.
+The arithmetic is the *same expression DAG* as the reference
+(`ops.common.quality_of` / `vol_of`), so `PMMGTPU_KERNELS=off` and the
+interpret-mode Pallas path agree bit-for-bit on CPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import metric as metric_mod
+from ..core.mesh import EDGE_VERTS
+from ..ops.quality import ALPHA
+from . import registry
+
+# rows per grid step: one VMEM-sized tile of the packed candidate
+# stream (the tables ride along whole — the VMEM-residency premise).
+# 1024 rows keeps the interpret-mode grid short on the CPU fixtures
+# while staying far under the VMEM budget next to a ~1M-row table.
+BLK = 1024
+
+# the 6 tet edges as STATIC python pairs: a Pallas body cannot close
+# over array constants, and the static unroll selects the same corner
+# rows the reference's EDGE_VERTS gather does (bit-identical values)
+_EV_PAIRS = tuple((int(a), int(b)) for a, b in np.asarray(EDGE_VERTS))
+
+
+def quality_vol_math(c: jax.Array, m4: jax.Array):
+    """(q, vol) from gathered corners c [B,4,3] and corner metrics
+    m4 [B,4,C] — the exact `quality_of`/`vol_of` expression DAG,
+    shared by the Pallas kernel body and usable on any backend."""
+    d1, d2, d3 = c[:, 1] - c[:, 0], c[:, 2] - c[:, 0], c[:, 3] - c[:, 0]
+    vol = jnp.einsum("ti,ti->t", jnp.cross(d1, d2), d3) / 6.0
+    e = jnp.stack([c[:, b] - c[:, a] for a, b in _EV_PAIRS], axis=1)
+    if m4.shape[-1] == 6:
+        mt = jnp.mean(m4, axis=1)
+        M = metric_mod.sym6_to_mat(mt)
+        l2 = jnp.einsum("tei,tij,tej->te", e, M, e)
+        volm = vol * jnp.sqrt(jnp.maximum(metric_mod.metric_det(mt), 0.0))
+    else:
+        h = jnp.mean(m4[..., 0], axis=1)
+        l2 = jnp.sum(e * e, axis=-1) / jnp.maximum(h[:, None] ** 2, 1e-30)
+        volm = vol / jnp.maximum(h ** 3, 1e-30)
+    rap = jnp.sum(l2, axis=-1)
+    q = ALPHA * volm / jnp.maximum(rap, 1e-30) ** 1.5
+    return jnp.where(jnp.isfinite(q), q, 0.0), vol
+
+
+def _quality_vol_ref(vert, met, tet):
+    """Lax reference: the pre-kernel chain, verbatim (off-mode =
+    bit-identical to the code the call sites ran before)."""
+    from ..ops import common
+
+    return common.quality_of(vert, met, tet), common.vol_of(vert, tet)
+
+
+def quality_vol_kernel(vert_ref, met_ref, tet_ref, q_ref, vol_ref):
+    """Pallas body: VMEM-resident tables, one corner gather, fused
+    quality+volume. f32/i32 on the compiled TPU path (PML011)."""
+    verts = vert_ref[...]
+    mets = met_ref[...]
+    idx = tet_ref[...]
+    q, vol = quality_vol_math(verts[idx], mets[idx])
+    q_ref[...] = q[:, None]
+    vol_ref[...] = vol[:, None]
+
+
+def pad_rows(a: jax.Array, blk: int) -> jax.Array:
+    """Pad the leading dim up to a multiple of `blk` (zero rows — the
+    padded outputs are sliced off by the wrapper)."""
+    n = a.shape[0]
+    npad = -(-max(n, 1) // blk) * blk
+    if npad == n:
+        return a
+    pad = [(0, npad - n)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, pad)
+
+
+def table_spec(shape):
+    """BlockSpec for a whole-array (VMEM-resident) table input."""
+    import jax.experimental.pallas as pl
+
+    return pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+
+
+def stream_spec(cols: int):
+    """BlockSpec for one BLK-row tile of a packed per-candidate
+    stream (index columns or per-row scalars)."""
+    import jax.experimental.pallas as pl
+
+    return pl.BlockSpec((BLK, cols), lambda i: (i, 0))
+
+
+def _quality_vol_pallas(vert, met, tet):
+    import jax.experimental.pallas as pl
+
+    n = tet.shape[0]
+    tetp = pad_rows(tet.astype(jnp.int32), BLK)
+    npad = tetp.shape[0]
+    q, vol = pl.pallas_call(
+        quality_vol_kernel,
+        grid=(npad // BLK,),
+        in_specs=[
+            table_spec(vert.shape),
+            table_spec(met.shape),
+            stream_spec(4),
+        ],
+        out_specs=(stream_spec(1), stream_spec(1)),
+        out_shape=(
+            jax.ShapeDtypeStruct((npad, 1), vert.dtype),
+            jax.ShapeDtypeStruct((npad, 1), vert.dtype),
+        ),
+        interpret=registry.interpret(),
+    )(vert, met, tetp)
+    return q[:n, 0], vol[:n, 0]
+
+
+def _quality_vol_cost(vert, met, tet):
+    n = tet.shape[0]
+    itemsize = jnp.dtype(vert.dtype).itemsize
+    table_b = vert.size * itemsize + met.size * itemsize
+    stream_b = tet.size * 4 + 2 * n * itemsize
+    # ~40 flops for the volume triple product, ~6*(4..25) for the edge
+    # lengths, plus the mean/pow tail — order-of-magnitude anchor
+    per_row = 160 if met.shape[1] == 1 else 420
+    return dict(flops=float(per_row * n),
+                bytes_accessed=float(table_b + stream_b))
+
+
+registry.register(
+    "quality_vol", _quality_vol_pallas, _quality_vol_ref,
+    doc="fused per-tet quality + signed volume over a packed int32 "
+        "tet stream (collapse/swap/smooth/quality call sites)",
+    est_cost=_quality_vol_cost,
+)

@@ -1,0 +1,175 @@
+"""Named-kernel registry and backend dispatch for the Pallas subsystem.
+
+Every hand-fused Pallas kernel registers here as a pair
+``{pallas_impl, lax_reference}`` under a stable name; op-layer call
+sites go through :func:`dispatch` and stay backend-agnostic. Selection
+is a process-wide *mode*:
+
+- ``auto`` (default): Pallas on TPU, the lax reference elsewhere — the
+  fused kernels exist for the TPU memory hierarchy; on CPU the XLA
+  fusion of the reference chain is the fast path.
+- ``off``: lax reference everywhere (the A/B baseline: bit-identical
+  to the pre-kernel code paths, which the references *are*).
+- ``on``: Pallas everywhere — ``interpret=True`` execution on
+  non-TPU backends, so tier-1 / check.sh exercise the kernel bodies
+  on every run (tools/kernel_smoke.py, tests/test_m18_kernels.py).
+- ``<csv>``: comma-separated allowlist of kernel names that run as
+  Pallas (interpret off-TPU); everything else takes the reference.
+
+Mode sources, strongest first: an explicit :func:`set_mode` (the
+``AdaptOptions.kernels`` plumbing in both drivers) > the
+``PMMGTPU_KERNELS`` environment variable > ``auto``.
+
+The dispatch decision is read at *trace time* (the call sites live in
+module-level jitted sweeps), so an effective-mode change must
+invalidate warmed traces: :func:`set_mode` calls ``jax.clear_caches()``
+when the effective mode actually changes. Mode flips are A/B events
+(bench, smoke), not hot-path events, so the recompile is the honest
+price of the switch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "Kernel", "register", "get", "names", "resolve_mode", "set_mode",
+    "use_mode", "enabled", "interpret", "dispatch",
+]
+
+_ENV = "PMMGTPU_KERNELS"
+
+
+@dataclasses.dataclass(frozen=True)
+class Kernel:
+    """One registered kernel: the fused Pallas implementation, its lax
+    reference (the exact pre-kernel computation — `off` mode routes
+    here, which is what makes the A/B bit-identical), and an analytic
+    I/O cost model for the roofline after-picture (the Pallas kernel's
+    bytes-moved contract is exactly its operand/result footprint)."""
+
+    name: str
+    pallas_impl: Callable
+    lax_reference: Callable
+    doc: str = ""
+    # est_cost(*args) -> dict(flops=..., bytes_accessed=...) for the
+    # fused kernel's I/O contract (tables counted once, index streams
+    # and outputs once) — fed to pl.CostEstimate and profile_ops
+    est_cost: Optional[Callable] = None
+
+
+_REGISTRY: Dict[str, Kernel] = {}
+# explicit mode override ([None] = fall through to the environment);
+# a one-element list so jitted closures never capture a stale binding
+_MODE = [None]
+_LOCK = threading.Lock()
+
+
+def register(name: str, pallas_impl: Callable, lax_reference: Callable,
+             doc: str = "", est_cost: Optional[Callable] = None) -> Kernel:
+    """Register (or re-register, e.g. on module reload) a kernel pair."""
+    k = Kernel(name, pallas_impl, lax_reference, doc, est_cost)
+    with _LOCK:
+        _REGISTRY[name] = k
+    return k
+
+
+def get(name: str) -> Kernel:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown kernel {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def names() -> tuple:
+    return tuple(sorted(_REGISTRY))
+
+
+def _normalize(mode: Optional[str]) -> str:
+    if mode is None or mode == "":
+        return "auto"
+    m = str(mode).strip().lower()
+    if m in ("auto",):
+        return "auto"
+    if m in ("off", "0", "none", "false"):
+        return "off"
+    if m in ("on", "1", "all", "force", "true"):
+        return "on"
+    return m  # csv allowlist, kept verbatim (lowercased)
+
+
+def resolve_mode() -> str:
+    """The effective mode: explicit override > PMMGTPU_KERNELS > auto."""
+    m = _MODE[0]
+    if m is None:
+        m = os.environ.get(_ENV)
+    return _normalize(m)
+
+
+def set_mode(mode: Optional[str]) -> str:
+    """Set the process kernel mode (None = defer to the environment
+    again). When the *effective* mode changes, warmed jit traces are
+    dropped (`jax.clear_caches`) — the dispatch decision is baked in at
+    trace time, so a stale trace would silently keep the old backend.
+    Returns the previous override value (for use_mode restore)."""
+    with _LOCK:
+        prev = _MODE[0]
+        before = resolve_mode()
+        _MODE[0] = mode
+        after = resolve_mode()
+    if before != after:
+        import jax
+
+        jax.clear_caches()
+    return prev
+
+
+@contextlib.contextmanager
+def use_mode(mode: Optional[str]):
+    """Scoped mode override (tests, smoke A/Bs)."""
+    prev = set_mode(mode)
+    try:
+        yield
+    finally:
+        set_mode(prev)
+
+
+def enabled(name: str) -> bool:
+    """Does `name` dispatch to its Pallas implementation right now?
+    Read at trace time by the jitted call sites (see set_mode)."""
+    mode = resolve_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    if mode == "auto":
+        import jax
+
+        return jax.default_backend() == "tpu"
+    allow = {s.strip() for s in mode.split(",") if s.strip()}
+    return name in allow
+
+
+def interpret() -> bool:
+    """Pallas execution mode for the current backend: compiled Mosaic
+    on TPU, `interpret=True` elsewhere (the CPU path tier-1 and the
+    kernel smoke exercise)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def dispatch(name: str, *args, **kwargs):
+    """The single backend-agnostic entry point: route to the Pallas
+    implementation when the mode admits `name`, else to the lax
+    reference. Both implementations share one calling convention per
+    kernel (documented at the registration site)."""
+    k = get(name)
+    impl = k.pallas_impl if enabled(name) else k.lax_reference
+    return impl(*args, **kwargs)

@@ -69,6 +69,9 @@ RULES: Dict[str, str] = {
     "PML009": "jnp.arange without explicit dtype (int64 under x64)",
     "PML010": "host clock inside jit-reachable code (measures trace "
               "time, not run time — use obs.trace spans)",
+    "PML011": "Pallas kernel registration hygiene (paired lax "
+              "reference + equivalence test; f32/i32-only kernel "
+              "bodies, no host numpy)",
 }
 
 # host-clock reads that are meaningless under trace (PML010): they
@@ -355,6 +358,108 @@ class _FuncChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+KERNEL_TEST_MODULE = "test_m18_kernels.py"
+
+
+def _kernels_module(mi: ModuleInfo) -> bool:
+    parts = mi.path.replace("\\", "/").split("/")
+    return "kernels" in parts
+
+
+def _kernel_test_source(mi: ModuleInfo) -> Optional[str]:
+    """Source of tests/test_m18_kernels.py next to the package holding
+    this kernels module (None when unreadable)."""
+    import os
+
+    parts = mi.path.replace("\\", "/").split("/")
+    try:
+        idx = parts.index("parmmg_tpu")
+    except ValueError:
+        return None
+    root = os.path.join(*parts[:idx]) if idx else "."
+    path = os.path.join(root, "tests", KERNEL_TEST_MODULE)
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _check_kernels_module(mi: ModuleInfo, findings: List[Finding]) -> None:
+    """PML011 — the Pallas kernel subsystem contract:
+
+    1. every `register(...)` in a kernels module must pair a
+       `pallas_impl` with a `lax_reference` (3 positional args or the
+       explicit keywords);
+    2. the registered kernel name must appear in
+       tests/test_m18_kernels.py — no kernel lands without an
+       equivalence test module covering it;
+    3. kernel BODIES (functions named `*_kernel`) are what Mosaic
+       compiles for TPU: f32/i32 only — f64 dtypes/constants and
+       host-side `np.` calls are flagged.
+    """
+    test_src = None
+    test_src_loaded = False
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _leaf_name(node.func) != "register":
+            continue
+        kwnames = {kw.arg for kw in node.keywords}
+        has_pair = len(node.args) >= 3 or (
+            {"pallas_impl", "lax_reference"} <= kwnames
+        )
+        if not has_pair:
+            findings.append(Finding(
+                "PML011", mi.path, node.lineno, node.col_offset,
+                "kernel registration without a paired lax reference — "
+                "every pallas_impl needs its exact lax counterpart "
+                "(the off-mode / equivalence baseline)",
+            ))
+        name_node = node.args[0] if node.args else None
+        if isinstance(name_node, ast.Constant) and isinstance(
+                name_node.value, str):
+            if not test_src_loaded:
+                test_src = _kernel_test_source(mi)
+                test_src_loaded = True
+            if test_src is not None and name_node.value not in test_src:
+                findings.append(Finding(
+                    "PML011", mi.path, node.lineno, node.col_offset,
+                    f"registered kernel {name_node.value!r} has no "
+                    f"equivalence coverage in tests/{KERNEL_TEST_MODULE}",
+                ))
+    # kernel bodies: f32/i32 only, no host numpy
+    for fi in mi.funcs.values():
+        if not fi.node.name.endswith("_kernel"):
+            continue
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Attribute):
+                dotted = _dotted_root(mi, node) or ""
+                if dotted.split(".")[0] == "numpy":
+                    findings.append(Finding(
+                        "PML011", mi.path, node.lineno, node.col_offset,
+                        "host-side numpy inside a Pallas kernel body — "
+                        "kernel bodies trace to Mosaic; use jnp",
+                        func=fi.key,
+                    ))
+                if dotted in ("jax.numpy.float64", "jax.numpy.int64",
+                              "numpy.float64", "numpy.int64"):
+                    findings.append(Finding(
+                        "PML011", mi.path, node.lineno, node.col_offset,
+                        f"{node.attr} inside a Pallas kernel body — TPU "
+                        "Pallas is f32/i32",
+                        func=fi.key,
+                    ))
+            elif isinstance(node, ast.Constant) and node.value in (
+                    "float64", "int64", "f8"):
+                findings.append(Finding(
+                    "PML011", mi.path, node.lineno, node.col_offset,
+                    f"{node.value!r} dtype constant inside a Pallas "
+                    "kernel body — TPU Pallas is f32/i32",
+                    func=fi.key,
+                ))
+
+
 def _is_memoize_decorator(dec: ast.AST) -> bool:
     target = dec.func if isinstance(dec, ast.Call) else dec
     return _leaf_name(target) in ("lru_cache", "cache", "memoize")
@@ -459,6 +564,8 @@ def run_lint(
             ))
             continue
         _check_module_level(mi, findings)
+        if _kernels_module(mi):
+            _check_kernels_module(mi, findings)
         seen_nodes = set()
         for fi in mi.funcs.values():
             if id(fi.node) in seen_nodes:

@@ -103,6 +103,14 @@ class AdaptOptions:
     # the default EVERYWHERE (CLI -nofrontier / False = full-table
     # sweeps, the pre-frontier behavior kept as the A/B baseline).
     frontier: bool = True
+    # Pallas kernel subsystem selection (parmmg_tpu.kernels.registry):
+    # None leaves the process mode alone (PMMGTPU_KERNELS env, default
+    # "auto" = Pallas on TPU / lax elsewhere); "off" = lax references
+    # everywhere (bit-identical A/B baseline), "on" = Pallas everywhere
+    # (interpret=True off-TPU), or a csv allowlist of kernel names.
+    # Applied process-wide at driver entry; an effective-mode change
+    # drops warmed jit traces (the dispatch is baked in at trace time).
+    kernels: Optional[str] = None
     # --- fail-safe layer (parmmg_tpu.failsafe) ---------------------------
     # phase-boundary validation level: "off" | "basic" (device
     # finiteness + positive orientation, one fused reduce) | "full"
@@ -1325,6 +1333,10 @@ def adapt(
     from ..lint import contracts
 
     opts = opts or AdaptOptions()
+    if opts.kernels is not None:
+        from ..kernels import registry as kernels_registry
+
+        kernels_registry.set_mode(opts.kernels)
     if checkpoint_dir is not None:
         opts = dataclasses.replace(opts, checkpoint_dir=checkpoint_dir)
     if opts.mem_budget_mb is None:

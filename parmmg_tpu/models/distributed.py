@@ -251,9 +251,13 @@ def _spmd_sweep_fn(dmesh, ecap, noinsert, noswap, nomove, nosurf,
                 _unsqueeze(fro),
             )
 
+        # check_rep=False: this jax's shard_map has no replication rule
+        # for pallas_call, which the sweep body reaches when the kernel
+        # subsystem dispatches Pallas (every operand/output is
+        # explicitly specced, so the check adds nothing here)
         return jax.jit(jax.shard_map(
             body_fr, mesh=dmesh, in_specs=(P(AXIS), P(), P(AXIS)),
-            out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+            out_specs=(P(AXIS), P(AXIS), P(AXIS)), check_rep=False,
         ))
 
     def body(blk, hausd):
@@ -267,9 +271,10 @@ def _spmd_sweep_fn(dmesh, ecap, noinsert, noswap, nomove, nosurf,
             lambda x: x[None], stats
         )
 
+    # check_rep=False: see body_fr above (pallas_call under shard_map)
     return jax.jit(jax.shard_map(
         body, mesh=dmesh, in_specs=(P(AXIS), P()),
-        out_specs=(P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS)), check_rep=False,
     ))
 
 
@@ -681,6 +686,10 @@ def adapt_distributed(
     from .. import failsafe
 
     opts = opts or DistOptions()
+    if opts.kernels is not None:
+        from ..kernels import registry as kernels_registry
+
+        kernels_registry.set_mode(opts.kernels)
     nparts = opts.nparts
     fs = failsafe.harness(opts, driver="distributed")
 

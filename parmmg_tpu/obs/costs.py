@@ -286,7 +286,23 @@ def attribute(cost_docs: Dict[str, dict], span_table: Dict[str, dict],
     for name, doc in cost_docs.items():
         span = span_table.get(name)
         calls = int(span["count"]) if span else 0
-        mean_s = (span["total_us"] / calls / 1e6) if calls else 0.0
+        cold = False
+        if calls > 1 and span.get("first_us") is not None:
+            # drop the first sample per span: on a cold-cache trace it
+            # folds the jit compile into the device-span mean, turning
+            # the %-of-roof fraction into fiction (the PR-8 wart). A
+            # 1-warmup trace therefore changes the reported mean.
+            mean_s = (
+                (span["total_us"] - span["first_us"]) / (calls - 1) / 1e6
+            )
+        elif calls:
+            # a single sample cannot be separated from its compile —
+            # keep it, flagged cold, so the fraction is readable as an
+            # upper bound on the honest mean
+            mean_s = span["total_us"] / calls / 1e6
+            cold = True
+        else:
+            mean_s = 0.0
         plat = platform or doc.get("platform", "cpu")
         row = dict(
             name=name, calls=calls, mean_s=mean_s,
@@ -295,6 +311,8 @@ def attribute(cost_docs: Dict[str, dict], span_table: Dict[str, dict],
             variants=doc.get("variants", 1),
             platform=plat,
         )
+        if cold:
+            row["cold"] = True
         if "error" in doc:
             row["error"] = doc["error"]
         row.update(roofline(row["flops"], row["bytes_accessed"],

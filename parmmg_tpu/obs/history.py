@@ -256,7 +256,9 @@ def backfill_records(repo_dir: str) -> List[dict]:
                 doc = json.load(f)
             except json.JSONDecodeError:
                 continue
-        if "cmd" in doc and "tail" in doc:
+        if isinstance(doc, list):
+            recs = doc  # committed A/B pair (r07 shape: [off, on])
+        elif "cmd" in doc and "tail" in doc:
             recs = _wrapper_records(doc)
         else:
             recs = [doc]  # raw record file (r06 shape)
@@ -346,8 +348,14 @@ def gate(db: List[dict], rec: dict, window: int = 8,
     base = [r for r in db
             if _group_key(r) == key and not r.get("partial")]
     if not base:
+        # coarse fallback (platform, metric) still honors the Pallas-
+        # kernel marker: a `…-pk` rung must never be gated against
+        # lax-baseline history (and vice versa) — the two backends are
+        # distinct baseline keys by contract
+        pk = str(key[1]).endswith("-pk")
         base = [r for r in db
                 if (r.get("platform"), r.get("metric")) == (key[0], key[2])
+                and str(r.get("rung", "")).endswith("-pk") == pk
                 and not r.get("partial")]
     base = base[-window:]
     res = GateResult(key, len(base))

@@ -80,6 +80,11 @@ def _span_table(events: List[dict]) -> Dict[str, dict]:
         dur = int(ev.get("dur", 0))
         row["total_us"] += dur
         row["max_us"] = max(row["max_us"], dur)
+        # first completed sample per span name: on a cold-cache trace
+        # it contains the jit compile, so the roofline attribution
+        # (obs.costs.attribute) drops it from the per-call mean
+        if row["count"] == 1:
+            row["first_us"] = dur
     return table
 
 

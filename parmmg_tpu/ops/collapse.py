@@ -33,6 +33,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .. import kernels
 from ..core import metric as metric_mod
 from ..core import tags
 from ..core.mesh import Mesh
@@ -152,8 +153,9 @@ def collapse_short_edges(
         ncand = jnp.sum(cand.astype(jnp.int32)).astype(jnp.int32)
 
         # win-independent quantities, hoisted out of the evaluation
-        q_old = common.quality_of(mesh.vert, mesh.met, tet)
-        vol_old = common.vol_of(mesh.vert, tet)
+        # (fused quality+volume: one pass over the tet stream instead
+        # of two — kernels.quality_vol, Pallas on TPU)
+        q_old, vol_old = kernels.quality_vol(mesh.vert, mesh.met, tet)
         # scale-relative positivity (common.POS_VOL_FRAC of the tet's own
         # old volume)
         vol_floor = common.POS_VOL_FRAC * jnp.abs(vol_old)
@@ -191,8 +193,13 @@ def collapse_short_edges(
             new_tet = jnp.where(
                 (tet == src_t[:, None]) & is_ball[:, None], dst_t[:, None], tet
             )
-            q_new = common.quality_of(mesh.vert, mesh.met, new_tet)
-            vol_new = common.vol_of(mesh.vert, new_tet)
+            # fused cavity evaluation (the round-9 740 ms target): the
+            # retargeted ring's quality, new volumes, and the
+            # positivity gate in ONE VMEM-resident pass — the kernel
+            # emits exactly the ball-min operand
+            gate_new = kernels.collapse_cavity(
+                mesh.vert, mesh.met, new_tet, vol_floor
+            )
 
             # --- geometric validity per winner --------------------------------
             inf = jnp.inf
@@ -200,7 +207,7 @@ def collapse_short_edges(
                 q_old, mode="drop"
             )
             ball_new = jnp.full(ecap, inf).at[jnp.where(is_ball, e_t, ecap)].min(
-                jnp.where(vol_new > vol_floor, q_new, -inf), mode="drop"
+                gate_new, mode="drop"
             )
             # accept if the new ball keeps ~a third of the old worst quality
             # (the class of criterion Mmg's colver uses) or is absolutely

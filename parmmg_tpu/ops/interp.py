@@ -22,6 +22,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from .. import kernels
 from ..core import metric as metric_mod, tags
 from ..core.mesh import Mesh
 from . import locate
@@ -39,6 +40,26 @@ def interp_at(
     """
     vids = old.tet[tet_idx]  # [Q,4]
     met = metric_mod.interp_metric(old.met[vids], bary)
+
+    def lin(a):
+        return jnp.einsum("qk,qkc->qc", bary, a[vids])
+
+    return met, lin(old.ls), lin(old.disp), lin(old.fields)
+
+
+# parmmg-lint: disable=PML005 -- the background mesh is queried repeatedly across calls
+@jax.jit
+def interp_at_points(old: Mesh, tet_idx: jax.Array, pts: jax.Array):
+    """Fused pull at walk-located points (`kernels.interp_bary`):
+    recompute the clamped barycentric weights from the located tet and
+    the query point — the exact expression the walk's own final step
+    evaluates, so the weights match `LocateResult.bary` — and
+    interpolate the metric in the same pass; ls/disp/fields ride the
+    returned weights. The Pallas path keeps the vertex/metric tables
+    VMEM-resident; the lax reference is the historical
+    locate-then-`interp_at` chain."""
+    vids = old.tet[tet_idx]  # [Q,4]
+    bary, met = kernels.interp_bary(old.vert, old.met, vids, pts)
 
     def lin(a):
         return jnp.einsum("qk,qkc->qc", bary, a[vids])
@@ -104,10 +125,19 @@ def _check_families(new: Mesh, old: Mesh):
 
 
 def _apply_interp(new: Mesh, old: Mesh, res, surface: bool,
-                  cos_wedge: float = locate._COS_WEDGE) -> Mesh:
+                  cos_wedge: float = locate._COS_WEDGE,
+                  pts: jax.Array | None = None) -> Mesh:
     """Pure (vmappable) application step: pull values at the located
-    tets, overlay the surface path for BDY vertices, respect REQUIRED."""
-    met_q, ls_q, disp_q, f_q = interp_at(old, res.tet, res.bary)
+    tets, overlay the surface path for BDY vertices, respect REQUIRED.
+
+    `pts` (the query points the walk located, when the caller still
+    holds them) routes the volume pull through the fused
+    locate+interpolate kernel; without them the historical
+    `interp_at(res.bary)` path is used."""
+    if pts is None:
+        met_q, ls_q, disp_q, f_q = interp_at(old, res.tet, res.bary)
+    else:
+        met_q, ls_q, disp_q, f_q = interp_at_points(old, res.tet, pts)
 
     if surface:
         from .analysis import surf_tria_mask
@@ -179,7 +209,8 @@ def interp_metrics_and_fields(
     """
     _check_families(new, old)
     res = locate.locate_points(old, new.vert, max_steps=max_steps)
-    return _apply_interp(new, old, res, surface, cos_wedge), res
+    return _apply_interp(new, old, res, surface, cos_wedge,
+                         pts=new.vert), res
 
 
 # parmmg-lint: disable=PML005 -- old/new meshes are both reused by the caller after interpolation
@@ -195,7 +226,8 @@ def _interp_all_shards(new: Mesh, old: Mesh, max_steps: int, surface: bool,
         pts = jnp.where(n.vmask[:, None], n.vert, n.vert[0])
         seeds = locate.morton_seeds(o, pts)
         res = locate.walk_locate(o, pts, seeds, max_steps=max_steps)
-        return _apply_interp(n, o, res, surface, cos_wedge), res.found
+        return _apply_interp(n, o, res, surface, cos_wedge,
+                             pts=pts), res.found
 
     return jax.vmap(one)(new, old)
 

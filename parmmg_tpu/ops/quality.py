@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import metric as metric_mod
-from ..core.mesh import EDGE_VERTS, Mesh, tet_volumes
+from ..core.mesh import Mesh
 
 # normalization: regular tet edge a has V = a^3 sqrt(2)/12, sum l^2 = 6 a^2
 ALPHA = 6.0**1.5 * 12.0 / math.sqrt(2.0)
@@ -32,27 +32,15 @@ BADQUAL = 0.012
 
 
 def tet_quality(mesh: Mesh) -> jax.Array:
-    """[TC] quality in (0,1] for valid tets (0 where masked/degenerate)."""
-    vol = tet_volumes(mesh)
-    ev = mesh.tet[:, EDGE_VERTS]  # [T,6,2]
-    p0, p1 = mesh.vert[ev[..., 0]], mesh.vert[ev[..., 1]]
-    if mesh.aniso:
-        # tet metric = arithmetic mean of vertex tensors (cheap, SPD)
-        mt = jnp.mean(mesh.met[mesh.tet], axis=1)  # [T,6]
-        M = metric_mod.sym6_to_mat(mt)
-        e = p1 - p0
-        l2 = jnp.einsum("tei,tij,tej->te", e, M, e)
-        det = metric_mod.metric_det(mt)
-        volm = vol * jnp.sqrt(jnp.maximum(det, 0.0))
-    else:
-        h = jnp.mean(mesh.met[mesh.tet, 0], axis=1)  # [T]
-        e = p1 - p0
-        l2 = jnp.sum(e * e, axis=-1) / jnp.maximum(h[:, None] ** 2, 1e-30)
-        volm = vol / jnp.maximum(h**3, 1e-30)
-    rap = jnp.sum(l2, axis=-1)
-    q = ALPHA * volm / jnp.maximum(rap, 1e-30) ** 1.5
-    q = jnp.where(mesh.tmask, q, 0.0)
-    return jnp.where(jnp.isfinite(q), q, 0.0)
+    """[TC] quality in (0,1] for valid tets (0 where masked/degenerate).
+
+    Routed through the `quality_vol` kernel dispatch (Pallas on TPU,
+    the fused lax reference elsewhere) — the same expression DAG this
+    function historically inlined, so values are unchanged."""
+    from .. import kernels  # deferred: the kernel modules import this module
+
+    q, _ = kernels.quality_vol(mesh.vert, mesh.met, mesh.tet)
+    return jnp.where(mesh.tmask, q, 0.0)
 
 
 @jax.tree_util.register_dataclass

@@ -25,6 +25,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .. import kernels
 from ..core import tags
 from ..core.mesh import Mesh
 from . import common
@@ -164,10 +165,11 @@ def smooth_vertices(
         disp = jnp.where(surf_v[:, None] & has_cnt, d_surf, disp)
         target = vert0 + relax * disp
 
-        q_old = common.quality_of(vert0, mesh.met, mesh.tet)
+        # fused quality+volume of the pre-move configuration
+        q_old, vol0 = kernels.quality_vol(vert0, mesh.met, mesh.tet)
         # scale-relative inversion floor (common.POS_VOL_FRAC of the
         # pre-move volume)
-        vol_floor = common.POS_VOL_FRAC * jnp.abs(common.vol_of(vert0, mesh.tet))
+        vol_floor = common.POS_VOL_FRAC * jnp.abs(vol0)
 
         # surface-fold guard: original tria normals to compare against
         tri = mesh.tria
@@ -180,8 +182,7 @@ def smooth_vertices(
         nr_old = jnp.linalg.norm(r_old, axis=1)
 
         def bad_entities(pos):
-            q_new = common.quality_of(pos, mesh.met, mesh.tet)
-            vol = common.vol_of(pos, mesh.tet)
+            q_new, vol = kernels.quality_vol(pos, mesh.met, mesh.tet)
             bad_t = mesh.tmask & ((vol <= vol_floor) | (q_new < qfactor * q_old))
             r_new = tria_normals_at(pos)
             nr_new = jnp.linalg.norm(r_new, axis=1)

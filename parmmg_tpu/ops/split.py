@@ -27,6 +27,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .. import kernels
 from ..core import metric as metric_mod
 from ..core import tags
 from ..core.mesh import EDGE_VERTS, Mesh
@@ -212,21 +213,11 @@ def split_long_edges(
                 - jnp.einsum("ei,ei->e", e_vec, na_)[:, None] * na_
             ) / 8.0
             mid_c = mid + corr
-            # per-tet validity of the offset midpoint
-            c = mesh.vert[mesh.tet]                   # [TC,4,3]
+            # per-tet validity of the offset midpoint: both child
+            # volumes vs the parent positivity floor, fused
+            # (kernels.split_midpoint — one pass over the tet stream)
             newp = mid_c[e_of_t]                      # [TC,3]
-            cA = c.at[rows, lj].set(newp)
-            cB = c.at[rows, li].set(newp)
-
-            def _vol(cc):
-                d1 = cc[:, 1] - cc[:, 0]
-                d2 = cc[:, 2] - cc[:, 0]
-                d3 = cc[:, 3] - cc[:, 0]
-                return jnp.einsum("ti,ti->t", jnp.cross(d1, d2), d3) / 6.0
-
-            vol_p = jnp.abs(_vol(c))
-            floor = common.POS_VOL_FRAC * vol_p
-            okt = (_vol(cA) > floor) & (_vol(cB) > floor)
+            okt = kernels.split_midpoint(mesh.vert, mesh.tet, newp, li, lj)
             bad_off = jnp.zeros(ecap, bool).at[
                 jnp.where(has & ~okt, e_of_t, ecap)
             ].max(True, mode="drop")

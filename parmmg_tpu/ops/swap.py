@@ -28,6 +28,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .. import kernels
 from ..core import tags
 from ..core.mesh import FACE_VERTS, Mesh
 from . import common
@@ -101,8 +102,8 @@ def swap_32(
     )
     off1 = tet[:, OFF[:, 0]]                   # [TC,6]
     off2 = tet[:, OFF[:, 1]]
-    q_old = common.quality_of(mesh.vert, mesh.met, tet)
-    vol_all = common.vol_of(mesh.vert, tet)
+    # fused quality+volume over the full tet table (kernels dispatch)
+    q_old, vol_all = kernels.quality_vol(mesh.vert, mesh.met, tet)
 
     ring_sum = jnp.zeros(ecap, jnp.int32).at[flat_e].add(
         (off1 + off2).reshape(-1), mode="drop"
@@ -159,13 +160,15 @@ def swap_32(
         s1 = jnp.clip(ssum[pick] - smin[pick] - smax[pick], 0, tcap - 1)
         shell_q = shell_min_q[pick]
 
-        # new configuration (compacted rows only)
+        # new configuration (compacted rows only) — both candidate tets
+        # stacked through ONE fused quality+volume pass
         t1 = _oriented(jnp.stack([uk, vk, wk_, ak], axis=1), mesh.vert)
         t2_ = _oriented(jnp.stack([uk, wk_, vk, bk], axis=1), mesh.vert)
-        q1 = common.quality_of(mesh.vert, mesh.met, t1)
-        q2 = common.quality_of(mesh.vert, mesh.met, t2_)
-        v1 = common.vol_of(mesh.vert, t1)
-        v2 = common.vol_of(mesh.vert, t2_)
+        q12, v12 = kernels.quality_vol(
+            mesh.vert, mesh.met, jnp.concatenate([t1, t2_], axis=0)
+        )
+        q1, q2 = q12[:K], q12[K:]
+        v1, v2 = v12[:K], v12[K:]
         # volume conservation rejects non-convex shells whose new tets are
         # individually positive but overlap outside the old shell
         shell_vol = vol_all[s0] + vol_all[s1] + vol_all[s2]
@@ -290,7 +293,7 @@ def swap_23(
     nb_full = adja.reshape(-1)
     t_id_full = jnp.arange(tcap * 4, dtype=jnp.int32) // 4
     t2_full = jnp.clip(nb_full // 4, 0, tcap - 1)
-    q_all = common.quality_of(mesh.vert, mesh.met, tet)
+    q_all, _ = kernels.quality_vol(mesh.vert, mesh.met, tet)
     pre = (
         (nb_full >= 0)
         & tmask[t2_full]
@@ -353,8 +356,13 @@ def swap_23(
             jnp.stack([z, x, d1, d2], axis=1),
         ]
         cands = [_oriented(c, mesh.vert) for c in cands]
-        qs = [common.quality_of(mesh.vert, mesh.met, c) for c in cands]
-        vs = [common.vol_of(mesh.vert, c) for c in cands]
+        # all three children of every candidate face through ONE fused
+        # quality+volume pass over the stacked stream
+        qcat, vcat = kernels.quality_vol(
+            mesh.vert, mesh.met, jnp.concatenate(cands, axis=0)
+        )
+        qs = [qcat[:K], qcat[K:2 * K], qcat[2 * K:]]
+        vs = [vcat[:K], vcat[K:2 * K], vcat[2 * K:]]
         new_min = jnp.minimum(jnp.minimum(qs[0], qs[1]), qs[2])
         vol_old2 = common.vol_of(mesh.vert, tet)
         pair_vol = vol_old2[t_id] + vol_old2[t2c]

@@ -65,6 +65,9 @@ def shard_fn(fn: Callable, dmesh: DeviceMesh, out_stacked: bool = True):
             mesh=dmesh,
             in_specs=(spec, spec),
             out_specs=spec if out_stacked else P(),
+            # arbitrary shard bodies may reach pallas_call (kernel
+            # subsystem dispatch) — no replication rule in this jax
+            check_rep=False,
         )
     )
 
@@ -81,9 +84,14 @@ def _sharded_hist_fn(dmesh: DeviceMesh):
         h = quality.quality_histogram(m)
         return quality.reduce_histograms(h, AXIS)
 
+    # check_rep=False: the histogram body reaches pallas_call when the
+    # kernel subsystem dispatches Pallas (tet_quality -> quality_vol),
+    # and this jax's shard_map has no replication rule for it; the
+    # reduced outputs are psum/pmin-replicated by construction
     return jax.jit(
         jax.shard_map(
-            body, mesh=dmesh, in_specs=(P(AXIS),), out_specs=P()
+            body, mesh=dmesh, in_specs=(P(AXIS),), out_specs=P(),
+            check_rep=False,
         )
     )
 

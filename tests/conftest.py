@@ -24,6 +24,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 from jax._src import xla_bridge as _xb  # noqa: E402
 
+# Pallas registers its Mosaic lowering rules for platform "tpu" at
+# import time and REFUSES when "tpu" is no longer a known platform —
+# import it while the accelerator factories are still registered (the
+# kernels subsystem runs interpret-mode Pallas on CPU in this suite).
+# Importing only registers lowerings; it does not initialize a backend.
+import jax.experimental.pallas  # noqa: F401, E402
+from jax.experimental.pallas import tpu as _pltpu  # noqa: F401, E402
+
 assert not _xb._default_backend, "conftest must run before jax backend init"
 for _accel in ("axon", "tpu", "cuda", "rocm"):
     _xb._backend_factories.pop(_accel, None)

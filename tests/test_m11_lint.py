@@ -506,3 +506,71 @@ def test_retrace_counter_and_budget():
     with pytest.raises(C.RetraceBudgetExceeded):
         with counter, counter.phase("strict", budget=0):
             jax.jit(lambda x: x * 7)(jnp.ones(6))
+
+
+# --- PML011 Pallas kernel registration hygiene ----------------------------
+
+
+def lint_kernels(tmp_path, src, name="mod.py"):
+    import textwrap as _tw
+
+    kdir = tmp_path / "kernels"
+    kdir.mkdir(exist_ok=True)
+    (kdir / name).write_text(_tw.dedent(src))
+    return run_lint([str(tmp_path)], root=str(tmp_path))
+
+
+def test_pml011_register_without_lax_reference_fires(tmp_path):
+    out = lint_kernels(tmp_path, HEADER + """
+    def register(*a, **k): ...
+    def _p(x): return x
+    register("orphan_kernel", _p)
+    """)
+    assert "PML011" in rule_ids(out)
+
+
+def test_pml011_paired_registration_clean(tmp_path):
+    out = lint_kernels(tmp_path, HEADER + """
+    def register(*a, **k): ...
+    def _p(x): return x
+    def _r(x): return x
+    register("good_kernel", _p, _r)
+    register("kw_kernel", pallas_impl=_p, lax_reference=_r)
+    """)
+    assert "PML011" not in rule_ids(out)
+
+
+def test_pml011_numpy_in_kernel_body_fires(tmp_path):
+    out = lint_kernels(tmp_path, HEADER + """
+    def my_kernel(x_ref, o_ref):
+        o_ref[...] = np.sum(x_ref[...])
+    """)
+    assert "PML011" in rule_ids(out)
+
+
+def test_pml011_f64_constant_in_kernel_body_fires(tmp_path):
+    out = lint_kernels(tmp_path, HEADER + """
+    def my_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...].astype("float64")
+    """)
+    assert "PML011" in rule_ids(out)
+
+
+def test_pml011_silent_outside_kernels_package(tmp_path):
+    (tmp_path / "plain.py").write_text(textwrap.dedent(HEADER + """
+    def register(*a, **k): ...
+    def _p(x): return x
+    register("orphan_kernel", _p)
+    def my_kernel(x_ref, o_ref):
+        o_ref[...] = np.sum(x_ref[...])
+    """))
+    out = run_lint([str(tmp_path)], root=str(tmp_path))
+    assert "PML011" not in rule_ids(out)
+
+
+def test_pml011_kernel_body_clean_jnp(tmp_path):
+    out = lint_kernels(tmp_path, HEADER + """
+    def ok_kernel(x_ref, o_ref):
+        o_ref[...] = jnp.sum(x_ref[...] * 2.0)
+    """)
+    assert "PML011" not in rule_ids(out)

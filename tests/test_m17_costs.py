@@ -378,3 +378,75 @@ def test_cost_docs_captured_and_report_renders(traced_cost_run):
     assert "cost attribution" in text
     assert "HBM peak bytes" in text
     assert "remesh_sweeps" in text
+
+
+def test_attribute_drops_cold_first_sample():
+    """The PR-8 wart: on a cold-cache trace the FIRST sample of every
+    span folds the jit compile into the device-span mean, so %-of-roof
+    was fiction. attribute() must drop the first sample per span —
+    i.e. a table with (and without) one huge warmup sample reports a
+    different, warm mean."""
+    docs = {"phase": dict(flops=1e6, bytes_accessed=1e9,
+                          platform="cpu")}
+    # 1 cold sample of 1 s + 4 warm samples of 1 ms each
+    cold = dict(count=5, total_us=1_000_000 + 4_000, max_us=1_000_000,
+                first_us=1_000_000)
+    rows = obs_costs.attribute(docs, {"phase": cold})
+    assert rows[0]["mean_s"] == pytest.approx(1_000 / 1e6)
+    naive = cold["total_us"] / cold["count"] / 1e6
+    assert rows[0]["mean_s"] != naive  # the 1-warmup trace changed it
+    assert "cold" not in rows[0]
+    # the %-of-roof follows the warm mean, not the compile-diluted one
+    warm_pct = rows[0]["pct_of_roof"]
+    legacy = obs_costs.attribute(
+        docs, {"phase": dict(count=5, total_us=cold["total_us"],
+                             max_us=1_000_000)}  # no first_us: old trace
+    )[0]
+    assert warm_pct > legacy["pct_of_roof"] * 10
+    # a single-sample span cannot be separated from its compile: kept,
+    # flagged cold
+    single = obs_costs.attribute(
+        docs, {"phase": dict(count=1, total_us=50, max_us=50,
+                             first_us=50)}
+    )[0]
+    assert single["cold"] is True
+    assert single["mean_s"] == pytest.approx(50 / 1e6)
+
+
+def test_span_table_records_first_sample():
+    events = [
+        dict(name="p", ph="X", ts=0, dur=900),
+        dict(name="p", ph="X", ts=1000, dur=10),
+        dict(name="p", ph="X", ts=2000, dur=12),
+    ]
+    table = obs_report._span_table(events)
+    assert table["p"]["first_us"] == 900
+    assert table["p"]["count"] == 3 and table["p"]["total_us"] == 922
+
+
+def test_kernels_rung_marker_and_gate_fallback_isolation():
+    """Kernel-on benches get a distinct `-pk` rung, and the gate's
+    coarse (platform, metric) fallback never mixes -pk and lax
+    history — kernel-on/off are distinct baseline keys."""
+    import bench
+
+    assert bench._rung_for_cfg(
+        dict(n=10, hsiz=0.05, kernels="on")) == "n10-hsiz0.05-pk"
+    assert bench._rung_for_cfg(
+        dict(n=10, hsiz=0.05, kernels="off")) == "n10-hsiz0.05"
+    assert bench._rung_for_cfg(
+        dict(dist=True, nparts=2, kernels="on")) == "dist-p2-pk"
+
+    db = [obs_history.make_record(
+        dict(metric="tets_per_sec", value=100.0, wall_s=10.0,
+             platform="cpu"), rung="n9-hsiz0.06") for _ in range(3)]
+    pk = obs_history.make_record(
+        dict(metric="tets_per_sec", value=1.0, wall_s=1000.0,
+             platform="cpu"), rung="n10-hsiz0.05-pk")
+    res = obs_history.gate(db, pk)
+    assert res.no_baseline  # lax history must not gate a -pk record
+    lax = obs_history.make_record(
+        dict(metric="tets_per_sec", value=90.0, wall_s=11.0,
+             platform="cpu"), rung="n10-hsiz0.05")
+    res2 = obs_history.gate(db, lax)
+    assert res2.baseline_n == 3  # same-marker coarse fallback intact

@@ -51,6 +51,15 @@ rc=$?
 echo "## frontier-smoke rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
+# Pallas-kernel smoke: interpret-mode run of every registered kernel
+# on the tiny fixture with equivalence vs its lax reference, vmap +
+# shard_map dispatch parity, and the PMMGTPU_KERNELS=off driver A/B
+# (off twice bit-identical; off-vs-on equivalent) on the cube mesh
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python tools/kernel_smoke.py
+rc=$?
+echo "## kernel-smoke rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
 # observability smoke: one tiny traced run must yield a structurally
 # valid Chrome trace + JSONL timeline, exact op counters, captured XLA
 # cost docs (cost table + HBM watermark line in the report), and a

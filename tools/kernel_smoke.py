@@ -1,0 +1,206 @@
+"""Pallas-kernel smoke for the CI gate (tools/check.sh, between the
+frontier stage and the obs stage).
+
+Interpret-mode execution of EVERY registered kernel on a tiny fixture
+with equivalence against its lax reference, the vmap / shard_map
+dispatch legs, and the driver-level A/B the kernels contract promises:
+
+1. registry sanity — every kernel pairs a pallas_impl with a
+   lax_reference, carries a doc and an analytic cost model;
+2. per-kernel interpret-vs-reference equivalence on mesh-shaped data
+   (bit-exact booleans; ULP-band tolerance for the float kernels —
+   the documented FMA/fusion story, see tests/test_m18_kernels.py);
+3. dispatch under vmap and under shard_map (check_rep=False, the SPMD
+   sweep setting);
+4. driver A/B on the cube mesh: ``PMMGTPU_KERNELS=off`` twice must be
+   bit-identical (the off path IS the pre-kernel chain), and
+   ``off`` vs ``on`` must land equivalent meshes (element count and
+   quality histogram within the kernel tolerance band).
+
+Exit 0 = the kernel subsystem is live and equivalent; any mismatch
+fails the gate.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+# Pallas registers Mosaic lowerings for platform "tpu" at import time
+# and refuses once "tpu" is deregistered — import it first (same
+# ordering as tests/conftest.py)
+import jax.experimental.pallas  # noqa: F401, E402
+from jax.experimental.pallas import tpu as _pltpu  # noqa: F401, E402
+
+for _accel in ("axon", "tpu", "cuda", "rocm"):
+    _xb._backend_factories.pop(_accel, None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import hashlib  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import parmmg_tpu  # noqa: F401, E402  (jax.shard_map alias)
+from parmmg_tpu import kernels  # noqa: E402
+from parmmg_tpu.kernels import registry  # noqa: E402
+from parmmg_tpu.models.adapt import AdaptOptions, adapt  # noqa: E402
+from parmmg_tpu.ops import common, quality  # noqa: E402
+from parmmg_tpu.utils.gen import unit_cube_mesh  # noqa: E402
+
+def _rtol(dtype) -> float:
+    """Documented interpret-vs-reference ULP band (FMA/fusion
+    differences amplified through the quality tail; see
+    tests/test_m18_kernels.py)."""
+    return 5e-6 if jnp.finfo(dtype).bits == 32 else 5e-11
+
+
+def _close(a, b, what):
+    a = np.asarray(a)
+    np.testing.assert_allclose(a, np.asarray(b), rtol=_rtol(a.dtype),
+                               atol=0, err_msg=what)
+
+
+def check_registry() -> None:
+    names = kernels.names()
+    assert {"collapse_cavity", "interp_bary", "quality_vol",
+            "split_midpoint"} <= set(names), names
+    for n in names:
+        k = registry.get(n)
+        assert callable(k.pallas_impl) and callable(k.lax_reference), n
+        assert k.doc and k.est_cost is not None, n
+    print(f"## registry: {len(names)} kernel(s) paired "
+          f"[{', '.join(names)}]")
+
+
+def check_kernels(mesh) -> None:
+    rng = np.random.default_rng(5)
+    vert, met, tet = mesh.vert, mesh.met, mesh.tet
+    with registry.use_mode("off"):
+        q0, v0 = kernels.quality_vol(vert, met, tet)
+    with registry.use_mode("on"):
+        q1, v1 = kernels.quality_vol(vert, met, tet)
+    _close(q1, q0, "quality_vol q")
+    _close(v1, v0, "quality_vol vol")
+
+    floor = common.POS_VOL_FRAC * jnp.abs(v0)
+    with registry.use_mode("off"):
+        g0 = kernels.collapse_cavity(vert, met, tet, floor)
+    with registry.use_mode("on"):
+        g1 = kernels.collapse_cavity(vert, met, tet, floor)
+    f0 = np.isfinite(np.asarray(g0))
+    assert (f0 == np.isfinite(np.asarray(g1))).all(), "cavity gate"
+    _close(np.asarray(g1)[f0], np.asarray(g0)[f0], "collapse_cavity")
+
+    n = tet.shape[0]
+    newp = jnp.asarray(rng.normal(size=(n, 3)), dtype=vert.dtype)
+    li = jnp.asarray(rng.integers(0, 4, n), dtype=jnp.int32)
+    lj = jnp.asarray(rng.integers(0, 4, n), dtype=jnp.int32)
+    with registry.use_mode("off"):
+        ok0 = kernels.split_midpoint(vert, tet, newp, li, lj)
+    with registry.use_mode("on"):
+        ok1 = kernels.split_midpoint(vert, tet, newp, li, lj)
+    assert (np.asarray(ok0) == np.asarray(ok1)).all(), "split_midpoint"
+
+    ne = int(mesh.ntet)
+    tids = rng.integers(0, max(ne, 1), size=256)
+    vids = jnp.asarray(np.asarray(jax.device_get(tet))[tids],
+                       dtype=jnp.int32)
+    pts = jnp.asarray(rng.uniform(0, 1, size=(256, 3)),
+                      dtype=vert.dtype)
+    with registry.use_mode("off"):
+        b0, m0 = kernels.interp_bary(vert, met, vids, pts)
+    with registry.use_mode("on"):
+        b1, m1 = kernels.interp_bary(vert, met, vids, pts)
+    _close(b1, b0, "interp_bary bary")
+    _close(m1, m0, "interp_bary met")
+    print("## per-kernel interpret-vs-reference equivalence OK")
+
+
+def check_vmap_shard_map(mesh) -> None:
+    from jax.sharding import Mesh as DeviceMesh, PartitionSpec as P
+
+    vert, met, tet = mesh.vert, mesh.met, mesh.tet
+
+    def f(t):
+        return kernels.quality_vol(vert, met, t)[0]
+
+    half = min(256, tet.shape[0] // 2)
+    ts = jnp.stack([tet[:half], tet[half:2 * half]])
+    with registry.use_mode("on"):
+        qp = jax.vmap(f)(ts)
+    with registry.use_mode("off"):
+        qr = jax.vmap(f)(ts)
+    _close(qp, qr, "vmap parity")
+
+    ndev = min(2, len(jax.devices()))
+    dmesh = DeviceMesh(np.array(jax.devices()[:ndev]), ("s",))
+    tflat = tet[: ndev * half]
+    # parmmg-lint: disable=PML004 -- one-shot smoke: the wrapper is built exactly twice per process
+    sm = jax.jit(jax.shard_map(
+        f, mesh=dmesh, in_specs=P("s"), out_specs=P("s"),
+        check_rep=False,
+    ))
+    with registry.use_mode("on"):
+        qsp = sm(tflat)
+    with registry.use_mode("off"):
+        qsr = sm(tflat)
+    _close(qsp, qsr, "shard_map parity")
+    print(f"## vmap + shard_map dispatch parity OK ({ndev} device(s))")
+
+
+def _digest(m) -> str:
+    s = hashlib.sha256()
+    for f in ("vert", "met", "tet", "tmask", "vmask", "tria", "trmask"):
+        s.update(np.asarray(jax.device_get(getattr(m, f))).tobytes())
+    return s.hexdigest()
+
+
+def check_driver_ab() -> None:
+    opts = dict(niter=1, hsiz=0.25, max_sweeps=4, hgrad=None)
+    try:
+        out_a, _ = adapt(unit_cube_mesh(4),
+                         AdaptOptions(kernels="off", **opts))
+        out_b, _ = adapt(unit_cube_mesh(4),
+                         AdaptOptions(kernels="off", **opts))
+        da, db = _digest(out_a), _digest(out_b)
+        assert da == db, f"off-mode runs not bit-identical: {da} {db}"
+        ha = quality.quality_histogram(out_a)
+        out_c, _ = adapt(unit_cube_mesh(4),
+                         AdaptOptions(kernels="on", **opts))
+        hc = quality.quality_histogram(out_c)
+    finally:
+        registry.set_mode(None)
+    ne_a, ne_c = int(out_a.ntet), int(out_c.ntet)
+    assert abs(ne_c - ne_a) <= max(8, 0.05 * ne_a), (ne_a, ne_c)
+    dq = abs(float(ha.qmin) - float(hc.qmin))
+    assert dq < 5e-2, f"qmin drifted across backends: {dq}"
+    print(f"## driver A/B OK: off bit-identical ({da[:12]}…), "
+          f"on ne={ne_c} vs off ne={ne_a}, |dqmin|={dq:.2e}")
+
+
+def main() -> int:
+    check_registry()
+    mesh = unit_cube_mesh(3)
+    check_kernels(mesh)
+    check_vmap_shard_map(mesh)
+    check_driver_ab()
+    print("## kernel smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,12 +12,23 @@ PERF_NOTES roofline tables.
 Usage:
 
     python tools/profile_ops.py [n] [hsiz] [reps] [--json <path>]
+        [--kernels auto|off|on|<csv>]
 
 `--json <path>` additionally commits the whole table as ONE
 PERF_DB-envelope record (metric ``profile_ops``, per-op rows under
 ``ops``) — append it with `tools/perf_gate.py --update-baseline`, or
 regenerate a PERF_NOTES table from the file instead of copy-pasting
 stdout.
+
+`--kernels` sets the Pallas kernel dispatch mode for the op rows
+(parmmg_tpu.kernels.registry). Independent of the mode, a per-kernel
+section profiles every REGISTERED kernel on the fixture's packed
+streams: the lax reference with its XLA-counted cost, and the Pallas
+implementation with its analytic I/O contract (`est_cost`) — the
+bytes-moved comparison that is the kernel's fusion claim. On non-TPU
+backends the Pallas timing is the interpret harness (correctness
+path), so only the bytes/intensity columns are meaningful there; run
+the same tool on TPU for achieved %-of-roof.
 """
 # parmmg-lint: disable-file=PML004,PML005 -- one-shot profiling harness: wrappers are built once per process and meshes are deliberately reused across repeats
 
@@ -60,6 +71,52 @@ def profile_op(name, jitfn, args, reps=5):
     return row
 
 
+def profile_kernels(mesh, reps):
+    """Per-registered-kernel rows: the lax reference (XLA-counted cost)
+    vs the Pallas implementation (analytic I/O contract) on the
+    fixture's packed streams — the after-picture of the fusion."""
+    import jax.numpy as jnp
+
+    from parmmg_tpu.kernels import registry as kreg
+    from parmmg_tpu.ops import common as ops_common
+
+    bc = jnp.mean(mesh.vert[mesh.tet], axis=1)
+    ntc = mesh.tet.shape[0]
+    zi = jnp.zeros(ntc, jnp.int32)
+    vol = ops_common.vol_of(mesh.vert, mesh.tet)
+    args_for = {
+        "quality_vol": (mesh.vert, mesh.met, mesh.tet),
+        "collapse_cavity": (mesh.vert, mesh.met, mesh.tet,
+                            ops_common.POS_VOL_FRAC * jnp.abs(vol)),
+        "split_midpoint": (mesh.vert, mesh.tet, bc, zi, zi + 1),
+        "interp_bary": (mesh.vert, mesh.met, mesh.tet, bc),
+    }
+    rows = []
+    for name in kreg.names():
+        k = kreg.get(name)
+        args = args_for.get(name)
+        if args is None:
+            continue
+        rows.append(profile_op(f"k:{name}/lax",
+                               jax.jit(k.lax_reference), args, reps))
+        est = k.est_cost(*args) if k.est_cost else dict(
+            flops=0.0, bytes_accessed=0.0)
+        pal = jax.jit(k.pallas_impl)
+        sec = obs_costs.timed_mean(lambda: pal(*args), reps=reps)
+        row = dict(op=f"k:{name}/pallas", ms=round(sec * 1e3, 3),
+                   flops=est["flops"],
+                   bytes_accessed=est["bytes_accessed"],
+                   cost_source="est_io")
+        row.update({
+            kk: v for kk, v in obs_costs.roofline(
+                row["flops"], row["bytes_accessed"], sec,
+                jax.devices()[0].platform,
+            ).items() if kk in ("intensity", "bound", "pct_of_roof")
+        })
+        rows.append(row)
+    return rows
+
+
 def main():
     pos, flags = parse_argv(sys.argv[1:])
     n = int(pos[0]) if pos else 8
@@ -68,10 +125,17 @@ def main():
 
     from parmmg_tpu.core import adjacency
     from parmmg_tpu.core.mesh import compact
+    from parmmg_tpu.kernels import registry as kreg
     from parmmg_tpu.models.adapt import AdaptOptions, adapt
     from parmmg_tpu.ops import analysis, collapse, smooth, split, swap
 
-    print(f"platform: {jax.devices()[0].platform}", flush=True)
+    if "kernels" in flags:
+        kreg.set_mode(flags["kernels"])
+    kmode = kreg.resolve_mode()
+    kernels_on = any(kreg.enabled(nm) for nm in kreg.names())
+    print(f"platform: {jax.devices()[0].platform}  "
+          f"kernels: {kmode} ({'pallas' if kernels_on else 'lax'})",
+          flush=True)
     import bench
 
     # the bench's own workload recipe (shared sizing formula + capacity
@@ -148,15 +212,33 @@ def main():
               f"{pct:>7s}  {r['bound']}")
     print(f"  TOTAL            {sum(r['ms'] for r in rows):8.1f}")
 
+    krows = profile_kernels(mesh, reps)
+    if krows:
+        print("\nregistered kernels: lax reference (XLA-counted) vs "
+              "Pallas (I/O contract):")
+        print(f"  {'kernel':<26s} {'ms':>8s} {'flops':>10s} "
+              f"{'bytes':>10s} {'F/B':>6s}  bound")
+        for r in krows:
+            print(f"  {r['op']:<26s} {r['ms']:8.1f} "
+                  f"{r['flops']:>10.3g} {r['bytes_accessed']:>10.3g} "
+                  f"{r['intensity']:>6.2f}  {r['bound']}")
+        if jax.devices()[0].platform != "tpu":
+            print("  (pallas ms on this backend = interpret harness — "
+                  "compare bytes/F/B here, time on TPU)")
+
     if "json" in flags:
+        rung = f"ops-n{n}-hsiz{hsiz:g}" + ("-pk" if kernels_on else "")
         rec = obs_history.make_record(dict(
             metric="profile_ops",
             value=round(sum(r["ms"] for r in rows), 3),
             unit="ms_total",
             ne=int(mesh.ntet), tcap=int(mesh.tcap), reps=reps,
             platform=jax.devices()[0].platform,
+            kernels=("on" if kernels_on else "off"),
+            kernels_mode=kmode,
             ops=rows,
-        ), rung=f"ops-n{n}-hsiz{hsiz:g}")
+            kernels_profile=krows,
+        ), rung=rung)
         tmp = flags["json"] + ".tmp"
         with open(tmp, "w") as f:
             json.dump(rec, f, indent=1)
