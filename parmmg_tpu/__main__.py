@@ -419,9 +419,22 @@ def _main_traced(argv=None) -> int:
     """CLI entry: run `main` and flush the process tracer afterwards,
     so the top-level Timers spans that close AFTER the driver's own
     flush (remeshing/output) still make it into the Chrome trace —
-    the JSONL log has them either way (per-line flush)."""
+    the JSONL log has them either way (per-line flush). The typed
+    checkpoint failures keep their documented exit codes here (the
+    same contract the chaos workers honor): 88 = resume refusal,
+    89 = checkpoint I/O abort (store retries exhausted, credential
+    rejected, corrupt payload past every fallback)."""
+    from . import failsafe
+    from .io.ckpt_store import CheckpointIOError
+
     try:
         return main(argv)
+    except failsafe.CheckpointMismatchError as e:
+        print(f"parmmg_tpu: {e}", file=sys.stderr)
+        return failsafe.MISMATCH_EXIT_CODE
+    except CheckpointIOError as e:
+        print(f"parmmg_tpu: {type(e).__name__}: {e}", file=sys.stderr)
+        return failsafe.CKPT_IO_EXIT_CODE
     finally:
         from .obs import trace as obs_trace
 

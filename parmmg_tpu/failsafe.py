@@ -419,12 +419,18 @@ FAULT_PHASES = (
 )
 FAULT_KINDS = (
     "nan", "overflow", "retrace", "kill", "sigterm", "ioerror", "slowio",
-    "preempt-notice",
+    "preempt-notice", "peer-lost",
 )
 # kinds that live at the ``ckpt`` phase: they fire inside the
 # checkpoint STORE (consumed per store operation via
 # `FaultPlan.io_fault`, not at a driver phase boundary)
 _IO_FAULT_KINDS = ("ioerror", "slowio")
+# everything the ckpt phase accepts: the store-op pair above plus
+# ``kill``, which at this phase means "die at the next manifest
+# PUBLISH at/after store op k" — i.e. INSIDE the two-barrier commit
+# window of the sharded checkpoint protocol, the nastiest spot a
+# preemption can land
+_CKPT_FAULT_KINDS = _IO_FAULT_KINDS + ("kill",)
 
 
 @dataclasses.dataclass
@@ -465,10 +471,19 @@ class FaultPlan:
       (`parallel.multihost.request_preemption_notice`) — the drivers
       force an out-of-cadence checkpoint at the next iteration boundary
       and keep running (the proactive half of preemption handling);
+    - ``peer-lost``: a simulated coordination-service peer-death
+      report on the targeted rank
+      (`parallel.multihost.simulate_peer_loss`) — its next
+      barrier/heartbeat raises the typed :class:`PeerLostError`
+      instead of hanging, exercising the survivor-side detection path
+      without actually killing a peer;
     - ``ioerror`` / ``slowio`` (``ckpt`` phase only): checkpoint-store
       I/O faults, consumed per STORE OPERATION via :meth:`io_fault` —
       for these the ``it<k>`` field indexes store ops (0-based, per
-      process), not iterations, so "fail the 3rd put" is expressible.
+      process), not iterations, so "fail the 3rd put" is expressible;
+      ``kill`` at the ``ckpt`` phase arms at store op ``it<k>`` but
+      fires at the next manifest PUBLISH — a death inside the
+      two-barrier commit window of the sharded protocol.
     """
 
     def __init__(self, faults: Optional[List[Fault]] = None,
@@ -508,11 +523,18 @@ class FaultPlan:
                 raise ValueError(
                     f"unknown fault kind {kind!r} (one of {FAULT_KINDS})"
                 )
-            if (phase == "ckpt") != (kind in _IO_FAULT_KINDS):
+            if kind in _IO_FAULT_KINDS and phase != "ckpt":
                 raise ValueError(
                     f"fault token {tok!r}: kinds {_IO_FAULT_KINDS} pair "
                     "exclusively with the 'ckpt' phase (store-operation "
-                    "faults), other kinds with the driver phases"
+                    "faults)"
+                )
+            if phase == "ckpt" and kind not in _CKPT_FAULT_KINDS:
+                raise ValueError(
+                    f"fault token {tok!r}: the 'ckpt' phase accepts "
+                    f"kinds {_CKPT_FAULT_KINDS} (store-operation "
+                    "faults; 'kill' = die at the next manifest "
+                    "publish), other kinds fire at driver phases"
                 )
             faults.append(Fault(it, phase, kind, rank=rank))
         return cls(faults, kill_mode=kill_mode)
@@ -566,12 +588,20 @@ class FaultPlan:
         ones; schedule at least `attempts` of them to force the typed
         :class:`~parmmg_tpu.io.ckpt_store.CheckpointIOError` abort.
         ``slowio`` outsleeps the store's per-op timeout (a no-op when
-        no timeout is configured), driving the timeout→retry path."""
+        no timeout is configured), driving the timeout→retry path.
+        ``kill`` arms at op k but fires only at the next manifest
+        PUBLISH — between the data barrier and the commit barrier of
+        the sharded protocol, so the chaos matrix can aim a preemption
+        INSIDE the commit window (the commit token never lands,
+        survivors get a typed PeerLostError, resume falls back to the
+        previous committed epoch)."""
         k = self._ckpt_ops
         self._ckpt_ops += 1
         for f in self.faults:
             if f.fired or f.phase != "ckpt" or not f.mine or f.it > k:
                 continue
+            if f.kind == "kill" and op != "publish":
+                continue  # armed, but only the commit token triggers it
             f.fired = True
             obs_trace.emit_event(
                 "fault_injected", kind=f.kind, phase="ckpt", op=op,
@@ -580,6 +610,20 @@ class FaultPlan:
             obs_metrics.registry().counter(
                 "failsafe/faults_injected"
             ).inc()
+            if f.kind == "kill":
+                if self.kill_mode == "raise":
+                    raise PreemptionError(
+                        f"injected commit-window preemption at store "
+                        f"op {k} ({op} {name!r}) (fault plan, "
+                        "kill_mode=raise)"
+                    )
+                print(
+                    f"[failsafe] injected commit-window preemption at "
+                    f"store op {k} ({op} {name!r}) — exiting with code "
+                    f"{KILL_EXIT_CODE}",
+                    flush=True,
+                )
+                os._exit(KILL_EXIT_CODE)
             if f.kind == "ioerror":
                 raise OSError(
                     f"injected checkpoint ioerror at store op {k} "
@@ -640,6 +684,20 @@ class FaultPlan:
                     "(fault plan)", flush=True,
                 )
                 multihost.request_preemption_notice(
+                    f"injected at {where} (fault plan)"
+                )
+            elif f.kind == "peer-lost":
+                # simulated coordination-service peer-death report on
+                # THIS rank: the next barrier/heartbeat refuses with a
+                # typed PeerLostError — the detection path a real dead
+                # peer drives, minus the dead peer
+                from .parallel import multihost
+
+                print(
+                    f"[failsafe] injected peer-loss report at {where} "
+                    "(fault plan)", flush=True,
+                )
+                multihost.simulate_peer_loss(
                     f"injected at {where} (fault plan)"
                 )
             elif f.kind == "sigterm":
@@ -1169,6 +1227,14 @@ class Checkpointer:
             meta["aux_arrays"] = {
                 k: arrs["aux/" + k] for k in doc.get("aux", ())
             }
+            # timeline record of the recovery: a chaos post-mortem
+            # chain ends fault → detection → RESUME, and this is the
+            # only place that knows which epoch the run came back from
+            obs_trace.emit_event(
+                "resume", it=int(doc["it"]), source_world=ck_world,
+                world=self.world,
+            )
+            obs_metrics.registry().counter("ckpt/resumes").inc()
             return ResumeState(
                 it=int(doc["it"]),
                 meshes=meshes,

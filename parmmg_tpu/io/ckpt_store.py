@@ -56,11 +56,46 @@ class CheckpointIOError(OSError):
     """A checkpoint-store operation failed after its bounded retries
     (or timed out). Typed so the drivers/harness can map it onto the
     graded-failure ladder (`failsafe.CKPT_IO_EXIT_CODE`) instead of an
-    untyped traceback."""
+    untyped traceback.
+
+    Subtypes form the TERMINAL half of the retry-status taxonomy: a
+    raw store attempt raising any `CheckpointIOError` subtype (other
+    than the timeout, which the retry envelope itself produces) is NOT
+    re-attempted — retrying a bad credential or a lost conditional
+    write cannot help and only delays the caller's typed exit."""
 
 
 class CheckpointTimeoutError(CheckpointIOError):
     """A single store operation exceeded its per-op timeout."""
+
+
+class CheckpointAuthError(CheckpointIOError):
+    """The store rejected our credentials (HTTP 401/403). Terminal:
+    no number of retries fixes a bad/expired token or missing bucket
+    ACL — fail typed and let the operator rotate the credential."""
+
+
+class CheckpointNotFoundError(CheckpointIOError, FileNotFoundError):
+    """The named object does not exist (HTTP 404). Also a
+    `FileNotFoundError`, so every pre-existing missing-object path
+    (load's fall-back-to-previous, delete's concurrent-GC tolerance)
+    handles a remote store identically to a local directory."""
+
+
+class CheckpointPreconditionError(CheckpointIOError):
+    """A conditional write lost its precondition (HTTP 412: the
+    ``if-generation-match`` guard on a manifest publish saw a
+    concurrent writer). Terminal for THIS attempt — the commit token
+    was taken by another publisher, and blindly overwriting it would
+    un-commit their epoch."""
+
+
+class CheckpointCorruptionError(CheckpointIOError, ValueError):
+    """A checkpoint payload is structurally corrupt (npz/zip CRC or
+    container damage — a torn object, bit rot). Also a ``ValueError``
+    so the loader's established fall-back-to-previous-epoch catch
+    keeps working; as a `CheckpointIOError` it maps onto exit code 89
+    when it escapes every fallback."""
 
 
 def _call_with_timeout(fn, timeout: float, what: str):
@@ -94,13 +129,31 @@ def _call_with_timeout(fn, timeout: float, what: str):
     return box.get("value")
 
 
+class TransientStoreError(OSError):
+    """A retryable backend failure (HTTP 408/429/5xx, a truncated or
+    timed-out transport, a dropped connection). Carries the optional
+    server-provided ``retry_after`` hint in seconds, which the seeded
+    backoff honors as a floor on the next delay
+    (`utils.retry.retry`)."""
+
+    def __init__(self, message: str, status: Optional[int] = None,
+                 retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.status = status
+        self.retry_after = retry_after
+
+
 def _retryable(exc: BaseException) -> bool:
     """Transient store failures worth re-attempting: timeouts and
-    OSErrors that are NOT a plain missing object (retrying a
+    OSErrors that are NOT (a) a plain missing object (retrying a
     FileNotFoundError cannot help and only delays the caller's
-    fallback-to-previous-checkpoint path)."""
+    fallback-to-previous-checkpoint path) or (b) a typed TERMINAL
+    member of the `CheckpointIOError` taxonomy (auth rejection,
+    precondition loss, corruption)."""
     if isinstance(exc, CheckpointTimeoutError):
         return True
+    if isinstance(exc, CheckpointIOError):
+        return False
     return isinstance(exc, OSError) and not isinstance(
         exc, FileNotFoundError
     )
@@ -144,6 +197,13 @@ class CheckpointStore:
     def _delete(self, name: str) -> None:
         raise NotImplementedError
 
+    def _publish(self, name: str, data: bytes) -> None:
+        """Raw commit-token put. Defaults to a plain `_put`; backends
+        with conditional-write support override it (the GCS adapter's
+        ``if-generation-match`` put) so the manifest-last commit token
+        stays atomic under concurrent publishers."""
+        self._put(name, data)
+
     # -- retry/timeout/fault envelope -----------------------------------
     def _op(self, op: str, name: str, fn):
         what = f"{op}:{name}" if name else op
@@ -175,7 +235,17 @@ class CheckpointStore:
             )
         except FileNotFoundError:
             raise
-        except (OSError, CheckpointTimeoutError) as e:
+        except CheckpointTimeoutError as e:
+            raise CheckpointIOError(
+                f"checkpoint {what} failed after {self.attempts} "
+                f"attempts: {e}"
+            ) from e
+        except CheckpointIOError:
+            # terminal taxonomy member (auth / precondition /
+            # corruption): already typed — propagate unchanged so the
+            # caller can tell WHY, not just that I/O failed
+            raise
+        except OSError as e:
             raise CheckpointIOError(
                 f"checkpoint {what} failed after {self.attempts} "
                 f"attempts: {e}"
@@ -198,8 +268,10 @@ class CheckpointStore:
     def publish(self, name: str, data: bytes) -> None:
         """Atomic commit-token put — identical durability to
         :meth:`put`; named separately because the checkpoint protocol's
-        correctness hangs on this object landing LAST."""
-        self._op("publish", name, lambda: self._put(name, bytes(data)))
+        correctness hangs on this object landing LAST (and backends
+        with conditional writes guard it against concurrent
+        publishers — see `_publish`)."""
+        self._op("publish", name, lambda: self._publish(name, bytes(data)))
         obs_metrics.registry().counter("ckpt/put_bytes").inc(len(data))
 
     def get(self, name: str) -> bytes:
@@ -336,6 +408,9 @@ def make_store(spec, dirpath: Optional[str] = None,
     - a :class:`CheckpointStore` instance passes through (its
       `fault_cb` is armed when unset);
     - ``"mem://<bucket>"`` — shared in-process :class:`ObjectStore`;
+    - ``"gs://<bucket>[/<prefix>]"`` — real GCS via the stdlib-HTTP
+      adapter (`io.gcs.GCSStore`; endpoint/auth per the PMMGTPU_GCS_*
+      env contract documented there);
     - ``"file://<dir>"`` or a plain path string — :class:`LocalFSStore`
       rooted there;
     - ``None`` — :class:`LocalFSStore` over `dirpath` (the
@@ -352,6 +427,10 @@ def make_store(spec, dirpath: Optional[str] = None,
     if isinstance(spec, str):
         if spec.startswith("mem://"):
             return ObjectStore(memory_bucket(spec[6:]), **kw)
+        if spec.startswith("gs://"):
+            from .gcs import GCSStore
+
+            return GCSStore.from_url(spec, **kw)
         if spec.startswith("file://"):
             return LocalFSStore(spec[7:], **kw)
         return LocalFSStore(spec, **kw)
@@ -376,15 +455,23 @@ def npz_bytes(arrays: Dict) -> bytes:
 
 def npz_arrays(data: bytes) -> Dict:
     """Deserialize npz bytes back to an eager {name: ndarray} dict.
-    Corrupt payloads (zip CRC/structure failures) surface as ValueError
-    so the checkpoint loader's fall-back-to-previous path catches them
-    uniformly."""
+    Corrupt payloads (zip CRC/structure failures) surface as the typed
+    :class:`CheckpointCorruptionError` — still a ``ValueError``, so the
+    checkpoint loader's fall-back-to-previous path catches them
+    uniformly, and a `CheckpointIOError`, so an escape past every
+    fallback maps onto exit code 89 instead of an untyped crash."""
     import zipfile
+    import zlib
 
     import numpy as np
 
     try:
         with np.load(_io.BytesIO(data)) as z:
             return {k: z[k] for k in z.files}
-    except zipfile.BadZipFile as e:
-        raise ValueError(f"corrupt npz payload: {e}") from e
+    except (zipfile.BadZipFile, zlib.error, ValueError, OSError) as e:
+        # BadZipFile (container), zlib.error (deflate stream), and
+        # np.load's own ValueError/OSError flavors on mangled bytes:
+        # all mean "this is not the npz we wrote"
+        raise CheckpointCorruptionError(
+            f"corrupt npz payload: {e}"
+        ) from e

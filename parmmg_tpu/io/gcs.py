@@ -1,0 +1,374 @@
+"""Real Google Cloud Storage adapter behind the `CheckpointStore`
+interface — the production durability backend the `ObjectStore`
+semantics (single-object atomic put, manifest-last commit) were
+modeled on.
+
+Pure stdlib HTTP (`urllib`) against the GCS JSON/upload API; no cloud
+SDK dependency, so the adapter runs anywhere the container runs and is
+testable hermetically against the in-repo fake server
+(``tests/fake_gcs.py``). Three pieces:
+
+- **typed retry-status taxonomy** (:func:`classify_http_status`): the
+  HTTP failure surface is split into RETRYABLE (408 request timeout,
+  429 rate limit — with the server's ``Retry-After`` hint honored as a
+  floor inside the seeded backoff of `utils.retry.retry` — and every
+  5xx, plus transport-level failures: refused/dropped connections,
+  truncated bodies, socket timeouts) raised as
+  `io.ckpt_store.TransientStoreError`, and TERMINAL statuses raised as
+  `CheckpointIOError` subtypes the retry envelope refuses to retry:
+
+  ======  ==========================================================
+  status  outcome
+  ======  ==========================================================
+  408     retry (request timeout)
+  429     retry, ``Retry-After`` floors the next seeded delay
+  5xx     retry (server fault)
+  401/403 `CheckpointAuthError` — rotate the credential, not retry
+  404     `CheckpointNotFoundError` (also ``FileNotFoundError``)
+  412     `CheckpointPreconditionError` — lost conditional write
+  other   `CheckpointIOError`
+  ======  ==========================================================
+
+- **pluggable auth** (:func:`resolve_token_provider`): a zero-arg
+  callable returning a bearer token or ``None`` (anonymous). Built-in
+  providers: the ``PMMGTPU_GCS_TOKEN`` env token (read per request, so
+  an external refresher can rotate it), the GCE metadata server
+  (cached until shortly before expiry), and anonymous (the fake
+  server / public buckets).
+
+- **conditional commit tokens**: `publish` routes through an
+  ``if-generation-match`` put — the object's current generation is
+  read and the upload is accepted only if it still holds (generation 0
+  = "only create"). Under concurrent publishers exactly one manifest
+  write wins; the loser gets the typed 412 instead of silently
+  un-committing the winner's epoch.
+
+Env contract (all optional):
+
+  PMMGTPU_GCS_ENDPOINT  API base URL (default
+                        ``https://storage.googleapis.com``; point it
+                        at a fake/emulator for hermetic runs)
+  PMMGTPU_GCS_TOKEN     static OAuth2 bearer token (env auth mode)
+  PMMGTPU_GCS_AUTH      ``env`` | ``metadata`` | ``anon`` — forces an
+                        auth mode; default: ``env`` when a token is
+                        set, ``metadata`` against the real Google
+                        endpoint, ``anon`` against anything else
+  PMMGTPU_GCS_METADATA  metadata-server base URL override (tests)
+
+Retry attempts/backoff/per-op timeout ride the shared PMMGTPU_CKPT_*
+contract through `ckpt_store.make_store` (``gs://bucket/prefix``
+specs resolve here); the fault-injection hook (`FaultPlan.io_fault`,
+the ``ckpt`` fault phase) applies unchanged through the base class's
+retry envelope.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, List, Optional
+
+from .ckpt_store import (
+    CheckpointAuthError,
+    CheckpointIOError,
+    CheckpointNotFoundError,
+    CheckpointPreconditionError,
+    CheckpointStore,
+    TransientStoreError,
+)
+
+DEFAULT_ENDPOINT = "https://storage.googleapis.com"
+_METADATA_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/"
+    "service-accounts/default/token"
+)
+
+
+def _parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """Seconds from a ``Retry-After`` header (delta-seconds form; the
+    HTTP-date form is ignored rather than parsed against a wall clock
+    the seeded backoff must not depend on)."""
+    if not value:
+        return None
+    try:
+        return max(float(value), 0.0)
+    except ValueError:
+        return None
+
+
+def classify_http_status(status: int, what: str,
+                         retry_after: Optional[str] = None,
+                         detail: str = "") -> OSError:
+    """The typed retry-status taxonomy: map an HTTP failure status to
+    the exception the store attempt should raise (returned, not
+    raised, so the mapping is unit-testable standalone)."""
+    msg = f"GCS {what}: HTTP {status}"
+    if detail:
+        msg += f": {detail}"
+    if status in (408, 429) or 500 <= status < 600:
+        return TransientStoreError(
+            msg, status=status,
+            retry_after=_parse_retry_after(retry_after),
+        )
+    if status in (401, 403):
+        return CheckpointAuthError(
+            f"{msg} — credential rejected (check PMMGTPU_GCS_TOKEN / "
+            "PMMGTPU_GCS_AUTH and the bucket ACL)"
+        )
+    if status == 404:
+        return CheckpointNotFoundError(msg)
+    if status == 412:
+        return CheckpointPreconditionError(
+            f"{msg} — conditional write lost its if-generation-match "
+            "guard (a concurrent publisher committed first)"
+        )
+    return CheckpointIOError(msg)
+
+
+# ---------------------------------------------------------------------------
+# auth-token providers
+# ---------------------------------------------------------------------------
+
+
+def env_token_provider() -> Optional[str]:
+    """The PMMGTPU_GCS_TOKEN bearer token, read per request so an
+    external refresher can rotate the env var without a restart."""
+    return os.environ.get("PMMGTPU_GCS_TOKEN") or None
+
+
+class MetadataTokenProvider:
+    """GCE/Cloud-TPU metadata-server token, cached until 60 s before
+    its advertised expiry (the standard refresh discipline)."""
+
+    def __init__(self, url: Optional[str] = None,
+                 http_timeout: float = 5.0):
+        self.url = url or os.environ.get(
+            "PMMGTPU_GCS_METADATA"
+        ) or _METADATA_URL
+        self.http_timeout = http_timeout
+        self._token: Optional[str] = None
+        self._expiry = 0.0
+
+    def __call__(self) -> Optional[str]:
+        now = time.monotonic()
+        if self._token is not None and now < self._expiry:
+            return self._token
+        req = urllib.request.Request(
+            self.url, headers={"Metadata-Flavor": "Google"}
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.http_timeout
+            ) as resp:
+                doc = json.loads(resp.read().decode())
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            raise CheckpointAuthError(
+                f"GCS metadata-server token fetch failed ({e}); set "
+                "PMMGTPU_GCS_TOKEN or PMMGTPU_GCS_AUTH=anon"
+            ) from e
+        self._token = doc.get("access_token")
+        self._expiry = now + float(doc.get("expires_in", 0)) - 60.0
+        return self._token
+
+
+def resolve_token_provider(
+    endpoint: str,
+) -> Optional[Callable[[], Optional[str]]]:
+    """Auth mode per the env contract: explicit ``PMMGTPU_GCS_AUTH``
+    wins; otherwise a set token means env auth, the real Google
+    endpoint means metadata auth, and anything else (a fake server, an
+    emulator) defaults to anonymous."""
+    mode = (os.environ.get("PMMGTPU_GCS_AUTH") or "").strip().lower()
+    if mode in ("anon", "anonymous", "none"):
+        return None
+    if mode == "env":
+        return env_token_provider
+    if mode == "metadata":
+        return MetadataTokenProvider()
+    if mode:
+        raise ValueError(
+            f"PMMGTPU_GCS_AUTH={mode!r} not one of env|metadata|anon"
+        )
+    if os.environ.get("PMMGTPU_GCS_TOKEN"):
+        return env_token_provider
+    if endpoint.rstrip("/") == DEFAULT_ENDPOINT:
+        return MetadataTokenProvider()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+
+class GCSStore(CheckpointStore):
+    """Checkpoint store over a real GCS bucket (JSON/upload API).
+
+    Every raw primitive is ONE bounded HTTP request whose failure is
+    classified by :func:`classify_http_status` — the base class's
+    retry/timeout/fault envelope then drives the retryable half
+    (seeded backoff, ``Retry-After`` floors) and propagates the
+    terminal half typed. Object names are flat (the checkpoint
+    protocol's contract) under an optional ``prefix/``."""
+
+    def __init__(self, bucket: str, prefix: str = "", *,
+                 endpoint: Optional[str] = None,
+                 token_provider=None,
+                 http_timeout: Optional[float] = None, **kw):
+        super().__init__(**kw)
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        if self.prefix:
+            self.prefix += "/"
+        self.endpoint = (
+            endpoint
+            or os.environ.get("PMMGTPU_GCS_ENDPOINT")
+            or DEFAULT_ENDPOINT
+        ).rstrip("/")
+        self.token_provider = (
+            token_provider if token_provider is not None
+            else resolve_token_provider(self.endpoint)
+        )
+        # socket-level deadline: the per-op watchdog (self.timeout)
+        # ABANDONS a stalled request thread; this bound makes the
+        # abandoned request itself die instead of holding a connection
+        # forever
+        self.http_timeout = float(
+            http_timeout if http_timeout is not None
+            else (self.timeout or 20.0)
+        )
+
+    @classmethod
+    def from_url(cls, url: str, **kw) -> "GCSStore":
+        """``gs://bucket[/prefix]`` → a configured store (the
+        `ckpt_store.make_store` entry point)."""
+        rest = url[5:] if url.startswith("gs://") else url
+        bucket, _, prefix = rest.partition("/")
+        if not bucket:
+            raise ValueError(f"no bucket in GCS url {url!r}")
+        return cls(bucket, prefix, **kw)
+
+    def __repr__(self) -> str:
+        return (f"GCSStore(gs://{self.bucket}/{self.prefix} "
+                f"via {self.endpoint})")
+
+    # -- HTTP plumbing ---------------------------------------------------
+    def _request(self, method: str, url: str, what: str,
+                 data: Optional[bytes] = None,
+                 headers: Optional[dict] = None) -> bytes:
+        req = urllib.request.Request(url, data=data, method=method)
+        if self.token_provider is not None:
+            tok = self.token_provider()
+            if tok:
+                req.add_header("Authorization", f"Bearer {tok}")
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.http_timeout
+            ) as resp:
+                body = resp.read()
+                want = resp.headers.get("Content-Length")
+                if want is not None and len(body) != int(want):
+                    raise TransientStoreError(
+                        f"GCS {what}: truncated body "
+                        f"({len(body)}/{want} bytes)"
+                    )
+                return body
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read(200).decode("utf-8", "replace")
+            except OSError:
+                pass
+            raise classify_http_status(
+                e.code, what, retry_after=e.headers.get("Retry-After"),
+                detail=detail,
+            ) from None
+        except urllib.error.URLError as e:
+            raise TransientStoreError(
+                f"GCS {what}: connection failed: {e.reason}"
+            ) from e
+        except (http.client.HTTPException, socket.timeout,
+                TimeoutError, ConnectionError) as e:
+            # IncompleteRead (a truncated body detected by the client),
+            # reset connections, socket deadlines: all transient
+            raise TransientStoreError(
+                f"GCS {what}: transport error: {e!r}"
+            ) from e
+
+    def _obj_url(self, name: str, **params) -> str:
+        quoted = urllib.parse.quote(self.prefix + name, safe="")
+        url = f"{self.endpoint}/storage/v1/b/{self.bucket}/o/{quoted}"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        return url
+
+    # -- raw primitives --------------------------------------------------
+    def _put(self, name: str, data: bytes,
+             generation_match: Optional[int] = None) -> None:
+        params = {"uploadType": "media", "name": self.prefix + name}
+        if generation_match is not None:
+            params["ifGenerationMatch"] = str(generation_match)
+        url = (f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o?"
+               + urllib.parse.urlencode(params))
+        self._request(
+            "POST", url, f"put {name!r}", data=bytes(data),
+            headers={"Content-Type": "application/octet-stream"},
+        )
+
+    def _generation(self, name: str) -> int:
+        """Current generation of `name`, or 0 when absent — exactly the
+        ``ifGenerationMatch`` value meaning "only create"."""
+        try:
+            body = self._request(
+                "GET", self._obj_url(name, fields="generation"),
+                f"stat {name!r}",
+            )
+        except FileNotFoundError:
+            return 0
+        return int(json.loads(body).get("generation", 0))
+
+    def _publish(self, name: str, data: bytes) -> None:
+        """Conditional commit-token put: re-reads the generation on
+        every attempt (a retried publish whose first upload landed but
+        whose response was lost sees its OWN new generation and
+        overwrites idempotently; a genuine concurrent publisher
+        surfaces as the typed 412)."""
+        self._put(name, data, generation_match=self._generation(name))
+
+    def _get(self, name: str) -> bytes:
+        return self._request(
+            "GET", self._obj_url(name, alt="media"), f"get {name!r}"
+        )
+
+    def _list(self) -> List[str]:
+        names: List[str] = []
+        token: Optional[str] = None
+        base = f"{self.endpoint}/storage/v1/b/{self.bucket}/o"
+        while True:
+            params = {"fields": "items(name),nextPageToken"}
+            if self.prefix:
+                params["prefix"] = self.prefix
+            if token:
+                params["pageToken"] = token
+            doc = json.loads(self._request(
+                "GET", base + "?" + urllib.parse.urlencode(params),
+                "list",
+            ))
+            for item in doc.get("items") or ():
+                n = item.get("name", "")
+                if n.startswith(self.prefix):
+                    names.append(n[len(self.prefix):])
+            token = doc.get("nextPageToken")
+            if not token:
+                return sorted(names)
+
+    def _delete(self, name: str) -> None:
+        self._request("DELETE", self._obj_url(name), f"delete {name!r}")

@@ -19,7 +19,15 @@ snapshots) — and renders the post-mortem a run operator wants first:
   payload and checkpoint bytes, store retry and latency summary);
 - retrace table: jit cache misses per RetraceCounter phase;
 - failure timeline: every instant event (faults injected, rollbacks,
-  checkpoint commits, preemption notices) in time order.
+  checkpoint commits, preemption notices) in time order;
+- **chaos post-mortem** (:func:`chaos_summary` / :func:`render_chaos`,
+  CLI ``tools/obs_report.py --chaos``): per-rank fault → detection →
+  recovery event chains assembled from the FILE-ORDERED JSONL
+  timelines (a resumed run appends to its rank file with a restarted
+  clock, so happened-order is line order, not timestamp order),
+  merged with whatever ``metrics_rank*.json`` snapshots survived —
+  a hard-killed rank leaves only its JSONL, which is part of the
+  story the report tells.
 
 `tools/obs_report.py` is the CLI wrapper; tests and the obs smoke
 stage call :func:`render` directly.
@@ -35,7 +43,10 @@ from typing import Dict, List, Optional
 from . import costs as costs_mod
 from . import metrics as metrics_mod
 
-__all__ = ["load_trace_events", "load_timeline", "summarize", "render"]
+__all__ = [
+    "load_trace_events", "load_timeline", "summarize", "render",
+    "rank_timelines", "chaos_summary", "render_chaos",
+]
 
 
 def load_trace_events(dirpath: str) -> List[dict]:
@@ -66,6 +77,148 @@ def load_timeline(dirpath: str) -> List[dict]:
                     continue
     recs.sort(key=lambda r: (r.get("ts_us", 0), r.get("rank", 0)))
     return recs
+
+
+def rank_timelines(dirpath: str) -> Dict[int, List[dict]]:
+    """Per-rank JSONL records in FILE order (NOT ts-sorted: a resumed
+    run appends to the same rank file with a restarted clock, so the
+    happened-order of a fault → death → resume → recovery chain is the
+    line order, and a global ts sort would interleave the two runs).
+    Tolerates truncated final lines (a process killed mid-write)."""
+    out: Dict[int, List[dict]] = {}
+    for path in sorted(glob.glob(
+            os.path.join(dirpath, "events_rank*.jsonl"))):
+        stem = os.path.basename(path)[len("events_rank"):-len(".jsonl")]
+        try:
+            rank = int(stem)
+        except ValueError:
+            continue
+        recs: List[dict] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        out[rank] = recs
+    return out
+
+
+# the event vocabulary of a chaos chain, by role: what was INJECTED,
+# how the failure was DETECTED, and what the run did to RECOVER. The
+# render tags each chain line with its role so a post-mortem reads as
+# fault -> detection -> recovery without knowing the emitter sites.
+CHAOS_FAULT_EVENTS = ("fault_injected",)
+CHAOS_DETECT_EVENTS = ("sigterm_received", "peer_lost",
+                       "preempt_notice")
+CHAOS_RECOVER_EVENTS = ("rollback", "checkpoint_commit", "resume")
+_CHAOS_ROLES = (
+    [(n, "fault") for n in CHAOS_FAULT_EVENTS]
+    + [(n, "detect") for n in CHAOS_DETECT_EVENTS]
+    + [(n, "recover") for n in CHAOS_RECOVER_EVENTS]
+)
+
+
+def chaos_summary(dirpath: str) -> dict:
+    """Structured per-rank post-mortem of a chaos run's trace
+    directory: every rank's fault → detection → recovery event chain
+    (file-ordered, so it spans a kill and the subsequent resume) plus
+    the merged per-rank metrics that survived (hard-killed ranks leave
+    only their JSONL — their metrics snapshot never flushed, which is
+    itself part of the story)."""
+    roles = dict(_CHAOS_ROLES)
+    ranks: Dict[int, dict] = {}
+    for rank, recs in rank_timelines(dirpath).items():
+        chain = []
+        for r in recs:
+            if r.get("type") != "event" or r.get("name") not in roles:
+                continue
+            chain.append(dict(
+                name=r.get("name"), role=roles[r.get("name")],
+                ts_us=r.get("ts_us", 0), args=r.get("args", {}),
+            ))
+        faults = [
+            dict(kind=c["args"].get("kind"),
+                 where=c["args"].get("where")
+                 or (f"store op {c['args'].get('store_op')} "
+                     f"({c['args'].get('op')})"
+                     if "store_op" in c["args"] else None))
+            for c in chain if c["name"] == "fault_injected"
+        ]
+        ranks[rank] = dict(
+            events=len(recs),
+            faults=faults,
+            detections=[c for c in chain if c["role"] == "detect"],
+            recoveries=[c for c in chain if c["role"] == "recover"],
+            chain=chain,
+        )
+    metrics = metrics_mod.merge_dir(dirpath)
+    counters = (metrics or {}).get("counters", {})
+    return dict(
+        dir=dirpath,
+        ranks=ranks,
+        world=len(ranks),
+        metrics_ranks=(metrics or {}).get("world", 0),
+        counters=dict(
+            faults_injected=counters.get("failsafe/faults_injected", 0),
+            rollbacks=counters.get("failsafe/rollbacks", 0),
+            ckpt_commits=counters.get("ckpt/commits", 0),
+            ckpt_retries=counters.get("ckpt/retries", 0),
+            resumes=counters.get("ckpt/resumes", 0),
+            barriers=counters.get("comm/barriers", 0),
+        ),
+    )
+
+
+def render_chaos(dirpath: str) -> str:
+    """Human-readable chaos post-mortem: one section per rank naming
+    the injected fault(s) and the detection/recovery event chain."""
+    s = chaos_summary(dirpath)
+    lines = [f"== chaos post-mortem: {s['dir']} =="]
+    if not s["ranks"]:
+        lines.append("   (no per-rank timelines found)")
+    for rank in sorted(s["ranks"]):
+        r = s["ranks"][rank]
+        lines.append("")
+        lines.append(f"-- rank {rank} ({r['events']} timeline "
+                     "records) --")
+        if r["faults"]:
+            for f in r["faults"]:
+                at = f" @ {f['where']}" if f.get("where") else ""
+                lines.append(f"   injected: {f['kind']}{at}")
+        else:
+            lines.append("   injected: (none on this rank)")
+        if not r["chain"]:
+            lines.append("   chain: (no chaos events)")
+            continue
+        lines.append("   chain:")
+        for c in r["chain"]:
+            args = c["args"]
+            extra = " ".join(
+                f"{k}={v}" for k, v in sorted(args.items())
+            )
+            lines.append(
+                f"     [{c['ts_us'] / 1e6:9.3f}s] "
+                f"{c['role']:<8s} {c['name']}"
+                + (f"  {extra}" if extra else "")
+            )
+    c = s["counters"]
+    lines.append("")
+    lines.append(
+        f"-- world: {s['world']} rank timeline(s), "
+        f"{s['metrics_ranks']} metrics snapshot(s) --"
+    )
+    lines.append(
+        f"   faults injected {c['faults_injected']}  rollbacks "
+        f"{c['rollbacks']}  ckpt commits {c['ckpt_commits']}  "
+        f"ckpt retries {c['ckpt_retries']}  resumes {c['resumes']}  "
+        f"barriers {c['barriers']}"
+    )
+    lines.append("")
+    return "\n".join(lines)
 
 
 def _span_table(events: List[dict]) -> Dict[str, dict]:

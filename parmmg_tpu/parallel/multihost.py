@@ -142,9 +142,31 @@ def peer_loss_detected() -> bool:
 
 def _on_peer_loss(status) -> None:
     # called from a runtime thread: only record; raising here would be
-    # lost (and must not run Python teardown on a foreign thread)
+    # lost (and must not run Python teardown on a foreign thread). The
+    # timeline event is the post-mortem's detection record — the
+    # tracer's per-line flush makes it durable even if the survivor
+    # dies moments later.
     _PEER_LOSS_STATUS.append(str(status))
+    if not _PEER_LOSS.is_set():
+        obs_trace.emit_event("peer_lost", status=str(status))
     _PEER_LOSS.set()
+
+
+def simulate_peer_loss(reason: str = "") -> None:
+    """Inject a coordination-service peer-death report into THIS
+    process (the chaos harness's ``peer-lost`` fault kind): the next
+    `barrier`/heartbeat raises the typed ``failsafe.PeerLostError``
+    exactly as if the runtime's missed-heartbeat callback had fired —
+    the survivor-side detection path, without needing a peer to
+    actually die."""
+    _on_peer_loss(reason or "injected peer loss")
+
+
+def clear_peer_loss() -> None:
+    """Reset the latched peer-loss report (tests only — in a real
+    world a lost peer stays lost until checkpoint-backed restart)."""
+    _PEER_LOSS.clear()
+    _PEER_LOSS_STATUS.clear()
 
 
 def _initialize_resilient(coord: str, world: int, rank: int) -> None:
@@ -380,6 +402,16 @@ def barrier(tag: str = "parmmg-barrier",
         return
     obs_metrics.registry().counter("comm/barriers").inc()
     from ..failsafe import PeerLostError
+
+    if _PEER_LOSS.is_set():
+        # the loss is already latched (runtime callback or an injected
+        # report): dispatching the collective would just hang until
+        # the watchdog window — and with no watchdog armed, forever
+        raise PeerLostError(
+            f"collective '{tag}' refused: a peer is already reported "
+            "lost "
+            f"({_PEER_LOSS_STATUS[-1] if _PEER_LOSS_STATUS else ''})"
+        )
 
     def _sync():
         fn, x, ndev = _barrier_fn()

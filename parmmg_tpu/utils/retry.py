@@ -76,10 +76,15 @@ def retry(
     ``backoff * 2**k * (1 + jitter * u)`` seconds, where ``u`` is drawn
     from ``random.Random(seed)`` — a SEEDED stream, so the delay
     schedule (and therefore every test that exercises a retry path) is
-    deterministic; pass ``seed=None`` for real entropy. The final
-    attempt's exception propagates unchanged. `on_retry(exc, attempt)`
-    runs between attempts (the clear-caches hook of :func:`jit_retry`);
-    `sleep` is injectable so tests need not wait out real delays.
+    deterministic; pass ``seed=None`` for real entropy. An exception
+    carrying a ``retry_after`` attribute (a server's ``Retry-After``
+    hint in seconds — `io.ckpt_store.TransientStoreError` from an HTTP
+    429/503) FLOORS the next delay at that value: the backoff stays
+    seeded-deterministic but never hammers a backend that asked for
+    room. The final attempt's exception propagates unchanged.
+    `on_retry(exc, attempt)` runs between attempts (the clear-caches
+    hook of :func:`jit_retry`); `sleep` is injectable so tests need
+    not wait out real delays.
     """
     if attempts < 1:
         raise ValueError(f"attempts={attempts} must be >= 1")
@@ -97,8 +102,14 @@ def retry(
             _obs_metrics.registry().counter("retry/attempts").inc()
             if on_retry is not None:
                 on_retry(e, k)
+            delay = 0.0
             if backoff > 0:
-                sleep(backoff * (2 ** k) * (1.0 + jitter * rng.random()))
+                delay = backoff * (2 ** k) * (1.0 + jitter * rng.random())
+            hint = getattr(e, "retry_after", None)
+            if hint:
+                delay = max(delay, float(hint))
+            if delay > 0:
+                sleep(delay)
 
 
 def jit_retry(fn, *args, **kwargs):

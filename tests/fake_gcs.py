@@ -1,0 +1,327 @@
+"""Hermetic stdlib fake-GCS server for the store-contract suite and
+the `io.gcs.GCSStore` fault matrix.
+
+Implements the JSON/upload API subset the adapter speaks — media
+upload with ``ifGenerationMatch``, media/metadata GET, paginated
+list, DELETE — over an in-memory object map with real per-object
+generation numbers, plus **per-op fault injection**:
+
+    srv = FakeGCS()
+    base = srv.start()                 # http://127.0.0.1:<port>
+    srv.inject("upload", status=429, retry_after=2)   # next upload
+    srv.inject("get", stall=1.0)       # next get sleeps 1 s
+    srv.inject("get", truncate=0.5)    # next get sends half the body
+    srv.stop()
+
+Fault ops: ``upload`` (put/publish data writes), ``get`` (media
+reads), ``meta`` (metadata/generation stats), ``list``, ``delete``.
+Each injected fault consumes ``times`` matching requests (FIFO per
+op). ``status`` faults answer with that HTTP code (and an optional
+``Retry-After`` header); ``stall`` sleeps with the connection open (a
+slow backend — trips socket/per-op timeouts); ``truncate`` advertises
+the full Content-Length but sends only that fraction and drops the
+connection (a torn read).
+
+Optional ``require_token`` arms bearer-token auth: requests without
+``Authorization: Bearer <token>`` get a 401 — the terminal
+`CheckpointAuthError` leg of the taxonomy.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+FAULT_OPS = ("upload", "get", "meta", "list", "delete")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "FakeGCS/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: tests read assertions
+        pass
+
+    # -- helpers ---------------------------------------------------------
+    @property
+    def gcs(self) -> "FakeGCS":
+        return self.server.gcs  # type: ignore[attr-defined]
+
+    def _reply(self, status: int, body: bytes = b"",
+               content_type: str = "application/json",
+               headers: Optional[dict] = None,
+               truncate: Optional[float] = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        if truncate is not None:
+            # advertise the full length, deliver a prefix, kill the
+            # connection: the client sees a torn read (IncompleteRead)
+            self.wfile.write(body[: int(len(body) * truncate)])
+            self.wfile.flush()
+            self.close_connection = True
+            try:
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return
+        self.wfile.write(body)
+
+    def _error(self, status: int, message: str,
+               headers: Optional[dict] = None) -> None:
+        body = json.dumps(
+            {"error": {"code": status, "message": message}}
+        ).encode()
+        self._reply(status, body, headers=headers)
+
+    def _fault(self, op: str) -> Optional[dict]:
+        """Consume + apply a pending fault for `op`. Returns the fault
+        when it already ANSWERED the request (status faults), or a
+        truncate fault for the normal path to apply; stalls sleep here
+        and fall through to normal handling."""
+        f = self.gcs._take_fault(op)
+        if f is None:
+            return None
+        if f.get("stall"):
+            time.sleep(float(f["stall"]))
+        if f.get("status"):
+            hdrs = {}
+            if f.get("retry_after") is not None:
+                hdrs["Retry-After"] = f["retry_after"]
+            self._error(int(f["status"]),
+                        f.get("message", "injected fault"), hdrs)
+            return f
+        return f if f.get("truncate") is not None else None
+
+    def _authorized(self) -> bool:
+        want = self.gcs.require_token
+        if want is None:
+            return True
+        got = self.headers.get("Authorization", "")
+        if got == f"Bearer {want}":
+            return True
+        self._error(401, "missing or invalid bearer token")
+        return False
+
+    # -- routes ----------------------------------------------------------
+    def do_POST(self):
+        split = urllib.parse.urlsplit(self.path)
+        qs = urllib.parse.parse_qs(split.query)
+        parts = split.path.strip("/").split("/")
+        # /upload/storage/v1/b/<bucket>/o
+        if len(parts) == 6 and parts[0] == "upload" and parts[5] == "o":
+            self.gcs._count("upload")
+            if not self._authorized():
+                return
+            fault = self._fault("upload")
+            if fault and fault.get("status"):
+                return
+            name = (qs.get("name") or [""])[0]
+            if not name:
+                return self._error(400, "missing object name")
+            length = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(length)
+            with self.gcs._lock:
+                cur = self.gcs.objects.get(name)
+                cur_gen = cur[1] if cur else 0
+                want = (qs.get("ifGenerationMatch") or [None])[0]
+                if want is not None and int(want) != cur_gen:
+                    return self._error(
+                        412,
+                        f"ifGenerationMatch {want} != current {cur_gen}",
+                    )
+                self.gcs._gen += 1
+                self.gcs.objects[name] = (data, self.gcs._gen)
+                gen = self.gcs._gen
+            body = json.dumps(
+                {"name": name, "generation": str(gen),
+                 "size": str(len(data))}
+            ).encode()
+            return self._reply(200, body)
+        self._error(404, f"no route for POST {split.path}")
+
+    def do_GET(self):
+        split = urllib.parse.urlsplit(self.path)
+        qs = urllib.parse.parse_qs(split.query)
+        parts = split.path.strip("/").split("/")
+        # /storage/v1/b/<bucket>/o[/<object>]
+        if len(parts) >= 5 and parts[0] == "storage" and parts[4] == "o":
+            if len(parts) == 5:
+                return self._do_list(qs)
+            name = urllib.parse.unquote(parts[5])
+            if (qs.get("alt") or [""])[0] == "media":
+                return self._do_get_media(name)
+            return self._do_get_meta(name)
+        self._error(404, f"no route for GET {split.path}")
+
+    def _do_list(self, qs):
+        self.gcs._count("list")
+        if not self._authorized():
+            return
+        fault = self._fault("list")
+        if fault and fault.get("status"):
+            return
+        prefix = (qs.get("prefix") or [""])[0]
+        token = (qs.get("pageToken") or ["0"])[0]
+        with self.gcs._lock:
+            names = sorted(
+                n for n in self.gcs.objects if n.startswith(prefix)
+            )
+        start = int(token)
+        page = names[start:start + self.gcs.page_size]
+        doc: dict = {"items": [{"name": n} for n in page]}
+        if start + self.gcs.page_size < len(names):
+            doc["nextPageToken"] = str(start + self.gcs.page_size)
+        self._reply(200, json.dumps(doc).encode())
+
+    def _do_get_media(self, name):
+        self.gcs._count("get")
+        if not self._authorized():
+            return
+        fault = self._fault("get")
+        if fault and fault.get("status"):
+            return
+        with self.gcs._lock:
+            cur = self.gcs.objects.get(name)
+        if cur is None:
+            return self._error(404, f"object {name!r} not found")
+        self._reply(
+            200, cur[0], content_type="application/octet-stream",
+            truncate=fault.get("truncate") if fault else None,
+        )
+
+    def _do_get_meta(self, name):
+        self.gcs._count("meta")
+        if not self._authorized():
+            return
+        fault = self._fault("meta")
+        if fault and fault.get("status"):
+            return
+        with self.gcs._lock:
+            cur = self.gcs.objects.get(name)
+        if cur is None:
+            return self._error(404, f"object {name!r} not found")
+        body = json.dumps(
+            {"name": name, "generation": str(cur[1]),
+             "size": str(len(cur[0]))}
+        ).encode()
+        self._reply(200, body)
+
+    def do_DELETE(self):
+        split = urllib.parse.urlsplit(self.path)
+        parts = split.path.strip("/").split("/")
+        if len(parts) == 6 and parts[0] == "storage" and parts[4] == "o":
+            self.gcs._count("delete")
+            if not self._authorized():
+                return
+            fault = self._fault("delete")
+            if fault and fault.get("status"):
+                return
+            name = urllib.parse.unquote(parts[5])
+            with self.gcs._lock:
+                if name not in self.gcs.objects:
+                    return self._error(404, f"object {name!r} not found")
+                del self.gcs.objects[name]
+            return self._reply(204)
+        self._error(404, f"no route for DELETE {split.path}")
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def handle_error(self, request, client_address):
+        # broken pipes from clients that timed out mid-stall are part
+        # of the fault matrix, not test noise
+        pass
+
+
+class FakeGCS:
+    """In-process fake GCS bucket server (see module docstring)."""
+
+    def __init__(self, require_token: Optional[str] = None,
+                 page_size: int = 1000):
+        self.objects: Dict[str, Tuple[bytes, int]] = {}
+        self.require_token = require_token
+        self.page_size = page_size
+        self.counts: Dict[str, int] = {op: 0 for op in FAULT_OPS}
+        self._gen = 0
+        self._faults: Dict[str, List[dict]] = {op: [] for op in FAULT_OPS}
+        self._lock = threading.RLock()
+        self._server: Optional[_Server] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> str:
+        self._server = _Server(("127.0.0.1", 0), _Handler)
+        self._server.gcs = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="fake-gcs",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.base_url
+
+    @property
+    def base_url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # -- fault injection / accounting -----------------------------------
+    def inject(self, op: str, *, status: Optional[int] = None,
+               times: int = 1, stall: Optional[float] = None,
+               retry_after: Optional[float] = None,
+               truncate: Optional[float] = None,
+               message: str = "injected fault") -> None:
+        """Queue a fault for the next `times` requests of `op`."""
+        if op not in FAULT_OPS:
+            raise ValueError(f"op {op!r} not one of {FAULT_OPS}")
+        with self._lock:
+            self._faults[op].append(dict(
+                status=status, times=int(times), stall=stall,
+                retry_after=retry_after, truncate=truncate,
+                message=message,
+            ))
+
+    def clear_faults(self) -> None:
+        with self._lock:
+            for q in self._faults.values():
+                q.clear()
+
+    def _take_fault(self, op: str) -> Optional[dict]:
+        with self._lock:
+            q = self._faults[op]
+            if not q:
+                return None
+            f = q[0]
+            f["times"] -= 1
+            if f["times"] <= 0:
+                q.pop(0)
+            return f
+
+    def _count(self, op: str) -> None:
+        with self._lock:
+            self.counts[op] += 1
+
+    def request_count(self, op: str) -> int:
+        with self._lock:
+            return self.counts[op]
+
+    def reset_counts(self) -> None:
+        with self._lock:
+            for op in self.counts:
+                self.counts[op] = 0

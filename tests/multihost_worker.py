@@ -118,6 +118,13 @@ def failsafe_main():
     )
     try:
         out, comm2, info = adapt_stacked_input(st, comm, opts)
+    except failsafe.PreemptionError as e:
+        # graceful SIGTERM path: the harness committed a checkpoint at
+        # the iteration boundary before raising — exit through the
+        # same code the hard kill uses so the chaos matrix sees one
+        # typed preemption family
+        print(f"PREEMPTED rank={jax.process_index()}: {e}", flush=True)
+        os._exit(failsafe.KILL_EXIT_CODE)
     except failsafe.PeerLostError as e:
         print(f"PEER_LOST rank={jax.process_index()}: {e}", flush=True)
         # the stuck watchdog thread cannot be joined; a clean interpreter

@@ -190,132 +190,35 @@ def test_two_process_adaptation_matches_single_process(tmp_path):
         )
 
 
-def _run_failsafe_pair(tmp_path, tag, extra_env, timeout=1200):
-    """Two coordinated `multihost_worker.py --failsafe` processes (4
-    CPU devices each); returns (exit codes, log texts)."""
-    import socket
-
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    worker = os.path.join(root, "tests", "multihost_worker.py")
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    procs, logs = [], []
-    for pid in (0, 1):
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.update(
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
-            PYTHONPATH=root,
-            PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
-            PMMGTPU_NUM_PROCS="2",
-            PMMGTPU_PROC_ID=str(pid),
-        )
-        env.update(extra_env)
-        lp = tmp_path / f"{tag}{pid}.log"
-        logs.append(lp)
-        procs.append(subprocess.Popen(
-            [sys.executable, worker, "--failsafe"], env=env,
-            stdout=open(lp, "w"), stderr=subprocess.STDOUT, cwd=root,
-        ))
-    try:
-        rcs = [p.wait(timeout=timeout) for p in procs]
-    finally:
-        for p in procs:
-            p.kill()
-    return rcs, [lp.read_text() for lp in logs]
-
-
-def _digests(text):
-    return [ln for ln in text.splitlines()
-            if ln.startswith("ADAPT_DIGEST")]
-
-
 @pytest.mark.slow
-def test_two_process_kill_resume_sharded_checkpoint(tmp_path):
-    """The multi-host fail-safe acceptance path, subprocess-real:
+def test_multi_rank_chaos_matrix(tmp_path):
+    """The hand-written 2-process kill/peer-lost/resume legs are
+    subsumed by the generated rank-targeted chaos matrix
+    (``tools/chaos_smoke.py --world 2``): seeded schedules aim
+    kill / broadcast-sigterm / peer-lost / ckpt-store faults —
+    including commit-window kills BETWEEN the two manifest barriers —
+    at random ranks of a real coordinated world, assert every rank
+    exits typed (86/87/88/89 family, zero hangs, zero untyped
+    tracebacks), resume killed worlds bit-identically (elastic 2→1
+    included on odd seeds), and require a complete per-rank
+    post-mortem (JSONL timeline + metrics merge via
+    ``tools/obs_report.py --chaos``) for every seed.
 
-    1. an uninterrupted 2-process run fixes the reference digest;
-    2. the same run with ``it0:post:kill@rank1`` and a checkpoint dir:
-       rank 1 must die with KILL_EXIT_CODE only AFTER the sharded
-       checkpoint's barrier-committed manifest (layout + digests
-       verified here), and rank 0's collective watchdog must convert
-       the silent peer loss into PeerLostError
-       (PEER_LOST_EXIT_CODE) instead of hanging;
-    3. an ELASTIC single-process resume of the 2-process checkpoint
-       (PMMGTPU_SPMD_SWEEPS=1 — the identical SPMD sweep programs on
-       one controller) completes bit-identically to (1);
-    4. a 2-process resume completes bit-identically to (1).
-
-    The reference analog: per-rank restart state + MPI_Barrier'd
-    checkpoint I/O in the node-scale runs of RR-9307."""
-    import json
-    import shutil
-
-    from parmmg_tpu import failsafe
-
-    rcs, logs = _run_failsafe_pair(
-        tmp_path, "ref", {"PMMGTPU_WATCHDOG": "300"}
-    )
-    assert rcs == [0, 0], logs[0][-2000:] + logs[1][-2000:]
-    ref = _digests(logs[0])
-    assert ref and _digests(logs[1]) == ref
-
-    ck = tmp_path / "ck"
-    rcs, logs = _run_failsafe_pair(tmp_path, "kill", {
-        "PMMGTPU_CKPT_DIR": str(ck),
-        "PMMGTPU_WATCHDOG": "60",
-        "PARMMG_FAULTS": "it0:post:kill@rank1",
-    })
-    assert rcs[1] == failsafe.KILL_EXIT_CODE, (rcs, logs[1][-2000:])
-    assert rcs[0] == failsafe.PEER_LOST_EXIT_CODE, (rcs, logs[0][-2000:])
-    assert "PEER_LOST" in logs[0]
-    # barrier-committed sharded layout: manifest + one data file per
-    # rank, no temp litter, digests verifying
-    names = sorted(os.listdir(ck))
-    assert names == ["ckpt_00000.json", "ckpt_00000.proc0.npz",
-                     "ckpt_00000.proc1.npz"], names
-    with open(ck / "ckpt_00000.json") as f:
-        doc = json.load(f)
-    assert doc["world"] == 2 and doc["sharded"] == ["mesh"]
-    import numpy as np
-
-    for r in (0, 1):
-        with np.load(ck / f"ckpt_00000.proc{r}.npz") as z:
-            arrs = {k: z[k] for k in z.files}
-        assert failsafe._digest_arrays(arrs) == doc["digests"][str(r)]
-
-    # elastic resume: a 1-process run (all 8 devices on one
-    # controller, same SPMD sweep programs) re-concatenates the 2-rank
-    # shard files and continues to the SAME digest — against a COPY of
-    # the checkpoint so phase 4's 2-process resume sees the original
-    ck1 = tmp_path / "ck_elastic"
-    shutil.copytree(ck, ck1)
+    The sharded-checkpoint layout/digest details stay covered
+    non-generated by `tools/fault_smoke.py --multihost` (a check.sh
+    stage); the reference analog is per-rank restart state +
+    MPI_Barrier'd checkpoint I/O in the node-scale runs of RR-9307."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update(
-        JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=8",
-        PYTHONPATH=root, PMMGTPU_CKPT_DIR=str(ck1),
-        PMMGTPU_SPMD_SWEEPS="1",
-    )
+    env.update(JAX_PLATFORMS="cpu")
     p = subprocess.run(
-        [sys.executable,
-         os.path.join(root, "tests", "multihost_worker.py"),
-         "--failsafe"],
-        env=env, capture_output=True, text=True, timeout=1200, cwd=root,
+        [sys.executable, os.path.join(root, "tools", "chaos_smoke.py"),
+         "--world", "2", "--seeds", "1", "--seed-base", "0"],
+        env=env, capture_output=True, text=True, timeout=2400,
+        cwd=root,
     )
     assert p.returncode == 0, (
-        p.returncode, p.stdout[-2000:], p.stderr[-2000:],
+        p.returncode, p.stdout[-3000:], p.stderr[-2000:],
     )
-    assert _digests(p.stdout) == ref, (_digests(p.stdout), ref)
-
-    rcs, logs = _run_failsafe_pair(tmp_path, "resume", {
-        "PMMGTPU_CKPT_DIR": str(ck), "PMMGTPU_WATCHDOG": "300",
-    })
-    assert rcs == [0, 0], logs[0][-2000:] + logs[1][-2000:]
-    assert _digests(logs[0]) == ref and _digests(logs[1]) == ref, (
-        _digests(logs[0]), ref,
-    )
+    assert "terminated typed" in p.stdout, p.stdout[-2000:]

@@ -261,3 +261,70 @@ def test_env_contract_parses_profile_flag(tmp_path, monkeypatch):
     tr2 = obs_trace.from_env()
     assert tr2.enabled
     tr2.flush()
+
+
+def test_chaos_postmortem_chain_and_file_order(tmp_path):
+    """The chaos section (round 11): per-rank fault -> detection ->
+    recovery chains must preserve FILE order (a resumed run appends to
+    the same rank file with a restarted clock — a ts sort would
+    interleave the two runs), name the injected fault, and merge the
+    surviving metrics snapshots."""
+    d = str(tmp_path / "obs")
+    # run 1: a fault, a detection, a commit — then a hard death (no
+    # flush beyond the per-line JSONL writes)
+    tr = obs_trace.Tracer(d, rank=0)
+    tr.event("fault_injected", kind="kill", phase="post", it=1,
+             where="it1:post@rank0")
+    tr.event("sigterm_received")
+    tr.event("checkpoint_commit", it=1, mode="sync", seconds=0.1)
+    # run 2 (the resume): fresh tracer, restarted clock, same file
+    tr2 = obs_trace.Tracer(d, rank=0)
+    tr2.event("resume", it=1, source_world=2, world=1)
+    tr2.flush()
+    # a second rank with its own timeline + metrics
+    tr3 = obs_trace.Tracer(d, rank=1)
+    tr3.event("peer_lost", status="injected")
+    tr3.flush()
+
+    tls = obs_report.rank_timelines(d)
+    assert sorted(tls) == [0, 1]
+    names0 = [r["name"] for r in tls[0] if r.get("type") == "event"]
+    # file order: the resume (restarted clock, ts ~0) stays LAST
+    assert names0 == ["fault_injected", "sigterm_received",
+                      "checkpoint_commit", "resume"]
+
+    s = obs_report.chaos_summary(d)
+    assert s["world"] == 2
+    assert s["ranks"][0]["faults"] == [
+        dict(kind="kill", where="it1:post@rank0")
+    ]
+    roles0 = [(c["role"], c["name"]) for c in s["ranks"][0]["chain"]]
+    assert roles0 == [
+        ("fault", "fault_injected"), ("detect", "sigterm_received"),
+        ("recover", "checkpoint_commit"), ("recover", "resume"),
+    ]
+    assert [(c["role"], c["name"]) for c in s["ranks"][1]["chain"]] \
+        == [("detect", "peer_lost")]
+
+    text = obs_report.render_chaos(d)
+    assert "chaos post-mortem" in text
+    assert "injected: kill @ it1:post@rank0" in text
+    assert "-- rank 0" in text and "-- rank 1" in text
+    assert "recover  resume" in text
+    assert "detect   peer_lost" in text
+
+
+def test_chaos_postmortem_tolerates_killed_rank_without_metrics(
+        tmp_path):
+    """A hard-killed rank leaves ONLY its JSONL (no metrics snapshot):
+    the post-mortem must still render, reporting the asymmetry."""
+    d = str(tmp_path / "obs")
+    tr = obs_trace.Tracer(d, rank=0)
+    tr.event("fault_injected", kind="ioerror", phase="ckpt", op="put",
+             store_op=3)
+    # no flush: simulates os._exit — metrics_rank0.json never written
+    s = obs_report.chaos_summary(d)
+    assert s["world"] == 1 and s["metrics_ranks"] == 0
+    assert s["ranks"][0]["faults"][0]["kind"] == "ioerror"
+    assert "store op 3" in s["ranks"][0]["faults"][0]["where"]
+    assert "injected: ioerror" in obs_report.render_chaos(d)

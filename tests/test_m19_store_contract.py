@@ -1,0 +1,526 @@
+"""M19: the parametrized store-contract suite + GCS adapter.
+
+ONE suite, run identically against every checkpoint-store backend —
+`LocalFSStore`, `ObjectStore` (``mem://`` semantics) and the new
+`GCSStore` speaking real HTTP to the hermetic fake server
+(``tests/fake_gcs.py``) — replacing the per-backend copies that used
+to live in test_m15:
+
+- put/get/list/delete/publish roundtrip + atomicity semantics;
+- bounded retry with DETERMINISTIC seeded backoff (same seed → same
+  recorded delay schedule on every backend);
+- transient faults absorbed within the retry budget, persistent
+  faults escalating to the typed `CheckpointIOError`;
+- the ``slowio``/per-op-timeout leg via the shared `FaultPlan` hook;
+- Checkpointer-level publish atomicity: a failed manifest publish
+  leaves data objects that are NOT a checkpoint (no commit token →
+  `load` returns None).
+
+Plus the GCS-only taxonomy matrix (429-with-Retry-After, 500, stall
+timeout, truncated body, 401/404/412 terminal subtypes, pagination,
+``if-generation-match`` conditional publish, auth providers, the
+``gs://`` spec) and the PR-5-NOTE regression: npz corruption is now
+the typed `CheckpointCorruptionError` (still a ValueError for the
+fall-back-to-previous path, and a `CheckpointIOError` so an escape
+maps onto exit code 89).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from fake_gcs import FakeGCS
+from parmmg_tpu import failsafe
+from parmmg_tpu.io import ckpt_store
+from parmmg_tpu.io.ckpt_store import (
+    CheckpointAuthError,
+    CheckpointCorruptionError,
+    CheckpointIOError,
+    CheckpointNotFoundError,
+    CheckpointPreconditionError,
+    CheckpointStore,
+    LocalFSStore,
+    ObjectStore,
+    TransientStoreError,
+)
+from parmmg_tpu.io.gcs import (
+    GCSStore,
+    classify_http_status,
+    resolve_token_provider,
+)
+from parmmg_tpu.models.adapt import AdaptOptions
+from parmmg_tpu.utils.gen import unit_cube_mesh
+
+BACKENDS = ("localfs", "mem", "gcs")
+
+
+@pytest.fixture(scope="module")
+def gcs_server():
+    srv = FakeGCS()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class _Backend:
+    """One backend under contract test: a store factory plus a
+    backend-appropriate transient/persistent fault injector (fault_cb
+    for the in-process stores, real HTTP faults for GCS)."""
+
+    def __init__(self, name, factory, server=None):
+        self.name = name
+        self.factory = factory
+        self.server = server
+        self._cb_faults = {}
+
+    def store(self, **kw) -> CheckpointStore:
+        kw.setdefault("attempts", 3)
+        kw.setdefault("backoff", 0.0)
+        return self.factory(self, kw)
+
+    # fault_cb shared by the in-process backends
+    def _fault_cb(self, op, name, timeout):
+        n = self._cb_faults.get(op, 0)
+        if n != 0:
+            if n > 0:
+                self._cb_faults[op] = n - 1
+            raise OSError(f"injected transient {op} failure")
+
+    def inject(self, op: str, times: int = 1) -> None:
+        """`times` transient failures on the next ops of kind `op`
+        (-1 = every attempt, the persistent-fault leg). GCS maps store
+        ops onto their HTTP requests."""
+        if self.server is None:
+            cur = self._cb_faults.get(op, 0)
+            self._cb_faults[op] = -1 if times < 0 else cur + times
+            return
+        http_op = {"put": "upload", "publish": "upload", "get": "get",
+                   "list": "list", "delete": "delete"}[op]
+        self.server.inject(http_op, status=503,
+                           times=10_000 if times < 0 else times)
+
+    def clear(self) -> None:
+        self._cb_faults.clear()
+        if self.server is not None:
+            self.server.clear_faults()
+
+
+@pytest.fixture(params=BACKENDS)
+def backend(request, tmp_path, gcs_server):
+    name = request.param
+    if name == "localfs":
+        be = _Backend(name, lambda self, kw: LocalFSStore(
+            str(tmp_path / "store"), fault_cb=self._fault_cb, **kw))
+    elif name == "mem":
+        bucket: dict = {}
+        be = _Backend(name, lambda self, kw: ObjectStore(
+            bucket, fault_cb=self._fault_cb, **kw))
+    else:
+        gcs_server.objects.clear()
+        gcs_server.clear_faults()
+        gcs_server.reset_counts()
+        be = _Backend(
+            name,
+            lambda self, kw: GCSStore(
+                "contract", "pre", endpoint=gcs_server.base_url,
+                token_provider=None, fault_cb=self._fault_cb, **kw),
+            server=gcs_server,
+        )
+    yield be
+    be.clear()
+
+
+# ---------------------------------------------------------------------------
+# the shared contract
+# ---------------------------------------------------------------------------
+
+
+def test_contract_roundtrip(backend):
+    st = backend.store()
+    assert st.list() == []
+    st.put("a.npz", b"alpha")
+    st.put("b.json", b"{}")
+    st.publish("manifest.json", b"commit-token")
+    assert st.list() == ["a.npz", "b.json", "manifest.json"]
+    assert st.get("a.npz") == b"alpha"
+    assert st.get("manifest.json") == b"commit-token"
+    # overwrite is whole-object
+    st.put("a.npz", b"alpha2")
+    assert st.get("a.npz") == b"alpha2"
+    # publish republishes cleanly (same-name commit token, e.g. a
+    # re-published epoch after a lost response)
+    st.publish("manifest.json", b"commit-token-2")
+    assert st.get("manifest.json") == b"commit-token-2"
+    st.delete("a.npz")
+    assert st.list() == ["b.json", "manifest.json"]
+    # missing objects: typed missing-object error on get, success on
+    # delete (concurrent-GC tolerance)
+    with pytest.raises(FileNotFoundError):
+        st.get("a.npz")
+    st.delete("a.npz")
+
+
+def test_contract_transient_fault_absorbed(backend):
+    st = backend.store(attempts=4)
+    backend.inject("put", times=2)
+    st.put("x.npz", b"payload")           # 2 failures < 4 attempts
+    assert st.get("x.npz") == b"payload"
+    backend.inject("get", times=1)
+    assert st.get("x.npz") == b"payload"
+
+
+def test_contract_persistent_fault_typed_abort(backend):
+    st = backend.store(attempts=2)
+    backend.inject("put", times=-1)
+    with pytest.raises(CheckpointIOError):
+        st.put("y.npz", b"data")
+    backend.clear()
+    st.put("y.npz", b"data")              # backend healthy again
+    assert st.get("y.npz") == b"data"
+
+
+def test_contract_retry_determinism(backend, monkeypatch):
+    """The same seed replays the exact backoff schedule on every
+    backend — the property every chaos assertion leans on."""
+    from parmmg_tpu.utils import retry as retry_mod
+
+    def delays_for(seed):
+        recorded = []
+
+        def spying_retry(fn, **kw):
+            kw["sleep"] = recorded.append
+            return retry_mod.retry(fn, **kw)
+
+        monkeypatch.setattr(ckpt_store, "retry", spying_retry)
+        st = backend.store(attempts=4, backoff=0.01, jitter=0.5,
+                           seed=seed)
+        backend.inject("put", times=3)
+        st.put(f"det-{seed}.npz", b"d")
+        backend.clear()
+        return recorded
+
+    a = delays_for(7)
+    b = delays_for(7)
+    assert len(a) == 3 and a == b
+    assert delays_for(8) != a
+    for k, d in enumerate(a):
+        assert 0.01 * 2 ** k <= d <= 0.01 * 2 ** k * 1.5
+
+
+def test_contract_slowio_trips_per_op_timeout(backend):
+    """The shared FaultPlan ``ckpt`` hook drives the per-op watchdog on
+    every backend: one slowio fault converts into timeout → retry, a
+    persistent burst escalates to the typed abort."""
+    plan = failsafe.FaultPlan.parse("it0:ckpt:slowio")
+    st = backend.store(attempts=2, timeout=0.2)
+    st.fault_cb = plan.io_fault
+    t0 = time.perf_counter()
+    st.put("slow.npz", b"data")
+    assert time.perf_counter() - t0 >= 0.2
+    assert st.get("slow.npz") == b"data"
+    plan2 = failsafe.FaultPlan(
+        [failsafe.Fault(it, "ckpt", "slowio") for it in range(20)]
+    )
+    st2 = backend.store(attempts=2, timeout=0.2)
+    st2.fault_cb = plan2.io_fault
+    with pytest.raises(CheckpointIOError, match="timeout|attempts"):
+        st2.put("slow2.npz", b"data")
+
+
+def test_contract_checkpointer_publish_atomicity(backend):
+    """Data objects without the commit token are NOT a checkpoint:
+    a persistently failing manifest publish leaves `load` → None, and
+    a later healthy save commits normally."""
+    opts = AdaptOptions(hsiz=0.45, niter=2)
+    mesh = unit_cube_mesh(2)
+    st = backend.store(attempts=2)
+    c = failsafe.Checkpointer(None, opts, "centralized", rank=0,
+                              world=1, store=st)
+    backend.inject("publish", times=-1)
+    with pytest.raises(CheckpointIOError):
+        c.save(0, {"mesh": mesh}, history=[], emult=1.6)
+    backend.clear()
+    assert c.load() is None
+    c.save(1, {"mesh": mesh}, history=[{"iter": 1}], emult=1.7)
+    rs = c.load()
+    assert rs is not None and rs.it == 1 and rs.emult == 1.7
+    np.testing.assert_array_equal(
+        np.asarray(rs.mesh.vert), np.asarray(mesh.vert)
+    )
+
+
+# ---------------------------------------------------------------------------
+# GCS-only: the HTTP retry-status taxonomy + protocol details
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def gcs(gcs_server):
+    gcs_server.objects.clear()
+    gcs_server.clear_faults()
+    gcs_server.reset_counts()
+
+    def make(**kw):
+        kw.setdefault("attempts", 3)
+        kw.setdefault("backoff", 0.0)
+        return GCSStore("bkt", "ck", endpoint=gcs_server.base_url,
+                        token_provider=None, **kw)
+
+    yield gcs_server, make
+    gcs_server.clear_faults()
+
+
+def test_gcs_status_taxonomy_mapping():
+    """The status → exception table, standalone."""
+    for status in (408, 429, 500, 502, 503, 599):
+        e = classify_http_status(status, "op")
+        assert isinstance(e, TransientStoreError), status
+    e = classify_http_status(429, "op", retry_after="7")
+    assert e.retry_after == 7.0
+    assert classify_http_status(429, "op",
+                                retry_after="nonsense").retry_after is None
+    for status, typ in ((401, CheckpointAuthError),
+                        (403, CheckpointAuthError),
+                        (404, CheckpointNotFoundError),
+                        (412, CheckpointPreconditionError),
+                        (400, CheckpointIOError)):
+        e = classify_http_status(status, "op")
+        assert type(e) is typ, (status, type(e))
+        assert isinstance(e, CheckpointIOError)
+    # terminal members are refused by the retry predicate; transient
+    # and timeout members are retried
+    assert not ckpt_store._retryable(classify_http_status(401, "x"))
+    assert not ckpt_store._retryable(classify_http_status(412, "x"))
+    assert not ckpt_store._retryable(classify_http_status(404, "x"))
+    assert ckpt_store._retryable(classify_http_status(500, "x"))
+    assert ckpt_store._retryable(
+        ckpt_store.CheckpointTimeoutError("t"))
+    assert isinstance(classify_http_status(404, "x"), FileNotFoundError)
+
+
+def test_gcs_429_retry_after_floors_backoff(gcs, monkeypatch):
+    """A 429 with Retry-After is retried, and the server's hint FLOORS
+    the seeded delay (deterministic, never below the hint)."""
+    from parmmg_tpu.utils import retry as retry_mod
+
+    srv, make = gcs
+    recorded = []
+
+    def spying_retry(fn, **kw):
+        kw["sleep"] = recorded.append
+        return retry_mod.retry(fn, **kw)
+
+    monkeypatch.setattr(ckpt_store, "retry", spying_retry)
+    st = make(attempts=3, backoff=0.01)
+    st.put("a", b"1")
+    recorded.clear()
+    srv.inject("get", status=429, retry_after=3, times=1)
+    assert st.get("a") == b"1"
+    assert recorded and recorded[0] >= 3.0
+
+
+def test_gcs_500_retry_and_budget(gcs):
+    srv, make = gcs
+    st = make(attempts=3)
+    srv.inject("upload", status=500, times=2)
+    st.put("b", b"2")                      # recovered within budget
+    srv.inject("upload", status=500, times=3)
+    with pytest.raises(CheckpointIOError, match="attempts"):
+        st.put("c", b"3")
+
+
+def test_gcs_stall_trips_timeout_then_recovers(gcs):
+    srv, make = gcs
+    st = make(attempts=2, http_timeout=0.3)
+    st.put("s", b"stall-me")
+    srv.inject("get", stall=1.2, times=1)
+    t0 = time.perf_counter()
+    assert st.get("s") == b"stall-me"
+    assert time.perf_counter() - t0 >= 0.3
+
+
+def test_gcs_truncated_body_retried(gcs):
+    srv, make = gcs
+    st = make(attempts=3)
+    payload = b"x" * 4096
+    st.put("t", payload)
+    srv.inject("get", truncate=0.5, times=1)
+    assert st.get("t") == payload
+
+
+def test_gcs_terminal_statuses_not_retried(gcs):
+    srv, make = gcs
+    st = make(attempts=5)
+    st.put("z", b"1")
+    srv.reset_counts()
+    srv.inject("get", status=401, times=10)
+    with pytest.raises(CheckpointAuthError):
+        st.get("z")
+    assert srv.request_count("get") == 1   # terminal: ONE attempt
+    srv.clear_faults()
+    with pytest.raises(FileNotFoundError):
+        st.get("missing")
+    srv.reset_counts()
+    srv.inject("upload", status=412, times=10)
+    with pytest.raises(CheckpointPreconditionError):
+        st.publish("m.json", b"tok")
+    assert srv.request_count("upload") == 1
+
+
+def test_gcs_conditional_publish_generation_conflict(gcs):
+    """The if-generation-match commit token: a publisher whose
+    generation snapshot went stale (concurrent publisher won) gets the
+    typed 412 instead of silently overwriting the winner."""
+    srv, make = gcs
+    st = make()
+    st.publish("m.json", b"epoch-1")       # create (generation 0 match)
+    gen = st._generation("m.json")
+    assert gen > 0
+    st.publish("m.json", b"epoch-2")       # re-publish advances
+    assert st.get("m.json") == b"epoch-2"
+    # stale-generation conditional write: the raw conflict surface
+    with pytest.raises(CheckpointPreconditionError):
+        st._put("m.json", b"stale-writer", generation_match=gen)
+    assert st.get("m.json") == b"epoch-2"  # winner kept
+
+
+def test_gcs_list_pagination(gcs):
+    srv, make = gcs
+    srv.page_size = 2
+    try:
+        st = make()
+        names = [f"obj{i:02d}" for i in range(5)]
+        for n in names:
+            st.put(n, n.encode())
+        assert st.list() == names
+    finally:
+        srv.page_size = 1000
+
+
+def test_gcs_auth_token_and_providers(monkeypatch):
+    srv = FakeGCS(require_token="sekrit")
+    base = srv.start()
+    try:
+        ok = GCSStore("b", endpoint=base, attempts=2, backoff=0.0,
+                      token_provider=lambda: "sekrit")
+        ok.put("x", b"1")
+        assert ok.get("x") == b"1"
+        bad = GCSStore("b", endpoint=base, attempts=2, backoff=0.0,
+                       token_provider=None)
+        with pytest.raises(CheckpointAuthError):
+            bad.get("x")
+        # env provider reads PMMGTPU_GCS_TOKEN per call
+        monkeypatch.setenv("PMMGTPU_GCS_TOKEN", "sekrit")
+        envd = GCSStore("b", endpoint=base, attempts=2, backoff=0.0)
+        assert envd.get("x") == b"1"
+        # resolution rules: explicit mode wins; non-Google endpoint
+        # without a token defaults to anonymous
+        monkeypatch.setenv("PMMGTPU_GCS_AUTH", "anon")
+        assert resolve_token_provider(base) is None
+        monkeypatch.setenv("PMMGTPU_GCS_AUTH", "env")
+        prov = resolve_token_provider(base)
+        assert prov is not None and prov() == "sekrit"
+        monkeypatch.setenv("PMMGTPU_GCS_AUTH", "bogus")
+        with pytest.raises(ValueError, match="PMMGTPU_GCS_AUTH"):
+            resolve_token_provider(base)
+        monkeypatch.delenv("PMMGTPU_GCS_AUTH")
+        monkeypatch.delenv("PMMGTPU_GCS_TOKEN")
+        assert resolve_token_provider(base) is None
+    finally:
+        srv.stop()
+
+
+def test_gcs_make_store_spec(gcs, monkeypatch):
+    srv, make = gcs
+    monkeypatch.setenv("PMMGTPU_GCS_ENDPOINT", srv.base_url)
+    monkeypatch.setenv("PMMGTPU_CKPT_ATTEMPTS", "5")
+    st = ckpt_store.make_store("gs://specbkt/some/prefix", None)
+    assert isinstance(st, GCSStore)
+    assert st.bucket == "specbkt" and st.prefix == "some/prefix/"
+    assert st.attempts == 5
+    st.put("via-spec", b"ok")
+    assert st.get("via-spec") == b"ok"
+    with pytest.raises(ValueError, match="bucket"):
+        GCSStore.from_url("gs://")
+
+
+def test_gcs_checkpointer_world2_roundtrip(gcs):
+    """The full sharded-checkpoint protocol over real HTTP: two
+    in-process ranks share the fake bucket, the rank-0 manifest digests
+    verify, and an elastic world-1 reader re-concatenates."""
+    import jax
+
+    from parmmg_tpu.parallel.distribute import split_mesh
+    from parmmg_tpu.parallel.partition import sfc_partition
+
+    srv, make = gcs
+    opts = AdaptOptions(hsiz=0.35, niter=2)
+    mesh = unit_cube_mesh(2)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 8)))
+    st8, _ = split_mesh(mesh, part, 8)
+    ranks = [
+        failsafe.Checkpointer(None, opts, "distributed", rank=r,
+                              world=2, barrier=lambda t: None,
+                              store=make())
+        for r in (0, 1)
+    ]
+    for c in ranks:
+        c.save(0, {"mesh": st8}, history=[{"iter": 0}], emult=1.7)
+    assert sorted(n for n in srv.objects) == [
+        "ck/ckpt_00000.json", "ck/ckpt_00000.proc0.npz",
+        "ck/ckpt_00000.proc1.npz",
+    ]
+    rdr = failsafe.Checkpointer(None, opts, "distributed", rank=0,
+                                world=1, barrier=lambda t: None,
+                                store=make())
+    rs = rdr.load()
+    assert rs is not None and rs.source_world == 2 and rs.it == 0
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(rs.mesh.vert)),
+        np.asarray(jax.device_get(st8.vert)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# PR-5 NOTE regression: npz corruption is typed
+# ---------------------------------------------------------------------------
+
+
+def test_npz_corruption_typed_taxonomy():
+    for garbage in (b"not-a-zip-at-all", b"PK\x03\x04torn"):
+        with pytest.raises(CheckpointCorruptionError) as ei:
+            ckpt_store.npz_arrays(garbage)
+        # both halves of the contract: ValueError keeps the loader's
+        # fall-back-to-previous catch working, CheckpointIOError maps
+        # an escape onto the typed exit (89)
+        assert isinstance(ei.value, ValueError)
+        assert isinstance(ei.value, CheckpointIOError)
+    # a flipped byte mid-payload (CRC damage) classifies the same way
+    blob = bytearray(ckpt_store.npz_bytes({"a": np.arange(64)}))
+    blob[len(blob) // 2] ^= 0xFF
+    with pytest.raises(CheckpointCorruptionError):
+        ckpt_store.npz_arrays(bytes(blob))
+    # corruption is TERMINAL for the store retry envelope: re-reading
+    # rotten bytes cannot help
+    assert not ckpt_store._retryable(CheckpointCorruptionError("x"))
+
+
+def test_npz_corruption_falls_back_to_previous_epoch(tmp_path):
+    """Driver-visible half of the regression: a corrupted NEWEST npz
+    makes `Checkpointer.load` fall back to the previous committed
+    epoch deliberately (typed corruption inside, not a bare
+    ValueError bubbling up)."""
+    opts = AdaptOptions(hsiz=0.45, niter=3)
+    mesh = unit_cube_mesh(2)
+    ck = str(tmp_path / "ck")
+    c = failsafe.Checkpointer(ck, opts, "centralized", rank=0, world=1)
+    for it in (0, 1):
+        c.save(it, {"mesh": mesh}, history=[{"iter": it}], emult=1.6)
+    path = os.path.join(ck, "ckpt_00001.npz")
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    rs = c.load()
+    assert rs is not None and rs.it == 0

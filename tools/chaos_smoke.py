@@ -1,33 +1,57 @@
 """Seeded chaos harness for the fail-safe layer (tools/check.sh gate).
 
-Generates N randomized-but-SEEDED fault schedules — kill / sigterm /
-ioerror / slowio / nan / overflow / retrace / preempt-notice at random
-iterations, phases and store-op ordinals, with async snapshot staging
-flipped at random — and runs each against the public `adapt` driver in
-a subprocess. The contract under chaos:
+Two matrices, one contract:
+
+**Single-rank matrix** (default): N randomized-but-SEEDED fault
+schedules — kill / sigterm / ioerror / slowio / nan / overflow /
+retrace / preempt-notice at random iterations, phases and store-op
+ordinals, with async snapshot staging flipped at random — each run
+against the public `adapt` driver in a subprocess. Killed runs are
+resumed fault-free; some resumes randomly FLIP the Pallas-kernel
+backend (``PMMGTPU_KERNELS`` off↔on) to assert end to end that
+backend knobs are excluded from the checkpoint fingerprint and never
+refuse a resume (digest equivalence is only asserted for un-flipped
+resumes — the interpret-mode kernels are equivalent, not
+bit-identical).
+
+**Multi-rank matrix** (``--world N``): seeded schedules that target
+RANDOM RANKS of a real ``jax.distributed`` world (N coordinated
+processes, the `tests/multihost_worker.py --failsafe` workload) with
+trajectory-NEUTRAL faults only — kill@rank r, broadcast sigterm,
+peer-lost@rank r (an injected coordination-service report), ckpt-store
+ioerror/slowio bursts @rank r, preempt-notice, and the commit-window
+kill (``it<k>:ckpt:kill@rank0``: rank 0 dies at the manifest publish,
+BETWEEN the two barrier rounds of the sharded commit). Every rank of
+every seed must end typed; killed/broken worlds are resumed fault-free
+(alternating same-world and ELASTIC world-1 resumes) and must
+reproduce the uninterrupted reference digest bit for bit; and every
+seed must leave a complete per-rank post-mortem — the JSONL timelines
++ ``metrics_rank*.json`` rendered by ``tools/obs_report.py --chaos``
+as a fault → detection → recovery chain per rank (asserted per seed).
+
+The contract under chaos (both matrices):
 
 - every run terminates inside the stage watchdog (subprocess timeout)
   — **zero hangs**;
 - every run ends in a TYPED outcome: exit 0 with a
-  ``CHAOS_RESULT status=<ReturnStatus>`` line, or a documented exit
-  code of the 86/87/88/89 family (kill/preemption, peer lost, resume
-  refusal, checkpoint I/O abort) announced by a ``CHAOS_TYPED`` line —
-  **zero untyped tracebacks** anywhere in any log;
-- a killed run RESUMES from its checkpoint **bit-identically**: the
-  resumed final-mesh digest equals the uninterrupted reference run's
-  (schedules containing trajectory-altering faults — nan / overflow /
-  retrace, whose recovery legitimately changes the iteration history —
-  assert the typed outcome only; schedules made purely of
-  trajectory-neutral faults must also reproduce the reference digest).
+  ``CHAOS_RESULT``/``ADAPT_DIGEST`` line, or a documented exit code of
+  the 86/87/88/89 family (kill/preemption, peer lost, resume refusal,
+  checkpoint I/O abort) — **zero untyped tracebacks** in any log;
+- killed runs RESUME from their checkpoint **bit-identically**
+  (single-rank schedules containing trajectory-altering faults —
+  nan/overflow/retrace — and backend-flipped resumes assert the typed
+  outcome only).
 
-Scheduling rules keeping every assertion well-defined: a terminal fault
-(kill/sigterm) is always the LAST driver-phase fault of its schedule,
-so everything before it is committed into the checkpoint the resume
-reads, and the resumed run (fault-free) replays the identical
-deterministic trajectory.
+Scheduling rules keeping every assertion well-defined: a terminal
+fault is always the LAST fault of its schedule, so everything before
+it is committed into the checkpoint the resume reads, and the resumed
+run (fault-free) replays the identical deterministic trajectory.
 
-Run: ``python tools/chaos_smoke.py --seeds 3 [--seed-base 0]``.
-Exit 0 = every seeded schedule behaved.
+Run: ``python tools/chaos_smoke.py --seeds 3 [--seed-base 0]
+[--world N]``. Exit 0 = every seeded schedule behaved. The optional
+``PARMMG_STAGE_BUDGET_S`` env bounds the stage: once the elapsed time
+plus a (measured) per-seed estimate would exceed it, remaining seeds
+are skipped with a notice instead of tripping the stage timeout.
 """
 
 import argparse
@@ -37,6 +61,7 @@ import random
 import subprocess
 import sys
 import tempfile
+import time
 import shutil
 
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
@@ -49,6 +74,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # exit codes of the typed family (mirrors parmmg_tpu.failsafe without
 # importing jax in the parent before the workers fork their own envs)
@@ -63,6 +89,12 @@ OPTS = dict(hsiz=0.45, niter=3, max_sweeps=3, hgrad=None,
 # per-run stage watchdog: a wedged worker is a FAILURE of the
 # zero-hang contract, not something to wait out
 RUN_TIMEOUT = 600
+# the multi-rank workload runs more machinery (coordination handshake,
+# SPMD compiles on every rank) — give each WORLD run a wider bound
+WORLD_RUN_TIMEOUT = 900
+# multi-rank workload geometry (tests/multihost_worker.py --failsafe):
+# niter=2, so schedules may reference it0/it1 only
+WORLD_NITER = 2
 
 # faults whose recovery changes the trajectory (rollback, grown
 # capacities): runs containing them assert typed outcomes, not digests
@@ -71,12 +103,41 @@ NEUTRAL_FAULTS = ("preempt-notice",)
 DRIVER_PHASES = ("remesh", "post")
 
 
+class StageBudget:
+    """PARMMG_STAGE_BUDGET_S accountant: refuses to start a unit of
+    work whose (measured) duration estimate would overrun the stage
+    budget — the harness then reports a capped-but-green stage instead
+    of being SIGKILLed mid-seed by the stage timeout."""
+
+    def __init__(self):
+        b = os.environ.get("PARMMG_STAGE_BUDGET_S")
+        self.budget = float(b) if b else None
+        self.t0 = time.monotonic()
+        self.worst = 0.0
+
+    def note(self, seconds: float) -> None:
+        self.worst = max(self.worst, seconds)
+
+    def allows_another(self, fallback_estimate: float = 120.0) -> bool:
+        if self.budget is None:
+            return True
+        est = self.worst or fallback_estimate
+        return time.monotonic() - self.t0 + est * 1.15 < self.budget
+
+
 def worker(ckdir: str) -> None:
     """Child mode: one checkpointing adapt run under the PARMMG_FAULTS
     env schedule; every outcome is typed — a result line + exit 0, or a
     CHAOS_TYPED line + an 86/88/89-family exit code."""
     import jax
     from jax._src import xla_bridge as _xb
+
+    # Pallas registers Mosaic lowerings for platform "tpu" at import
+    # time and refuses once "tpu" is deregistered — import it first
+    # (same ordering as tests/conftest.py / tools/kernel_smoke.py);
+    # the kernel-flip resume leg runs with PMMGTPU_KERNELS=on
+    import jax.experimental.pallas  # noqa: F401
+    from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
 
     for _accel in ("axon", "tpu", "cuda", "rocm"):
         _xb._backend_factories.pop(_accel, None)
@@ -120,8 +181,9 @@ def worker(ckdir: str) -> None:
 
 
 def gen_schedule(rng: random.Random):
-    """One seeded schedule: (spec string, terminal kind or None,
-    trajectory-altering?, async staging?)."""
+    """One seeded single-rank schedule: (spec string, terminal kind or
+    None, trajectory-altering?, async staging?, flip kernel backend on
+    resume?)."""
     faults = []
     trajectory = False
     # 0-2 background faults
@@ -157,7 +219,13 @@ def gen_schedule(rng: random.Random):
         # land one iteration earlier to fire at all.
         term_it = OPTS["niter"] - (1 if terminal == "kill" else 2)
         faults.append(f"it{term_it}:post:{terminal}")
-    return ",".join(faults), terminal, trajectory, rng.random() < 0.5
+    # resume-across-backends leg: some killed runs resume with the
+    # kernel backend flipped (PMMGTPU_KERNELS=on — interpret mode off
+    # TPU). The fingerprint excludes backend knobs, so the resume must
+    # be ACCEPTED; bit-digests are only asserted for un-flipped resumes
+    flip = terminal is not None and rng.random() < 0.4
+    return ",".join(faults), terminal, trajectory, rng.random() < 0.5, \
+        flip
 
 
 def _timeline_kinds(obs_dir: str):
@@ -185,6 +253,33 @@ def _timeline_kinds(obs_dir: str):
                         and rec.get("name") == "fault_injected":
                     kinds.append(rec.get("args", {}).get("kind"))
     return bool(paths) and n_lines > 0, kinds
+
+
+def _assert_postmortem(obs_dir: str, label: str, kinds=()):
+    """Render the per-rank chaos post-mortem for a seed's trace dir
+    through the REAL CLI (a subprocess — the parent stays jax-free)
+    and require it to name every expected fault kind. Returns the
+    rendered text; raises AssertionError on a broken report."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "obs_report.py"),
+         obs_dir, "--chaos", "1"],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, (
+        f"{label}: chaos post-mortem failed to render: "
+        f"{p.stdout[-1000:]}{p.stderr[-1000:]}"
+    )
+    text = p.stdout
+    assert "chaos post-mortem" in text, text[-500:]
+    for kind in kinds:
+        assert f"injected: {kind}" in text, (
+            f"{label}: post-mortem does not name injected fault "
+            f"{kind!r}:\n{text}"
+        )
+    return text
 
 
 def _run(ckdir: str, log: str, env_extra: dict) -> int:
@@ -221,14 +316,11 @@ def _field(text: str, key: str):
     return None
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--seeds", type=int, default=3)
-    ap.add_argument("--seed-base", type=int, default=0)
-    args = ap.parse_args()
-
+def main(args) -> int:
     tmp = tempfile.mkdtemp(prefix="parmmg_chaos_")
     failures = []
+    budget = StageBudget()
+    done = 0
     try:
         # shared fault-free reference digest (all terminal/neutral
         # schedules must converge to it)
@@ -243,8 +335,15 @@ def main() -> int:
         print(f"[chaos] reference digest {ref_digest[:16]}…")
 
         for seed in range(args.seed_base, args.seed_base + args.seeds):
+            if not budget.allows_another():
+                print(f"[chaos] stage budget reached after {done} "
+                      f"seed(s) — skipping seeds {seed}.."
+                      f"{args.seed_base + args.seeds - 1}")
+                break
+            t_seed = time.monotonic()
             rng = random.Random(seed)
-            spec, terminal, trajectory, use_async = gen_schedule(rng)
+            spec, terminal, trajectory, use_async, flip = \
+                gen_schedule(rng)
             ck = os.path.join(tmp, f"ck_{seed}")
             log = os.path.join(tmp, f"seed_{seed}.log")
             env = {"PARMMG_FAULTS": spec}
@@ -257,6 +356,9 @@ def main() -> int:
             except subprocess.TimeoutExpired:
                 failures.append(f"{label}: HANG (watchdog)")
                 continue
+            finally:
+                done += 1
+                budget.note(time.monotonic() - t_seed)
             text = open(log).read()
             if rc not in TYPED_RCS:
                 failures.append(
@@ -294,14 +396,27 @@ def main() -> int:
                     continue
                 print(f"[chaos] {label} -> typed status {status}")
             elif rc == KILL:
-                # resume the killed run fault-free: bit-identical
+                # resume the killed run fault-free: bit-identical —
+                # with the kernel backend randomly FLIPPED on some
+                # seeds (the fingerprint-exclusion leg: a backend knob
+                # must never refuse a resume)
+                renv = {"PARMMG_FAULTS": ""}
+                if flip:
+                    renv["PMMGTPU_KERNELS"] = "on"
                 try:
-                    rc2 = _run(ck, log + ".resume",
-                               {"PARMMG_FAULTS": ""})
+                    rc2 = _run(ck, log + ".resume", renv)
                 except subprocess.TimeoutExpired:
                     failures.append(f"{label}: resume HANG")
                     continue
                 rtext = open(log + ".resume").read()
+                if rc2 == MISMATCH:
+                    failures.append(
+                        f"{label}: resume REFUSED"
+                        + (" with kernels flipped — the backend knob "
+                           "leaked into the fingerprint" if flip
+                           else "") + f": …{rtext[-1500:]}"
+                    )
+                    continue
                 if rc2 != 0 or "Traceback (most recent call last)" \
                         in rtext:
                     failures.append(
@@ -309,7 +424,11 @@ def main() -> int:
                     )
                     continue
                 ok = _field(rtext, "digest") == ref_digest
-                if trajectory:
+                if flip:
+                    print(f"[chaos] {label} -> {terminal} + resume "
+                          "ACCEPTED with kernels flipped off->on "
+                          "(fingerprint excludes backend knobs)")
+                elif trajectory:
                     # a pre-kill trajectory fault is baked into the
                     # checkpoint: the resume must still END typed, but
                     # the digest legitimately differs
@@ -328,8 +447,317 @@ def main() -> int:
             for f in failures:
                 print(" -", f)
             return 1
-        print(f"[chaos] all {args.seeds} seeded schedules terminated "
+        print(f"[chaos] all {done} seeded schedules terminated "
               "typed — zero hangs, zero untyped tracebacks")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# multi-rank matrix (--world N)
+# ---------------------------------------------------------------------------
+
+
+def _world_env(extra: dict) -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=ROOT,
+        PYTHONFAULTHANDLER="1",
+        PMMGTPU_CKPT_TIMEOUT="5",
+        PMMGTPU_CKPT_BACKOFF="0.01",
+    )
+    env.update(extra)
+    return env
+
+
+def _run_world(tmp: str, tag: str, world: int, extra: dict):
+    """N coordinated `multihost_worker.py --failsafe` processes (8/N
+    CPU devices each). Returns (rcs, log texts); raises
+    subprocess.TimeoutExpired on a hang (after killing the world)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker_py = os.path.join(ROOT, "tests", "multihost_worker.py")
+    ndev = 8 // world
+    procs, logs = [], []
+    for pid in range(world):
+        env = _world_env(dict(
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={ndev}",
+            PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
+            PMMGTPU_NUM_PROCS=str(world),
+            PMMGTPU_PROC_ID=str(pid),
+            **extra,
+        ))
+        lp = os.path.join(tmp, f"{tag}{pid}.log")
+        logs.append(lp)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker_py, "--failsafe"], env=env,
+            stdout=open(lp, "w"), stderr=subprocess.STDOUT, cwd=ROOT,
+        ))
+    deadline = time.monotonic() + WORLD_RUN_TIMEOUT
+    rcs = []
+    try:
+        for p in procs:
+            rcs.append(p.wait(timeout=max(deadline - time.monotonic(),
+                                          1.0)))
+    finally:
+        for p in procs:
+            p.kill()
+    return rcs, [open(lp).read() for lp in logs]
+
+
+def _run_world_single(tmp: str, tag: str, extra: dict):
+    """One UN-coordinated worker owning all 8 devices with the same
+    SPMD sweep programs (PMMGTPU_SPMD_SWEEPS=1) — the elastic N→1
+    resume leg. Returns (rc, log text)."""
+    env = _world_env(dict(
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PMMGTPU_SPMD_SWEEPS="1",
+        **extra,
+    ))
+    for k in ("PMMGTPU_COORDINATOR", "PMMGTPU_NUM_PROCS",
+              "PMMGTPU_PROC_ID"):
+        env.pop(k, None)
+    lp = os.path.join(tmp, f"{tag}.log")
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tests", "multihost_worker.py"),
+         "--failsafe"],
+        env=env, stdout=open(lp, "w"), stderr=subprocess.STDOUT,
+        cwd=ROOT, timeout=WORLD_RUN_TIMEOUT,
+    )
+    return p.returncode, open(lp).read()
+
+
+def _digest_lines(text: str):
+    return [ln for ln in text.splitlines()
+            if ln.startswith("ADAPT_DIGEST")]
+
+
+def gen_world_schedule(rng: random.Random, world: int):
+    """One seeded multi-rank schedule over trajectory-NEUTRAL faults
+    (every killed/broken world must resume to the reference digest).
+
+    Returns (spec, terminal, expected) where terminal is None or
+    (kind, rank) and expected maps rank -> set of allowed exit codes.
+    """
+    all_ok = {r: {0} for r in range(world)}
+    faults = []
+    # 0-2 background faults: absorbed ckpt-store noise + notices
+    for _ in range(rng.randint(0, 2)):
+        rank = rng.randrange(world)
+        if rng.random() < 0.5:
+            burst = rng.choice((1, 2))        # < retry budget: absorbed
+            start = rng.randint(0, 4)
+            kind = rng.choice(("ioerror", "slowio"))
+            faults += [f"it{start + j}:ckpt:{kind}@rank{rank}"
+                       for j in range(burst)]
+        else:
+            faults.append(f"it{rng.randint(0, WORLD_NITER - 1)}:post:"
+                          f"preempt-notice@rank{rank}")
+    terminal = None
+    expected = all_ok
+    roll = rng.random()
+    rank = rng.randrange(world)
+    # ~5/6 of seeds end in a terminal fault; survivors of a killed
+    # rank exit 87 via the collective watchdog (or 0 if they finished
+    # their last collective first — a legitimate race at the tail)
+    survivors = {r: {0, PEER_LOST} for r in range(world)}
+    if roll < 0.20:
+        # rank-targeted hard kill after the it0 checkpoint commit
+        terminal = ("kill", rank)
+        faults.append(f"it0:post:kill@rank{rank}")
+        expected = {**survivors, rank: {KILL}}
+    elif roll < 0.40:
+        # broadcast SIGTERM (a platform preemption hits the whole
+        # world): every rank commits, then exits through the graceful
+        # preemption path
+        terminal = ("sigterm", None)
+        faults.append("it0:post:sigterm")
+        expected = {r: {KILL} for r in range(world)}
+    elif roll < 0.60:
+        # injected coordination-service peer-loss report on one rank:
+        # ITS next barrier refuses typed; the real peers then lose it
+        terminal = ("peer-lost", rank)
+        faults.append(f"it0:post:peer-lost@rank{rank}")
+        expected = {**survivors, rank: {PEER_LOST}}
+    elif roll < 0.80:
+        # commit-window kill: rank 0 dies AT THE MANIFEST PUBLISH,
+        # between the data barrier and the commit barrier — the epoch
+        # stays uncommitted, survivors watchdog out typed
+        terminal = ("kill", 0)
+        faults.append(f"it{rng.randint(0, 2)}:ckpt:kill@rank0")
+        expected = {**survivors, 0: {KILL}}
+    elif roll < 0.90:
+        # unabsorbable ckpt-store outage on one rank: typed 89 abort
+        # mid-protocol, peers watchdog out
+        terminal = ("ioerror", rank)
+        start = rng.randint(1, 4)
+        faults += [f"it{start + j}:ckpt:ioerror@rank{rank}"
+                   for j in range(8)]
+        expected = {**survivors, rank: {CKPT_IO, PEER_LOST}}
+    return ",".join(faults), terminal, expected
+
+
+def main_world(args) -> int:
+    world = args.world
+    assert 8 % world == 0, f"--world {world} must divide 8 devices"
+    tmp = tempfile.mkdtemp(prefix="parmmg_chaos_w_")
+    failures = []
+    budget = StageBudget()
+    done = 0
+    try:
+        # fault-free reference digest at the target world size (the
+        # single-controller SPMD run reproduces it bit for bit — the
+        # elastic legs lean on that, asserted by fault_smoke/m10)
+        t0 = time.monotonic()
+        rcs, logs = _run_world(tmp, "ref", world,
+                               {"PMMGTPU_WATCHDOG": "300"})
+        budget.note(time.monotonic() - t0)
+        assert rcs == [0] * world, (rcs, logs[0][-2000:],
+                                    logs[-1][-2000:])
+        ref = _digest_lines(logs[0])
+        assert ref and all(_digest_lines(t) == ref for t in logs), logs
+        print(f"[chaos-w{world}] reference {ref[0][:60]}…")
+
+        for seed in range(args.seed_base, args.seed_base + args.seeds):
+            # a terminal seed costs run + resume: require 2 units
+            if not budget.allows_another(fallback_estimate=240.0):
+                print(f"[chaos-w{world}] stage budget reached after "
+                      f"{done} seed(s) — skipping seeds {seed}.."
+                      f"{args.seed_base + args.seeds - 1}")
+                break
+            t_seed = time.monotonic()
+            rng = random.Random(10_000 + seed)
+            spec, terminal, expected = gen_world_schedule(rng, world)
+            ck = os.path.join(tmp, f"ck_{seed}")
+            obs = ck + "_obs"
+            label = (f"w{world} seed {seed}: "
+                     f"faults={spec or '<none>'}")
+            extra = {
+                "PARMMG_FAULTS": spec,
+                "PMMGTPU_CKPT_DIR": ck,
+                "PMMGTPU_WATCHDOG": "60",
+                "PMMGTPU_TRACE": obs,
+            }
+            try:
+                rcs, logs = _run_world(tmp, f"seed{seed}_", world,
+                                       extra)
+            except subprocess.TimeoutExpired:
+                failures.append(f"{label}: HANG (watchdog)")
+                done += 1
+                continue
+            finally:
+                budget.note(time.monotonic() - t_seed)
+            done += 1
+            bad = [
+                (r, rc) for r, rc in enumerate(rcs)
+                if rc not in TYPED_RCS
+            ]
+            if bad:
+                failures.append(
+                    f"{label}: untyped exits {bad}: "
+                    f"…{logs[bad[0][0]][-1500:]}"
+                )
+                continue
+            wrong = [
+                (r, rc) for r, rc in enumerate(rcs)
+                if rc not in expected[r]
+            ]
+            if wrong:
+                failures.append(
+                    f"{label}: exits {rcs} outside the expected "
+                    f"per-rank sets {expected}: "
+                    f"…{logs[wrong[0][0]][-1500:]}"
+                )
+                continue
+            tb = [r for r, t in enumerate(logs)
+                  if "Traceback (most recent call last)" in t]
+            if tb:
+                failures.append(
+                    f"{label}: untyped traceback on rank {tb[0]}: "
+                    f"…{logs[tb[0]][-1500:]}"
+                )
+                continue
+
+            if terminal is None:
+                if any(_digest_lines(t) != ref for t in logs):
+                    failures.append(
+                        f"{label}: neutral-schedule digest diverged"
+                    )
+                    continue
+                try:
+                    _assert_postmortem(obs, label)
+                except AssertionError as e:
+                    failures.append(str(e))
+                    continue
+                print(f"[chaos-w{world}] {label} -> all ranks typed, "
+                      "reference digest")
+                continue
+
+            # terminal seed: resume fault-free, alternating the resume
+            # world — even seeds same-world, odd seeds ELASTIC N->1
+            elastic = seed % 2 == 1
+            try:
+                if elastic:
+                    rc1, text = _run_world_single(
+                        tmp, f"seed{seed}_resume",
+                        {"PMMGTPU_CKPT_DIR": ck, "PMMGTPU_TRACE": obs},
+                    )
+                    rcs2, rlogs = [rc1], [text]
+                else:
+                    rcs2, rlogs = _run_world(
+                        tmp, f"seed{seed}_resume_", world,
+                        {"PMMGTPU_CKPT_DIR": ck,
+                         "PMMGTPU_WATCHDOG": "300",
+                         "PMMGTPU_TRACE": obs},
+                    )
+            except subprocess.TimeoutExpired:
+                failures.append(f"{label}: resume HANG")
+                continue
+            if any(rc != 0 for rc in rcs2):
+                failures.append(
+                    f"{label}: resume exits {rcs2}: "
+                    f"…{rlogs[0][-1500:]}"
+                )
+                continue
+            if any(_digest_lines(t) != ref for t in rlogs):
+                failures.append(
+                    f"{label}: "
+                    f"{'elastic ' if elastic else ''}resume digest "
+                    f"diverged (want {ref})"
+                )
+                continue
+            # the per-rank post-mortem must render AND name the
+            # injected terminal fault + the recovery chain
+            kind = terminal[0]
+            try:
+                text = _assert_postmortem(obs, label, kinds=[kind])
+                assert ("recover  resume" in text
+                        or "recover  checkpoint_commit" in text), (
+                    f"{label}: post-mortem shows no recovery events:"
+                    f"\n{text}"
+                )
+            except AssertionError as e:
+                failures.append(str(e))
+                continue
+            print(f"[chaos-w{world}] {label} -> typed "
+                  f"{dict(enumerate(rcs))}, "
+                  f"{'elastic 1-rank' if elastic else f'{world}-rank'}"
+                  " resume bit-identical, post-mortem complete")
+        if failures:
+            print(f"\n[chaos-w{world}] FAILURES:")
+            for f in failures:
+                print(" -", f)
+            return 1
+        print(f"[chaos-w{world}] all {done} seeded rank-targeted "
+              "schedules terminated typed — zero hangs, bit-identical "
+              "resumes, per-rank post-mortems complete")
         return 0
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -338,4 +766,11 @@ def main() -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2])
-    sys.exit(main())
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--seed-base", type=int, default=0)
+    ap.add_argument("--world", type=int, default=1,
+                    help="multi-rank matrix: N coordinated processes "
+                         "(default 1 = the single-rank matrix)")
+    args = ap.parse_args()
+    sys.exit(main(args) if args.world == 1 else main_world(args))

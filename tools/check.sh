@@ -36,10 +36,27 @@ echo "## fault-smoke-multihost rc=$rc"
 # seeded chaos stage: randomized-but-seeded fault schedules (kill /
 # sigterm / ioerror / slowio / nan / overflow / preempt-notice, async
 # staging flipped at random) — every run must end in a typed status or
-# a bit-identical resume; zero hangs, zero untyped tracebacks
-timeout -k 10 1800 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py --seeds 3
+# a bit-identical resume; zero hangs, zero untyped tracebacks. Some
+# killed runs resume with the Pallas-kernel backend FLIPPED
+# (PMMGTPU_KERNELS off->on): backend knobs must never refuse a resume
+timeout -k 10 1800 env JAX_PLATFORMS=cpu PARMMG_STAGE_BUDGET_S=1500 \
+    python tools/chaos_smoke.py --seeds 3
 rc=$?
 echo "## chaos-smoke rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
+# multi-rank chaos matrix: seeded schedules target RANDOM RANKS of a
+# real 2-process jax.distributed world — kill@rank, broadcast sigterm,
+# injected peer-loss reports, ckpt-store ioerror/slowio bursts, and
+# commit-window kills BETWEEN the two manifest barriers. Every rank
+# must exit typed, killed worlds must resume bit-identically (elastic
+# 2->1 on odd seeds), and every seed must render a per-rank chaos
+# post-mortem (obs_report --chaos). PARMMG_STAGE_BUDGET_S-bounded:
+# the harness stops scheduling seeds rather than tripping the timeout
+timeout -k 10 2700 env JAX_PLATFORMS=cpu PARMMG_STAGE_BUDGET_S=2400 \
+    python tools/chaos_smoke.py --world 2 --seeds 3
+rc=$?
+echo "## chaos-world2 rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
 # distributed-frontier smoke: 2-shard tiny run — sweep_active_fraction

@@ -3,6 +3,12 @@
 Usage:
   python tools/obs_report.py <trace-dir>            # text report
   python tools/obs_report.py <trace-dir> --json 1   # structured JSON
+  python tools/obs_report.py <trace-dir> --chaos 1  # per-rank chaos
+                                  # post-mortem: injected fault ->
+                                  # detection -> recovery chain per
+                                  # rank (file-ordered JSONL, spans a
+                                  # kill and its resume), merged with
+                                  # the surviving metrics_rank*.json
   python tools/obs_report.py <trace-dir> --merge-metrics out.json
                                   # one world metrics doc from the
                                   # per-rank metrics_rank*.json files
@@ -52,6 +58,13 @@ def main():
             json.dump(merged, f, indent=1)
         print(f"merged {merged['world']} rank doc(s) -> "
               f"{flags['merge-metrics']}")
+        return 0
+    if flags.get("chaos", "") not in ("", "0"):
+        if flags.get("json", "") not in ("", "0"):
+            print(json.dumps(obs_report.chaos_summary(trace_dir),
+                             indent=1, default=str))
+            return 0
+        print(obs_report.render_chaos(trace_dir))
         return 0
     if flags.get("json", "") not in ("", "0"):
         print(json.dumps(obs_report.summarize(trace_dir), indent=1,
