@@ -314,17 +314,29 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
     # from) through the async staging path, so the record carries a
     # real ckpt_overlap_s — how much checkpoint wall time hid behind
     # compute. Off by default: the headline throughput row stays
-    # I/O-free (the key then records 0.0).
+    # I/O-free (the key then records 0.0). PARMMG_BENCH_CKPT_STORE
+    # points the bench at a store SPEC instead of a temp dir — a real
+    # ``gs://`` bucket (PMMGTPU_GCS_* env) or a fake-GCS endpoint, the
+    # real-bucket checkpoint-overlap measurement of the ROADMAP's
+    # preemptible-fleet thread (tools/ckpt_bench.py drives it per
+    # epoch size).
     steady_opts = opts
     _ckpt_tmp = None
     if os.environ.get("PARMMG_BENCH_CKPT"):
         import dataclasses
         import tempfile
 
-        _ckpt_tmp = tempfile.mkdtemp(prefix="parmmg_bench_ckpt_")
-        steady_opts = dataclasses.replace(
-            opts, checkpoint_dir=_ckpt_tmp, checkpoint_async=True,
-        )
+        _ckpt_store = os.environ.get("PARMMG_BENCH_CKPT_STORE")
+        if _ckpt_store:
+            steady_opts = dataclasses.replace(
+                opts, checkpoint_store=_ckpt_store,
+                checkpoint_async=True,
+            )
+        else:
+            _ckpt_tmp = tempfile.mkdtemp(prefix="parmmg_bench_ckpt_")
+            steady_opts = dataclasses.replace(
+                opts, checkpoint_dir=_ckpt_tmp, checkpoint_async=True,
+            )
 
     # retrace accounting (lint.contracts): the warmup run is EXPECTED
     # to compile; the timed run must hit the in-process executable

@@ -435,6 +435,11 @@ def _main_traced(argv=None) -> int:
     except CheckpointIOError as e:
         print(f"parmmg_tpu: {type(e).__name__}: {e}", file=sys.stderr)
         return failsafe.CKPT_IO_EXIT_CODE
+    except failsafe.WorldReformError as e:
+        # an elastic survivor under a fleet supervisor: 90 = "relaunch
+        # me in the reformed world" (checkpoint committed)
+        print(f"parmmg_tpu: {e}", file=sys.stderr)
+        return failsafe.REFORM_EXIT_CODE
     finally:
         from .obs import trace as obs_trace
 

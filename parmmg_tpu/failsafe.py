@@ -109,6 +109,11 @@ MISMATCH_EXIT_CODE = 88
 # retries (io.ckpt_store.CheckpointIOError) — the chaos harness and
 # smoke stages assert the family {0, 86, 87, 88, 89} and nothing else
 CKPT_IO_EXIT_CODE = 89
+# exit code a SURVIVOR uses after a world-agreed elastic reformation
+# (`parallel.elastic`): the checkpoint is committed and the fleet
+# supervisor (tools/fleet.py) relaunches this rank in the reformed
+# world — exit 90 means "relaunch me", not "I failed"
+REFORM_EXIT_CODE = 90
 
 CHECKPOINT_FORMAT = 1
 
@@ -190,6 +195,27 @@ class PreemptionError(BaseException):
     driver recovery path can absorb it — exactly like a real
     preemption, the run ends and only the checkpoint survives. Used by
     tests that cannot afford a subprocess per driver."""
+
+
+class WorldReformError(BaseException):
+    """A world-agreed elastic reformation (`parallel.elastic`): the
+    epoch's checkpoint is committed and this SURVIVOR must tear down so
+    the fleet can relaunch it in the reformed world. BaseException like
+    :class:`PreemptionError` — no recovery path may absorb it (rollback
+    cannot un-agree a reformation the other ranks are already exiting
+    for). Workers convert it to :data:`REFORM_EXIT_CODE`."""
+
+    def __init__(self, kind: str, epoch: int, old_world: int,
+                 new_world: int):
+        super().__init__(
+            f"world reform ({kind}) agreed at epoch {epoch}: "
+            f"{old_world}→{new_world} ranks — checkpoint committed, "
+            "exiting for relaunch in the reformed world"
+        )
+        self.kind = kind
+        self.epoch = int(epoch)
+        self.old_world = int(old_world)
+        self.new_world = int(new_world)
 
 
 def classify(exc: BaseException, have_mesh: bool) -> tags.ReturnStatus:
@@ -1344,6 +1370,15 @@ class FailsafeHarness:
             )
             if (ckdir or store is not None) else None
         )
+        # elastic world supervisor (PMMGTPU_ELASTIC env contract):
+        # armed only with a checkpoint store to coordinate through —
+        # a reformation without a durable epoch to resume from would
+        # just be a crash with extra steps
+        self.elastic = None
+        if self.ckpt is not None:
+            from .parallel import elastic
+
+            self.elastic = elastic.coordinator_from_env(self.ckpt.store)
 
     # -- multi-host liveness --------------------------------------------
     def _barrier(self, tag: str) -> None:
@@ -1443,6 +1478,29 @@ class FailsafeHarness:
         from .parallel import multihost
 
         return multihost.preemption_notice()
+
+    # -- elastic world reformation --------------------------------------
+    def elastic_poll(self, it: int):
+        """World-agreed reform vote at an iteration boundary (see
+        `parallel.elastic.ElasticCoordinator.poll`). Contains a
+        collective when armed in a multi-process world, so EVERY rank
+        must reach this call at the same boundary — the distributed
+        loop calls it unconditionally right before its checkpoint
+        decision. Returns None (keep adapting) or the agreed
+        :class:`~parmmg_tpu.parallel.elastic.ReformDecision`; no-op
+        (None) when elasticity is not armed."""
+        if self.elastic is None:
+            return None
+        return self.elastic.poll(it, timeout=self.watchdog)
+
+    def elastic_exit(self, decision) -> BaseException:
+        """Seal one agreed reformation AFTER the reform checkpoint is
+        fully committed (callers drain async staging first): writes
+        this rank's exit ack (the downtime clock) and returns the typed
+        error to leave the driver with — PreemptionError for the
+        departing rank, WorldReformError for survivors."""
+        self.elastic.ack_exit(decision)
+        return self.elastic.error_for(decision)
 
     def save(self, it: int, meshes: Dict[str, Mesh], *, history, emult,
              meta=None, aux_arrays=None, force: bool = False) -> None:

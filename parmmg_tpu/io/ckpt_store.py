@@ -43,6 +43,7 @@ Env contract (read by :func:`make_store` for the default store):
 from __future__ import annotations
 
 import io as _io
+import json
 import os
 import threading
 import time
@@ -294,6 +295,34 @@ class CheckpointStore:
                 pass
 
         self._op("delete", name, _del)
+
+    # -- small JSON control records (elastic membership manifests) -------
+    def put_json(self, name: str, doc: dict) -> None:
+        """Store a small JSON control document (an elastic membership
+        manifest, reform request or exit ack) — same atomic whole-object
+        semantics as :meth:`put`."""
+        self.put(name, json.dumps(doc, sort_keys=True,
+                                  default=str).encode())
+
+    def publish_json(self, name: str, doc: dict) -> None:
+        """Commit-token JSON put (conditional on backends that support
+        it — the membership manifest of one elastic epoch must have
+        exactly one writer win)."""
+        self.publish(name, json.dumps(doc, sort_keys=True,
+                                      default=str).encode())
+
+    def get_json(self, name: str) -> dict:
+        """Read a JSON control document; a structurally broken payload
+        surfaces as the typed :class:`CheckpointCorruptionError` (a
+        torn or foreign object must not crash the reform protocol
+        untyped)."""
+        data = self.get(name)
+        try:
+            return json.loads(data.decode())
+        except (UnicodeDecodeError, ValueError) as e:
+            raise CheckpointCorruptionError(
+                f"corrupt JSON control record {name!r}: {e}"
+            ) from e
 
 
 class LocalFSStore(CheckpointStore):

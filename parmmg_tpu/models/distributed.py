@@ -952,11 +952,18 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
             last_good = fs.snapshot(stacked)
             if tr.enabled:
                 obs_metrics.registry().snapshot(it)
+            # elastic reform vote (world-agreed; a collective when
+            # armed multi-process, so it sits at the SAME boundary on
+            # every rank): a standing preemption notice becomes a
+            # shrink, restored capacity below the target world a grow —
+            # either way the epoch force-commits below before anyone
+            # exits
+            reform = fs.elastic_poll(it)
             if fs.ckpt is not None and (
                 fs.ckpt.due(it) or fs.preempt_requested
                 # a maintenance-event notice forces an out-of-cadence
                 # checkpoint NOW, before the platform's SIGTERM lands
-                or fs.preempt_notice()
+                or fs.preempt_notice() or reform is not None
             ):
                 meta = dict(ckpt_meta or {})
                 meta["icap"] = int(icap) if icap is not None else None
@@ -975,6 +982,15 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                     fs.save(it, {"mesh": stacked}, history=history,
                             emult=emult[0], meta=meta, aux_arrays=aux,
                             force=True)
+            if reform is not None:
+                # the agreed reformation's checkpoint is committed
+                # (drain any async-staged epoch first — the exit must
+                # leave durable state, not a staged one); ack, then
+                # leave through the unabsorbable typed path: the
+                # departing rank exits the preemption family, the
+                # survivors exit REFORM for the fleet to relaunch
+                fs.finish()
+                raise fs.elastic_exit(reform)
             if fs.preempt_requested:
                 # preemption grace window: the iteration's (sharded,
                 # barrier-committed) checkpoint is in place — exit via

@@ -108,17 +108,21 @@ def rank_timelines(dirpath: str) -> Dict[int, List[dict]]:
 
 
 # the event vocabulary of a chaos chain, by role: what was INJECTED,
-# how the failure was DETECTED, and what the run did to RECOVER. The
-# render tags each chain line with its role so a post-mortem reads as
+# how the failure was DETECTED, what the run did to RECOVER, and how
+# the WORLD itself changed shape (elastic reformations). The render
+# tags each chain line with its role so a post-mortem reads as
 # fault -> detection -> recovery without knowing the emitter sites.
 CHAOS_FAULT_EVENTS = ("fault_injected",)
 CHAOS_DETECT_EVENTS = ("sigterm_received", "peer_lost",
-                       "preempt_notice")
+                       "preempt_notice", "preempt_notice_cleared",
+                       "capacity_restored")
 CHAOS_RECOVER_EVENTS = ("rollback", "checkpoint_commit", "resume")
+CHAOS_WORLD_EVENTS = ("world_reform", "world_shrink", "world_grow")
 _CHAOS_ROLES = (
     [(n, "fault") for n in CHAOS_FAULT_EVENTS]
     + [(n, "detect") for n in CHAOS_DETECT_EVENTS]
     + [(n, "recover") for n in CHAOS_RECOVER_EVENTS]
+    + [(n, "world") for n in CHAOS_WORLD_EVENTS]
 )
 
 
@@ -155,12 +159,36 @@ def chaos_summary(dirpath: str) -> dict:
             recoveries=[c for c in chain if c["role"] == "recover"],
             chain=chain,
         )
+    # world-size timeline: elastic transitions deduped by (epoch, name)
+    # — every rank of a reformed epoch emits its own copy — ordered by
+    # epoch (the reformation counter is the only clock that survives
+    # process restarts)
+    seen = set()
+    world_timeline: List[dict] = []
+    for rank in sorted(ranks):
+        for c in ranks[rank]["chain"]:
+            if c["name"] not in ("world_shrink", "world_grow"):
+                continue
+            args = c["args"]
+            key = (args.get("epoch"), c["name"])
+            if key in seen:
+                continue
+            seen.add(key)
+            world_timeline.append(dict(
+                name=c["name"], epoch=args.get("epoch"),
+                old=args.get("old"), new=args.get("new"),
+                downtime_s=args.get("downtime_s"),
+                reason=args.get("reason", ""),
+            ))
+    world_timeline.sort(key=lambda t: (t["epoch"] is None,
+                                       t["epoch"] or 0))
     metrics = metrics_mod.merge_dir(dirpath)
     counters = (metrics or {}).get("counters", {})
     return dict(
         dir=dirpath,
         ranks=ranks,
         world=len(ranks),
+        world_timeline=world_timeline,
         metrics_ranks=(metrics or {}).get("world", 0),
         counters=dict(
             faults_injected=counters.get("failsafe/faults_injected", 0),
@@ -169,6 +197,8 @@ def chaos_summary(dirpath: str) -> dict:
             ckpt_retries=counters.get("ckpt/retries", 0),
             resumes=counters.get("ckpt/resumes", 0),
             barriers=counters.get("comm/barriers", 0),
+            world_shrinks=counters.get("elastic/world_shrink", 0),
+            world_grows=counters.get("elastic/world_grow", 0),
         ),
     )
 
@@ -205,6 +235,19 @@ def render_chaos(dirpath: str) -> str:
                 f"{c['role']:<8s} {c['name']}"
                 + (f"  {extra}" if extra else "")
             )
+    if s["world_timeline"]:
+        lines.append("")
+        lines.append("-- world-size timeline (elastic reformations) --")
+        for t in s["world_timeline"]:
+            arrow = f"{t['old']} -> {t['new']}"
+            dt = t.get("downtime_s")
+            dt_s = (f", downtime {dt:.3f}s"
+                    if isinstance(dt, (int, float)) and dt >= 0 else "")
+            why = f"  ({t['reason']})" if t.get("reason") else ""
+            lines.append(
+                f"   epoch {t['epoch']}: {t['name']}  world "
+                f"{arrow}{dt_s}{why}"
+            )
     c = s["counters"]
     lines.append("")
     lines.append(
@@ -217,6 +260,11 @@ def render_chaos(dirpath: str) -> str:
         f"ckpt retries {c['ckpt_retries']}  resumes {c['resumes']}  "
         f"barriers {c['barriers']}"
     )
+    if c["world_shrinks"] or c["world_grows"]:
+        lines.append(
+            f"   world shrinks {c['world_shrinks']}  world grows "
+            f"{c['world_grows']}"
+        )
     lines.append("")
     return "\n".join(lines)
 

@@ -1,0 +1,399 @@
+"""Elastic world supervisor: notice→shrink, capacity-restored→grow.
+
+The reference's remesh–repartition loop assumes one MPI world for the
+life of the run (`PMMG_Init_parMesh(PMMG_ARG_MPIComm, ...)`); on
+preemptible TPU pools that assumption is what forces an operator into
+the loop — before this module, a maintenance notice ended in the
+checkpoint-backed exit-86 family and a human restarting the job with a
+new layout. This module makes world-size changes an INTERNAL recovery
+action, the way `models.distributed._elastic_recut` already made shard-
+count changes an internal array transformation:
+
+- a **preemption notice** on rank r (any `parallel.multihost` notice
+  source) turns into a world-agreed SHRINK: the noticed rank publishes
+  a departure record into the checkpoint store, every rank agrees at
+  the same iteration boundary (one psum vote,
+  `multihost.agree_flags` — the ``MPI_Allreduce(ier)`` role), the
+  world force-commits its checkpoint, the departing rank exits through
+  the preemption path (86) and the survivors exit with the typed
+  :data:`~parmmg_tpu.failsafe.REFORM_EXIT_CODE`; the fleet supervisor
+  (`tools/fleet.py`) relaunches the survivors as a world of N−1, which
+  resumes from the committed epoch (re-cutting the shards through
+  `_elastic_recut` when the device pool changed);
+- a **capacity-restored signal** (`multihost.capacity_restored`:
+  programmatic request / callback probe / ``PMMGTPU_CAPACITY_FILE`` —
+  the exact mirror of the notice sources) on a world running below its
+  target size turns into the symmetric GROW: a grow record, the same
+  vote, the same commit, all ranks exit 90 and the fleet relaunches at
+  N+1 with a fresh member.
+
+Coordination is **store-backed**, not ack-based: the membership
+manifest (`elastic_manifest_e<k>.json`, one per reformation epoch) and
+the per-rank reform/ack records live in the same durable
+`CheckpointStore` as the checkpoints themselves, so a reformation
+survives the dying rank never acking — the survivors and the fleet
+read the store, they do not wait on the departing process. Records:
+
+- ``elastic_manifest_e<k>.json`` — ``{epoch, world, members,
+  target_world, reason, ts}``; published (commit-token put) by the
+  fleet before launching epoch k;
+- ``elastic_reform_e<k>_r<r>.json`` — rank r's reform request in epoch
+  k (``kind`` = ``shrink`` | ``grow``, ``ts``); per-rank names, so
+  concurrent requesters never conflict;
+- ``elastic_ack_e<k>_r<r>.json`` — rank r's exit ack (best-effort;
+  used only to measure downtime, never waited on).
+
+Every transition is observable: the deciding epoch emits a
+``world_reform`` event, and the FIRST boundary of the new epoch emits
+``world_shrink`` / ``world_grow`` with ``old``/``new`` world sizes and
+``downtime_s`` (wall time from the previous epoch's last ack — or its
+manifest — to the new epoch's coordinator coming up), rendered by
+``tools/obs_report.py --chaos`` as the world-size timeline.
+
+A world that cannot reform — a shrink below
+``PMMGTPU_ELASTIC_MIN_WORLD`` (default 1; raise it when a lone
+survivor's device pool could not hold ``min_shard_elts`` per shard) —
+refuses loudly with the typed :class:`UnreformableWorldError` instead
+of limping into an unservable layout.
+
+Env contract (set per epoch by `tools/fleet.py`)::
+
+  PMMGTPU_ELASTIC            arm the coordinator (requires a checkpoint
+                             store — without one there is nothing to
+                             shrink/grow FROM)
+  PMMGTPU_ELASTIC_EPOCH      this launch's reformation epoch (default:
+                             newest manifest in the store, else 0)
+  PMMGTPU_ELASTIC_TARGET     target world size grows aim for (default:
+                             the current world size)
+  PMMGTPU_ELASTIC_MIN_WORLD  smallest world a shrink may leave
+                             (default 1)
+  PMMGTPU_CAPACITY_FILE      capacity-restored marker file (see
+                             `multihost.capacity_restored`)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import List, Optional
+
+from ..failsafe import (
+    AdaptError,
+    PreemptionError,
+    WorldReformError,
+)
+from ..io.ckpt_store import CheckpointIOError, CheckpointStore
+from ..obs import metrics as obs_metrics, trace as obs_trace
+from . import multihost
+
+MANIFEST_FMT = "elastic_manifest_e{:05d}.json"
+REFORM_FMT = "elastic_reform_e{:05d}_r{}.json"
+ACK_FMT = "elastic_ack_e{:05d}_r{}.json"
+ELASTIC_FORMAT = 1
+
+
+class UnreformableWorldError(AdaptError):
+    """A reformation was agreed but the resulting world would be
+    unservable (shrink below the configured minimum — e.g. a lone
+    survivor whose device pool cannot hold ``min_shard_elts`` per
+    shard). Refuse loudly: the checkpoint stands, the operatorless
+    answer is "wait for capacity", not "limp on a broken layout"."""
+
+
+# ---------------------------------------------------------------------------
+# store-backed records
+# ---------------------------------------------------------------------------
+
+
+def publish_manifest(store: CheckpointStore, epoch: int, world: int,
+                     members: List[int], target_world: int,
+                     reason: str = "", ts: Optional[float] = None) -> dict:
+    """Publish epoch ``epoch``'s membership manifest (the fleet calls
+    this before every launch; exactly-one-writer via the store's
+    commit-token put)."""
+    doc = dict(
+        format=ELASTIC_FORMAT, epoch=int(epoch), world=int(world),
+        members=[int(m) for m in members],
+        target_world=int(target_world), reason=reason,
+        ts=float(ts if ts is not None else time.time()),
+    )
+    store.publish_json(MANIFEST_FMT.format(int(epoch)), doc)
+    return doc
+
+
+def read_manifest(store: CheckpointStore, epoch: int) -> Optional[dict]:
+    try:
+        return store.get_json(MANIFEST_FMT.format(int(epoch)))
+    except (FileNotFoundError, CheckpointIOError):
+        return None
+
+
+def latest_epoch(store: CheckpointStore) -> Optional[int]:
+    """Newest manifest epoch in the store, or None."""
+    epochs = []
+    for name in store.list():
+        if name.startswith("elastic_manifest_e") and name.endswith(".json"):
+            digits = name[len("elastic_manifest_e"):-len(".json")]
+            if digits.isdigit():
+                epochs.append(int(digits))
+    return max(epochs) if epochs else None
+
+
+def reform_records(store: CheckpointStore, epoch: int) -> List[dict]:
+    """Every rank's reform request for ``epoch`` (corrupt or torn
+    records are skipped — a broken request must not wedge the vote)."""
+    prefix = f"elastic_reform_e{int(epoch):05d}_"
+    recs = []
+    for name in store.list():
+        if not (name.startswith(prefix) and name.endswith(".json")):
+            continue
+        try:
+            recs.append(store.get_json(name))
+        except (FileNotFoundError, CheckpointIOError):
+            continue
+    return recs
+
+
+def write_exit_ack(store: CheckpointStore, epoch: int, rank: int,
+                   role: str, kind: str) -> None:
+    """Best-effort exit ack (downtime bookkeeping only — the protocol
+    never waits on it, so a failure here is swallowed: the manifest ts
+    is the fallback clock)."""
+    try:
+        store.put_json(
+            ACK_FMT.format(int(epoch), int(rank)),
+            dict(format=ELASTIC_FORMAT, epoch=int(epoch),
+                 rank=int(rank), role=role, kind=kind, ts=time.time()),
+        )
+    except Exception:
+        pass
+
+
+def last_ack_ts(store: CheckpointStore, epoch: int) -> Optional[float]:
+    prefix = f"elastic_ack_e{int(epoch):05d}_"
+    best = None
+    for name in store.list():
+        if not (name.startswith(prefix) and name.endswith(".json")):
+            continue
+        try:
+            ts = float(store.get_json(name).get("ts", 0.0))
+        except (FileNotFoundError, CheckpointIOError, TypeError,
+                ValueError):
+            continue
+        best = ts if best is None else max(best, ts)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# the coordinator the failsafe harness holds
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ReformDecision:
+    """One world-agreed reformation: every rank of the epoch holds an
+    identical copy of this after the vote."""
+
+    kind: str                 # "shrink" | "grow"
+    epoch: int
+    old_world: int
+    new_world: int
+    departing: tuple          # ranks leaving (shrink), () for grow
+    requested_ts: float       # wall clock of the earliest request
+
+    def mine(self, rank: int) -> bool:
+        return rank in self.departing
+
+
+class ElasticCoordinator:
+    """Per-run elastic state: polled by the failsafe harness at every
+    iteration boundary of the distributed driver. Holds no collective
+    state beyond the one-psum vote — everything durable lives in the
+    checkpoint store."""
+
+    def __init__(self, store: CheckpointStore, *, epoch: int, rank: int,
+                 world: int, target_world: int, min_world: int = 1):
+        self.store = store
+        self.epoch = int(epoch)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.target_world = max(int(target_world), 1)
+        self.min_world = max(int(min_world), 1)
+        self._published = False
+        self._decision: Optional[ReformDecision] = None
+
+    # -- transition observability ---------------------------------------
+    def note_transition(self) -> Optional[str]:
+        """Emit ``world_shrink`` / ``world_grow`` (old/new world size,
+        ``downtime_s``) when this epoch's world differs from the
+        previous epoch's — called once at coordinator construction, the
+        first code of the resumed world that can see both manifests.
+        Idempotent per (process, epoch)."""
+        if self.epoch <= 0 or self.epoch in _NOTED_EPOCHS:
+            return None
+        cur = read_manifest(self.store, self.epoch)
+        prev = read_manifest(self.store, self.epoch - 1)
+        if not cur or not prev:
+            return None
+        _NOTED_EPOCHS.add(self.epoch)
+        old, new = int(prev.get("world", 0)), int(cur.get("world", 0))
+        if not old or not new or old == new:
+            return None
+        end_ts = last_ack_ts(self.store, self.epoch - 1)
+        if end_ts is None:
+            end_ts = float(prev.get("ts", 0.0)) or None
+        downtime = (
+            max(0.0, time.time() - end_ts) if end_ts is not None else -1.0
+        )
+        name = "world_shrink" if new < old else "world_grow"
+        obs_trace.emit_event(
+            name, old=old, new=new, epoch=self.epoch,
+            downtime_s=round(downtime, 3),
+            reason=str(cur.get("reason", "")),
+        )
+        obs_metrics.registry().counter(f"elastic/{name}").inc()
+        return name
+
+    # -- the boundary poll ------------------------------------------------
+    def _publish_reform(self, kind: str, reason: str) -> None:
+        self.store.put_json(
+            REFORM_FMT.format(self.epoch, self.rank),
+            dict(format=ELASTIC_FORMAT, epoch=self.epoch,
+                 rank=self.rank, kind=kind, reason=reason,
+                 ts=time.time()),
+        )
+
+    def poll(self, it: int,
+             timeout: Optional[float] = None) -> Optional[ReformDecision]:
+        """One iteration-boundary reform vote. EVERY rank of the epoch
+        must call this at the SAME boundary (it contains a collective):
+        a rank with a standing preemption notice publishes its
+        departure, a rank seeing restored capacity below the target
+        world publishes a grow request, and one psum agreement makes
+        the decision identical everywhere — the ranks that saw nothing
+        locally learn the details from the store AFTER the vote, so
+        the steady-state cost is one tiny collective and zero store
+        reads. Returns None (keep adapting) or the agreed decision;
+        raises :class:`UnreformableWorldError` when the agreed shrink
+        would leave fewer than ``min_world`` ranks."""
+        if self._decision is not None:
+            return self._decision
+        flag = 0
+        if multihost.preemption_notice():
+            if not self._published:
+                self._publish_reform(
+                    "shrink",
+                    f"preemption notice on rank {self.rank} at it {it}",
+                )
+                self._published = True
+            flag = 1
+        elif self.world < self.target_world \
+                and multihost.capacity_restored():
+            if not self._published:
+                self._publish_reform(
+                    "grow",
+                    f"capacity restored, world {self.world} below "
+                    f"target {self.target_world} (it {it})",
+                )
+                self._published = True
+            flag = 1
+        agreed = multihost.agree_flags(
+            flag, tag=f"elastic-vote:{it}", timeout=timeout
+        )
+        if not agreed:
+            return None
+        recs = reform_records(self.store, self.epoch)
+        if not recs:
+            # a voter whose record publish failed: consistent on every
+            # rank (same store read), so everyone keeps adapting and
+            # the requester re-publishes at the next boundary
+            return None
+        departing = tuple(sorted({
+            int(r["rank"]) for r in recs if r.get("kind") == "shrink"
+        }))
+        requested_ts = min(float(r.get("ts", time.time())) for r in recs)
+        if departing:
+            kind = "shrink"
+            new_world = self.world - len(departing)
+        else:
+            # grow one rank per reformation: conservative — repeated
+            # reformations reach the target, and each one revalidates
+            # that capacity still stands
+            kind = "grow"
+            new_world = min(self.target_world, self.world + 1)
+        decision = ReformDecision(
+            kind=kind, epoch=self.epoch, old_world=self.world,
+            new_world=new_world, departing=departing,
+            requested_ts=requested_ts,
+        )
+        obs_trace.emit_event(
+            "world_reform", kind=kind, epoch=self.epoch, it=int(it),
+            old=self.world, new=new_world,
+            departing=list(departing),
+        )
+        obs_metrics.registry().counter("elastic/reforms").inc()
+        if kind == "shrink" and new_world < self.min_world:
+            raise UnreformableWorldError(
+                f"agreed shrink at epoch {self.epoch} would leave "
+                f"{new_world} rank(s), below the configured minimum "
+                f"world of {self.min_world} (ranks {list(departing)} "
+                "departing): the world cannot reform — the checkpoint "
+                "stands; restart when capacity returns"
+            )
+        self._decision = decision
+        return decision
+
+    # -- exit -------------------------------------------------------------
+    def ack_exit(self, decision: ReformDecision) -> None:
+        """Durable exit ack AFTER the reform checkpoint committed —
+        the downtime clock's start. Best-effort by design."""
+        role = "departing" if decision.mine(self.rank) else "survivor"
+        write_exit_ack(self.store, self.epoch, self.rank, role,
+                       decision.kind)
+
+    def error_for(self, decision: ReformDecision) -> BaseException:
+        """The typed error each rank leaves the driver with: the
+        departing rank exits through the preemption family (86 — it IS
+        being preempted), survivors through the reform code (90 — the
+        fleet relaunches them at the new world size)."""
+        if decision.mine(self.rank):
+            return PreemptionError(
+                f"elastic departure: preemption notice honored at "
+                f"epoch {decision.epoch} — checkpoint committed, world "
+                f"reforming {decision.old_world}→{decision.new_world} "
+                "without this rank"
+            )
+        return WorldReformError(
+            kind=decision.kind, epoch=decision.epoch,
+            old_world=decision.old_world, new_world=decision.new_world,
+        )
+
+
+_NOTED_EPOCHS: set = set()
+
+
+def coordinator_from_env(store) -> Optional[ElasticCoordinator]:
+    """The coordinator for this process per the PMMGTPU_ELASTIC_* env
+    contract (module docstring), or None when elasticity is not armed
+    or no store exists to coordinate through. Emits the world
+    transition event for a freshly reformed epoch."""
+    if not os.environ.get("PMMGTPU_ELASTIC") or store is None:
+        return None
+    import jax
+
+    rank = int(jax.process_index())
+    world = int(jax.process_count())
+    epoch_env = os.environ.get("PMMGTPU_ELASTIC_EPOCH")
+    if epoch_env is not None and epoch_env != "":
+        epoch = int(epoch_env)
+    else:
+        epoch = latest_epoch(store) or 0
+    target = int(os.environ.get("PMMGTPU_ELASTIC_TARGET", world) or world)
+    minw = int(os.environ.get("PMMGTPU_ELASTIC_MIN_WORLD", "1") or 1)
+    coord = ElasticCoordinator(
+        store, epoch=epoch, rank=rank, world=world,
+        target_world=max(target, world), min_world=minw,
+    )
+    coord.note_transition()
+    return coord

@@ -246,22 +246,41 @@ def is_multiprocess() -> bool:
 _PREEMPT_NOTICE = threading.Event()
 _PREEMPT_NOTICE_REASON: list = []
 _PREEMPT_CB = None
+# True when the latch came from an EXPLICIT request (platform glue, the
+# injected ``preempt-notice`` fault): those never un-happen on their
+# own. A latch from a polled source (callback probe, drain file) is
+# re-verified on every poll — a cancelled maintenance event (probe went
+# quiet, drain file removed) must stop forcing out-of-cadence
+# checkpoints instead of staying latched for the rest of the run.
+_PREEMPT_STICKY: list = []
 
 
 def request_preemption_notice(reason: str = "") -> None:
-    """Latch a pending preemption notice (idempotent)."""
+    """Latch a pending preemption notice (idempotent, sticky — only
+    :func:`clear_preemption_notice` resets an explicit request)."""
+    _latch_preempt_notice(reason, sticky=True)
+
+
+def _latch_preempt_notice(reason: str, sticky: bool) -> None:
     if not _PREEMPT_NOTICE.is_set():
         obs_trace.emit_event("preempt_notice", reason=reason)
     if reason:
         _PREEMPT_NOTICE_REASON.append(reason)
+    if sticky:
+        _PREEMPT_STICKY.append(reason or "requested")
     _PREEMPT_NOTICE.set()
 
 
-def clear_preemption_notice() -> None:
-    """Reset the latched notice (tests; a cancelled maintenance
-    event)."""
+def clear_preemption_notice(reason: str = "") -> None:
+    """Reset the latched notice (a cancelled maintenance event, tests).
+    A standing notice leaves a ``preempt_notice_cleared`` record in the
+    obs timeline — the post-mortem must show WHY a run armed, then
+    stopped, forcing per-iteration commits."""
+    if _PREEMPT_NOTICE.is_set():
+        obs_trace.emit_event("preempt_notice_cleared", reason=reason)
     _PREEMPT_NOTICE.clear()
     _PREEMPT_NOTICE_REASON.clear()
+    _PREEMPT_STICKY.clear()
 
 
 def set_preemption_callback(cb) -> None:
@@ -273,17 +292,101 @@ def set_preemption_callback(cb) -> None:
 
 
 def preemption_notice() -> bool:
-    """True while a preemption notice stands (latched flag, callback
-    probe, or the PMMGTPU_PREEMPT_FILE drain file)."""
-    if _PREEMPT_NOTICE.is_set():
-        return True
+    """True while a preemption notice stands: an explicit request, a
+    truthy callback probe, or the PMMGTPU_PREEMPT_FILE drain file.
+    Polled-source latches are re-verified here — when the probe goes
+    quiet AND the drain file is gone AND no explicit request stands,
+    the latch auto-clears (with a ``preempt_notice_cleared`` event) so
+    a cancelled maintenance event stops forcing out-of-cadence
+    checkpoints."""
+    live = False
     if _PREEMPT_CB is not None and _PREEMPT_CB():
-        request_preemption_notice("preemption callback fired")
-        return True
+        _latch_preempt_notice("preemption callback fired", sticky=False)
+        live = True
     path = os.environ.get("PMMGTPU_PREEMPT_FILE")
-    if path and os.path.exists(path):
-        request_preemption_notice(f"drain file {path} present")
+    if not live and path and os.path.exists(path):
+        _latch_preempt_notice(f"drain file {path} present", sticky=False)
+        live = True
+    if live:
         return True
+    if _PREEMPT_NOTICE.is_set():
+        if _PREEMPT_STICKY:
+            return True
+        clear_preemption_notice("polled source no longer reports the "
+                                "maintenance event")
+    return False
+
+
+# ---------------------------------------------------------------------------
+# capacity-restored signals (the grow half of elastic autoscaling)
+# ---------------------------------------------------------------------------
+# Symmetric to the preemption-notice sources above: a platform that can
+# take capacity away can also give it back (a spot pool refilling, a
+# maintenance window ending). Three sources, any of which arms the
+# signal; `parallel.elastic` polls it between iterations and — when the
+# current world runs below its target size — turns it into a
+# world-grow reformation, the same checkpoint-backed transition a
+# notice-driven shrink takes in the other direction.
+
+_CAPACITY_SIGNAL = threading.Event()
+_CAPACITY_REASON: list = []
+_CAPACITY_CB = None
+_CAPACITY_STICKY: list = []
+
+
+def request_capacity_restored(reason: str = "") -> None:
+    """Latch a capacity-restored signal (idempotent, sticky)."""
+    _latch_capacity(reason, sticky=True)
+
+
+def _latch_capacity(reason: str, sticky: bool) -> None:
+    if not _CAPACITY_SIGNAL.is_set():
+        obs_trace.emit_event("capacity_restored", reason=reason)
+    if reason:
+        _CAPACITY_REASON.append(reason)
+    if sticky:
+        _CAPACITY_STICKY.append(reason or "requested")
+    _CAPACITY_SIGNAL.set()
+
+
+def clear_capacity_signal(reason: str = "") -> None:
+    """Reset the latched capacity signal (tests; capacity withdrawn
+    again before the grow could happen)."""
+    if _CAPACITY_SIGNAL.is_set():
+        obs_trace.emit_event("capacity_signal_cleared", reason=reason)
+    _CAPACITY_SIGNAL.clear()
+    _CAPACITY_REASON.clear()
+    _CAPACITY_STICKY.clear()
+
+
+def set_capacity_callback(cb) -> None:
+    """Install (or with None, remove) the lazily-polled capacity probe
+    (e.g. a pool-inventory query). Cheap and non-blocking, like the
+    preemption probe."""
+    global _CAPACITY_CB
+    _CAPACITY_CB = cb
+
+
+def capacity_restored() -> bool:
+    """True while a capacity-restored signal stands: explicit request,
+    truthy callback probe, or the PMMGTPU_CAPACITY_FILE marker file.
+    Polled-source latches auto-clear when every source goes quiet,
+    mirroring :func:`preemption_notice`."""
+    live = False
+    if _CAPACITY_CB is not None and _CAPACITY_CB():
+        _latch_capacity("capacity callback fired", sticky=False)
+        live = True
+    path = os.environ.get("PMMGTPU_CAPACITY_FILE")
+    if not live and path and os.path.exists(path):
+        _latch_capacity(f"capacity file {path} present", sticky=False)
+        live = True
+    if live:
+        return True
+    if _CAPACITY_SIGNAL.is_set():
+        if _CAPACITY_STICKY:
+            return True
+        clear_capacity_signal("polled source no longer reports "
+                              "restored capacity")
     return False
 
 
@@ -433,6 +536,96 @@ def barrier(tag: str = "parmmg-barrier",
             f"collective '{tag}' failed "
             f"(rank {jax.process_index()}): {e}"
         ) from e
+
+
+def agree_flags(value: int, tag: str = "agree",
+                timeout: float | None = None) -> int:
+    """World-agreed bitwise-OR of one small non-negative int per
+    process — the ``MPI_Allreduce(ier)`` role for control decisions
+    that must be taken by EVERY process at the SAME boundary (the
+    elastic reform vote: "someone is departing / a grow was
+    requested"). Single-process this is the identity.
+
+    Implemented as one psum over the global device mesh (each device
+    carries its owner process's value, so the sum is
+    ``sum_r value_r * local_device_count``; uniform local device
+    counts make the per-process sum recoverable, and because callers
+    pass disjoint bit flags the division yields their bitwise OR).
+    Runs under the same peer-loss refusal + watchdog conversion as
+    :func:`barrier` — a dead peer turns the vote into a typed
+    `failsafe.PeerLostError` instead of a hang."""
+    val = int(value)
+    if not is_multiprocess():
+        return val
+    if val < 0:
+        raise ValueError(f"agree_flags wants a non-negative int, got {val}")
+    obs_metrics.registry().counter("comm/collectives").inc()
+    from ..failsafe import PeerLostError
+
+    if _PEER_LOSS.is_set():
+        raise PeerLostError(
+            f"collective '{tag}' refused: a peer is already reported "
+            "lost "
+            f"({_PEER_LOSS_STATUS[-1] if _PEER_LOSS_STATUS else ''})"
+        )
+    fn, sh, ndev = _agree_fn()
+    nloc = jax.local_device_count()
+    if ndev % jax.process_count() or nloc * jax.process_count() != ndev:
+        raise RuntimeError(
+            f"agree_flags needs uniform local device counts "
+            f"({ndev} devices over {jax.process_count()} processes)"
+        )
+
+    def _cb(idx):
+        sl = idx[0]
+        lo = 0 if sl.start is None else sl.start
+        hi = ndev if sl.stop is None else sl.stop
+        return np.full((hi - lo,), val, np.int32)
+
+    def _vote():
+        x = jax.make_array_from_callback((ndev,), sh, _cb)
+        return int(jax.device_get(fn(x)))
+
+    try:
+        total = run_with_watchdog(_vote, tag=tag, timeout=timeout)
+    except PeerLostError:
+        raise
+    except Exception as e:
+        raise PeerLostError(
+            f"collective '{tag}' failed "
+            f"(rank {jax.process_index()}): {e}"
+        ) from e
+    return total // nloc
+
+
+_AGREE = None
+
+
+def _agree_fn():
+    """Memoized psum program + sharding for :func:`agree_flags`
+    (rebuilding jit(shard_map) per vote would retrace every boundary,
+    parmmg-lint PML004)."""
+    global _AGREE
+    if _AGREE is not None:
+        return _AGREE
+    import jax.numpy as jnp
+    from jax.sharding import (
+        Mesh as DeviceMesh, NamedSharding, PartitionSpec as P,
+    )
+
+    devs = jax.devices()
+    dmesh = DeviceMesh(np.array(devs), ("procs",))
+    sh = NamedSharding(dmesh, P("procs"))
+
+    def body(blk):
+        return jax.lax.psum(jnp.sum(blk), "procs")
+
+    # parmmg-lint: disable=PML004 -- built once, memoized in _AGREE
+    fn = jax.jit(jax.shard_map(
+        body, mesh=dmesh, in_specs=(P("procs"),), out_specs=P()
+    ))
+    _AGREE = (fn, sh, len(devs))
+    return _AGREE
 
 
 def put_sharded_global(tree, dmesh):

@@ -154,11 +154,101 @@ def failsafe_main():
     )
 
 
+def elastic_main():
+    """Elastic fleet workload (tools/fleet.py launches this as one rank
+    of an autoscaling world — the chaos harness's elastic rung).
+
+    Differences from `failsafe_main`: the shard count follows the
+    CURRENT device pool (`nparts = jax.device_count()`, so a reformed
+    world re-cuts the checkpoint through `_elastic_recut`), the elastic
+    coordinator is armed via the PMMGTPU_ELASTIC_* env the fleet sets,
+    and two more typed exits join the family: REFORM_EXIT_CODE (90, a
+    survivor of a world-agreed reformation asking to be relaunched)
+    and the UnreformableWorldError refusal (88 — the world cannot
+    shrink any further). A completed run prints ADAPT_DIGEST with the
+    merged mesh's quality so the harness can gate the elastic finish
+    against a fixed-world reference."""
+    import hashlib
+    import os
+
+    from parmmg_tpu.parallel import multihost
+
+    multi = multihost.init_from_env()
+
+    import jax
+    import numpy as np
+
+    from parmmg_tpu import failsafe
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_stacked_input, merge_adapted,
+    )
+    from parmmg_tpu.ops import quality
+    from parmmg_tpu.parallel import elastic
+    from parmmg_tpu.parallel.distribute import split_mesh
+    from parmmg_tpu.parallel.partition import sfc_partition
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    ckdir = os.environ.get("PMMGTPU_CKPT_DIR") or None
+    watchdog = float(os.environ.get("PMMGTPU_WATCHDOG", "60"))
+    niter = int(os.environ.get("PMMGTPU_ELASTIC_NITER", "4"))
+    rank = jax.process_index()
+
+    # identical replicated host prep on every process of THIS epoch;
+    # the shard count follows the epoch's device pool, so a reformed
+    # world resumes its checkpoint through the elastic re-cut
+    ndev = jax.device_count()
+    mesh = unit_cube_mesh(3)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, ndev)))
+    st, comm = split_mesh(mesh, part, ndev)
+    opts = DistOptions(
+        hsiz=0.32, niter=niter, max_sweeps=3, nparts=ndev,
+        min_shard_elts=8, hgrad=None, polish_sweeps=0,
+        checkpoint_dir=ckdir,
+        watchdog_timeout=watchdog if multi else None,
+    )
+    try:
+        out, comm2, info = adapt_stacked_input(st, comm, opts)
+    except failsafe.WorldReformError as e:
+        print(f"WORLD_REFORM rank={rank}: {e}", flush=True)
+        os._exit(failsafe.REFORM_EXIT_CODE)
+    except failsafe.PreemptionError as e:
+        # elastic departure / SIGTERM: checkpoint committed first
+        print(f"PREEMPTED rank={rank}: {e}", flush=True)
+        os._exit(failsafe.KILL_EXIT_CODE)
+    except failsafe.PeerLostError as e:
+        print(f"PEER_LOST rank={rank}: {e}", flush=True)
+        os._exit(failsafe.PEER_LOST_EXIT_CODE)
+    except elastic.UnreformableWorldError as e:
+        print(f"UNREFORMABLE rank={rank}: {e}", flush=True)
+        os._exit(failsafe.MISMATCH_EXIT_CODE)
+    except failsafe.CheckpointMismatchError as e:
+        print(f"CKPT_MISMATCH rank={rank}: {e}", flush=True)
+        os._exit(failsafe.MISMATCH_EXIT_CODE)
+    except failsafe.CheckpointIOError as e:
+        print(f"CKPT_IO rank={rank}: {e}", flush=True)
+        os._exit(failsafe.CKPT_IO_EXIT_CODE)
+    merged = merge_adapted(out, comm2)
+    d = jax.device_get(merged)
+    h = hashlib.sha256()
+    for name in ("vert", "vmask", "tet", "tmask", "tria", "trmask",
+                 "tref", "trref", "vtag", "trtag"):
+        h.update(np.ascontiguousarray(np.asarray(getattr(d, name))).tobytes())
+    qh = quality.quality_histogram(merged)
+    print(
+        f"ADAPT_DIGEST {h.hexdigest()} ne={int(qh.ne)} "
+        f"qmin={float(qh.qmin):.9f} qavg={float(qh.qavg):.9f} "
+        f"status={int(info['status'])}",
+        flush=True,
+    )
+
+
 def main():
     if "--adapt" in sys.argv:
         return adapt_main()
     if "--failsafe" in sys.argv:
         return failsafe_main()
+    if "--elastic" in sys.argv:
+        return elastic_main()
     # the package __init__ auto-initializes the multi-controller
     # runtime from the PMMGTPU_* env (before any backend touch) — the
     # same path `python -m parmmg_tpu` takes under a process launcher

@@ -22,6 +22,12 @@ test_m10's slow subprocess matrix) exercise end to end:
   time, and the preemption path drains synchronously;
 - the proactive preemption notice (file / callback / injected
   ``preempt-notice`` fault) forcing an out-of-cadence checkpoint.
+
+The world matrix here is load-level and shrink-biased (2→{1,3,4});
+the GROW direction — `_resume_stacked` re-cut, grow-under-way through
+the driver, and the notice→shrink / capacity→grow supervisor protocol
+— lives in tests/test_m20_elastic_world.py, with the process-level
+fleet story in tools/chaos_smoke.py --elastic.
 """
 
 import os

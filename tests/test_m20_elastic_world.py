@@ -1,0 +1,491 @@
+"""M20: notice-driven elastic autoscaling — the in-process half.
+
+Unit coverage for what `tools/chaos_smoke.py --elastic` drives end to
+end through `tools/fleet.py` (a real 2-rank world absorbing a
+preemption notice, shrinking to 1, growing back on the capacity
+signal):
+
+- the store-backed membership protocol (`parallel.elastic`): manifest
+  publish/read, per-rank reform requests, exit acks, epoch discovery;
+- the coordinator's boundary poll: notice→shrink and capacity→grow
+  decisions, the typed per-role exit errors (departure = preemption
+  family, survivor = `WorldReformError`→exit 90), the
+  `UnreformableWorldError` refusal below the minimum world;
+- world-transition observability: `world_shrink`/`world_grow` events
+  with downtime seconds, and the `obs_report --chaos` world-size
+  timeline section;
+- the capacity-restored signal trio (file / callback / programmatic),
+  symmetric to the preemption-notice sources, including the
+  auto-unlatch of cancelled polled sources (the PR-11 notice bugfix:
+  a cancelled maintenance event must stop forcing per-iteration
+  commits and leave a ``preempt_notice_cleared`` record);
+- driver-level elastic GROW: `_resume_stacked` re-cuts onto more
+  shards with the frontier reset to all-active and the cached comm
+  capacity dropped, and (slow) a full grow-under-way run through
+  `adapt_distributed` — reform raised mid-run, resumed at the larger
+  layout, quality within the m9-class gate.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from parmmg_tpu import failsafe
+from parmmg_tpu.core.tags import ReturnStatus
+from parmmg_tpu.io import ckpt_store
+from parmmg_tpu.models.distributed import (
+    DistOptions,
+    _resume_stacked,
+    adapt_distributed,
+    merge_adapted,
+)
+from parmmg_tpu.obs import report as obs_report, trace as obs_trace
+from parmmg_tpu.parallel import elastic, multihost
+from parmmg_tpu.parallel.distribute import split_mesh
+from parmmg_tpu.parallel.partition import sfc_partition
+from parmmg_tpu.utils.gen import unit_cube_mesh
+
+C_OPTS = dict(hsiz=0.45, niter=2, max_sweeps=2, hgrad=None,
+              polish_sweeps=0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_signals():
+    yield
+    multihost.clear_preemption_notice()
+    multihost.set_preemption_callback(None)
+    multihost.clear_capacity_signal()
+    multihost.set_capacity_callback(None)
+    elastic._NOTED_EPOCHS.clear()
+
+
+def _mem_store(name):
+    ckpt_store.memory_bucket(name).clear()
+    return ckpt_store.make_store(f"mem://{name}", None)
+
+
+def _events(dirpath, name=None):
+    recs = []
+    for fn in os.listdir(dirpath):
+        if not fn.startswith("events_rank"):
+            continue
+        with open(os.path.join(dirpath, fn)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec.get("type") == "event" and (
+                    name is None or rec.get("name") == name
+                ):
+                    recs.append(rec)
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# store-backed membership protocol
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_reform_ack_roundtrip():
+    store = _mem_store("m20-proto")
+    assert elastic.latest_epoch(store) is None
+    assert elastic.read_manifest(store, 0) is None
+    doc = elastic.publish_manifest(store, 0, world=2, members=[0, 1],
+                                   target_world=2, reason="launch")
+    assert elastic.read_manifest(store, 0) == doc
+    elastic.publish_manifest(store, 1, world=1, members=[0],
+                             target_world=2, reason="shrink")
+    assert elastic.latest_epoch(store) == 1
+    # per-rank reform records never conflict; corrupt ones are skipped
+    assert elastic.reform_records(store, 0) == []
+    store.put_json(elastic.REFORM_FMT.format(0, 1),
+                   dict(epoch=0, rank=1, kind="shrink", ts=10.0))
+    store.put(elastic.REFORM_FMT.format(0, 0), b"{not json")
+    recs = elastic.reform_records(store, 0)
+    assert len(recs) == 1 and recs[0]["rank"] == 1
+    # acks: best-effort, newest ts wins, absent -> None
+    assert elastic.last_ack_ts(store, 0) is None
+    elastic.write_exit_ack(store, 0, 1, "departing", "shrink")
+    elastic.write_exit_ack(store, 0, 0, "survivor", "shrink")
+    ts = elastic.last_ack_ts(store, 0)
+    assert ts is not None and ts > 0
+
+
+def test_fleet_manifest_matches_worker_protocol(tmp_path):
+    """The jax-free supervisor half (tools/fleet.py) writes manifests
+    the worker-side coordinator reads — one format, two writers."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "parmmg_fleet", os.path.join(root, "tools", "fleet.py")
+    )
+    fleet = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet)
+
+    ck = str(tmp_path / "ck")
+    fleet.publish_manifest(ck, 3, members=[0, 2], target=2,
+                           reason="grow: capacity restored")
+    store = ckpt_store.make_store(ck, None)
+    doc = elastic.read_manifest(store, 3)
+    assert doc is not None
+    assert doc["world"] == 2 and doc["members"] == [0, 2]
+    assert doc["target_world"] == 2 and doc["epoch"] == 3
+    assert elastic.latest_epoch(store) == 3
+    # and the fleet can read back the worker's reform records
+    store.put_json(elastic.REFORM_FMT.format(3, 0),
+                   dict(epoch=3, rank=0, kind="grow", ts=1.0))
+    assert fleet.reform_kinds(ck, 3) == {"grow"}
+
+
+# ---------------------------------------------------------------------------
+# coordinator decisions
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_disarmed_without_env(monkeypatch):
+    monkeypatch.delenv("PMMGTPU_ELASTIC", raising=False)
+    assert elastic.coordinator_from_env(_mem_store("m20-off")) is None
+    assert elastic.coordinator_from_env(None) is None
+
+
+def test_poll_without_signals_is_noop():
+    store = _mem_store("m20-noop")
+    c = elastic.ElasticCoordinator(store, epoch=0, rank=0, world=1,
+                                   target_world=1)
+    assert c.poll(0) is None
+    assert elastic.reform_records(store, 0) == []
+
+
+def test_notice_driven_shrink_decision():
+    store = _mem_store("m20-shrink")
+    c1 = elastic.ElasticCoordinator(store, epoch=0, rank=1, world=2,
+                                    target_world=2)
+    multihost.request_preemption_notice("maintenance event")
+    d = c1.poll(1)
+    assert d is not None and d.kind == "shrink"
+    assert d.departing == (1,) and d.new_world == 1 and d.old_world == 2
+    # the departure record is durable BEFORE the vote returned
+    recs = elastic.reform_records(store, 0)
+    assert [r["kind"] for r in recs] == ["shrink"]
+    # per-role exits: the noticed rank leaves via the preemption
+    # family, a survivor via the typed reform error (exit 90)
+    assert isinstance(c1.error_for(d), failsafe.PreemptionError)
+    c0 = elastic.ElasticCoordinator(store, epoch=0, rank=0, world=2,
+                                    target_world=2)
+    err = c0.error_for(d)
+    assert isinstance(err, failsafe.WorldReformError)
+    assert err.kind == "shrink" and err.new_world == 1
+    # sealed exits leave acks; the decision is cached (poll is
+    # idempotent once agreed)
+    c1.ack_exit(d)
+    c0.ack_exit(d)
+    assert elastic.last_ack_ts(store, 0) is not None
+    assert c1.poll(2) is d
+
+
+def test_capacity_driven_grow_decision():
+    store = _mem_store("m20-grow")
+    c = elastic.ElasticCoordinator(store, epoch=2, rank=0, world=1,
+                                   target_world=2)
+    assert c.poll(0) is None          # no capacity signal yet
+    multihost.request_capacity_restored("pool refilled")
+    d = c.poll(1)
+    assert d is not None and d.kind == "grow"
+    assert (d.old_world, d.new_world) == (1, 2) and d.departing == ()
+    err = c.error_for(d)
+    assert isinstance(err, failsafe.WorldReformError)
+    assert err.kind == "grow"
+    # a world already AT target never grows on the signal
+    store2 = _mem_store("m20-grow-at-target")
+    c2 = elastic.ElasticCoordinator(store2, epoch=0, rank=0, world=1,
+                                    target_world=1)
+    assert c2.poll(0) is None
+    assert elastic.reform_records(store2, 0) == []
+
+
+def test_unreformable_world_refusal():
+    store = _mem_store("m20-refuse")
+    c = elastic.ElasticCoordinator(store, epoch=0, rank=0, world=1,
+                                   target_world=1, min_world=1)
+    multihost.request_preemption_notice("last rank preempted")
+    with pytest.raises(elastic.UnreformableWorldError, match="minimum"):
+        c.poll(0)
+
+
+def test_agree_flags_single_process_identity():
+    assert multihost.agree_flags(0) == 0
+    assert multihost.agree_flags(3) == 3
+    assert multihost.agree_flags(True) == 1
+
+
+# ---------------------------------------------------------------------------
+# capacity-signal sources + notice auto-unlatch (the PR-11 bugfix)
+# ---------------------------------------------------------------------------
+
+
+def test_capacity_signal_sources(tmp_path, monkeypatch):
+    monkeypatch.delenv("PMMGTPU_CAPACITY_FILE", raising=False)
+    assert not multihost.capacity_restored()
+    # 1. marker file: present arms, removed auto-clears
+    cap = tmp_path / "capacity"
+    monkeypatch.setenv("PMMGTPU_CAPACITY_FILE", str(cap))
+    assert not multihost.capacity_restored()
+    cap.write_text("")
+    assert multihost.capacity_restored()
+    cap.unlink()
+    assert not multihost.capacity_restored()
+    # 2. callback probe, same auto-unlatch semantics
+    state = {"up": True}
+    multihost.set_capacity_callback(lambda: state["up"])
+    assert multihost.capacity_restored()
+    state["up"] = False
+    assert not multihost.capacity_restored()
+    # 3. explicit request is sticky until cleared
+    multihost.request_capacity_restored("programmatic")
+    state["up"] = False
+    assert multihost.capacity_restored()
+    multihost.clear_capacity_signal()
+    assert not multihost.capacity_restored()
+
+
+def test_cancelled_notice_stops_forcing_and_leaves_trace(tmp_path,
+                                                         monkeypatch):
+    """The satellite bugfix: a notice latched from a POLLED source
+    (drain file / callback) auto-clears when the source cancels,
+    emitting ``preempt_notice_cleared`` — so a cancelled maintenance
+    event stops forcing per-iteration commits. Explicit requests stay
+    sticky."""
+    tr = obs_trace.Tracer(str(tmp_path / "obs"), costs=False, rank=0)
+    prev = obs_trace.install(tr)
+    try:
+        drain = tmp_path / "drain"
+        monkeypatch.setenv("PMMGTPU_PREEMPT_FILE", str(drain))
+        drain.write_text("")
+        assert multihost.preemption_notice()
+        drain.unlink()
+        # cancelled: the latch drops on the next poll, with a trace
+        assert not multihost.preemption_notice()
+        assert not multihost.preemption_notice()   # stays clear
+        names = [e["name"] for e in _events(str(tmp_path / "obs"))]
+        assert "preempt_notice" in names
+        assert "preempt_notice_cleared" in names
+        # explicit requests survive source silence until cleared
+        multihost.request_preemption_notice("platform glue")
+        assert multihost.preemption_notice()
+        multihost.clear_preemption_notice()
+        assert not multihost.preemption_notice()
+    finally:
+        obs_trace.install(prev)
+        tr.flush()
+
+
+def test_cancelled_notice_driver_level(tmp_path):
+    """Driver-level regression: a notice that cancels after one
+    boundary forces exactly ONE out-of-cadence commit — before the
+    fix the latch survived cancellation and every later iteration
+    committed too."""
+    fired = {"n": 0}
+
+    def probe():
+        # truthy exactly once: the maintenance event is cancelled
+        # before the next iteration boundary polls again
+        fired["n"] += 1
+        return fired["n"] == 1
+
+    multihost.set_preemption_callback(probe)
+    try:
+        ck = tmp_path / "ck"
+        from parmmg_tpu.models.adapt import AdaptOptions, adapt
+
+        out, info = adapt(
+            unit_cube_mesh(2),
+            AdaptOptions(checkpoint_every=50, **C_OPTS),
+            checkpoint_dir=str(ck),
+        )
+        assert info["status"] == ReturnStatus.SUCCESS
+        names = sorted(os.listdir(ck))
+        assert "ckpt_00000.json" in names, names
+        assert "ckpt_00001.json" not in names, (
+            "cancelled notice kept forcing commits", names,
+        )
+    finally:
+        multihost.set_preemption_callback(None)
+        multihost.clear_preemption_notice()
+
+
+# ---------------------------------------------------------------------------
+# world-transition observability
+# ---------------------------------------------------------------------------
+
+
+def test_transition_events_with_downtime(tmp_path):
+    store = _mem_store("m20-trans")
+    elastic.publish_manifest(store, 0, world=2, members=[0, 1],
+                             target_world=2, reason="launch")
+    elastic.write_exit_ack(store, 0, 0, "survivor", "shrink")
+    elastic.write_exit_ack(store, 0, 1, "departing", "shrink")
+    elastic.publish_manifest(store, 1, world=1, members=[0],
+                             target_world=2,
+                             reason="shrink: members [1] departed")
+    elastic.publish_manifest(store, 2, world=2, members=[0, 2],
+                             target_world=2,
+                             reason="grow: capacity restored")
+    tr = obs_trace.Tracer(str(tmp_path / "obs"), costs=False, rank=0)
+    prev = obs_trace.install(tr)
+    try:
+        c1 = elastic.ElasticCoordinator(store, epoch=1, rank=0,
+                                        world=1, target_world=2)
+        assert c1.note_transition() == "world_shrink"
+        assert c1.note_transition() is None     # idempotent per epoch
+        c2 = elastic.ElasticCoordinator(store, epoch=2, rank=0,
+                                        world=2, target_world=2)
+        assert c2.note_transition() == "world_grow"
+        # epoch 0 has no predecessor: no event
+        elastic._NOTED_EPOCHS.clear()
+        c0 = elastic.ElasticCoordinator(store, epoch=0, rank=0,
+                                        world=2, target_world=2)
+        assert c0.note_transition() is None
+    finally:
+        obs_trace.install(prev)
+        tr.flush()
+    shr = _events(str(tmp_path / "obs"), "world_shrink")
+    gro = _events(str(tmp_path / "obs"), "world_grow")
+    assert len(shr) == 1 and len(gro) == 1
+    assert shr[0]["args"]["old"] == 2 and shr[0]["args"]["new"] == 1
+    assert gro[0]["args"]["old"] == 1 and gro[0]["args"]["new"] == 2
+    # downtime measured from the previous epoch's last ack (shrink)
+    # or its manifest ts (grow: the world-1 epoch left no acks here)
+    assert float(shr[0]["args"]["downtime_s"]) >= 0.0
+    assert float(gro[0]["args"]["downtime_s"]) >= 0.0
+
+
+def test_chaos_report_world_timeline(tmp_path):
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    lines = [
+        dict(type="event", name="preempt_notice", ts_us=10, rank=0,
+             args=dict(reason="drain")),
+        dict(type="event", name="world_reform", ts_us=20, rank=0,
+             args=dict(kind="shrink", epoch=0, old=2, new=1,
+                       departing=[1])),
+        dict(type="event", name="checkpoint_commit", ts_us=30, rank=0,
+             args=dict(it=1, mode="sync")),
+        dict(type="event", name="world_shrink", ts_us=5, rank=0,
+             args=dict(old=2, new=1, epoch=1, downtime_s=3.25,
+                       reason="shrink: members [1] departed")),
+        dict(type="event", name="world_grow", ts_us=9, rank=0,
+             args=dict(old=1, new=2, epoch=2, downtime_s=2.5,
+                       reason="grow: capacity restored")),
+    ]
+    with open(obs / "events_rank0.jsonl", "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    s = obs_report.chaos_summary(str(obs))
+    tl = s["world_timeline"]
+    assert [t["name"] for t in tl] == ["world_shrink", "world_grow"]
+    assert tl[0]["downtime_s"] == 3.25 and tl[0]["epoch"] == 1
+    # the chain tags world events with their own role
+    roles = {c["name"]: c["role"] for c in s["ranks"][0]["chain"]}
+    assert roles["world_reform"] == "world"
+    assert roles["world_shrink"] == "world"
+    text = obs_report.render_chaos(str(obs))
+    assert "world-size timeline" in text
+    assert "world_shrink  world 2 -> 1, downtime 3.250s" in text
+    assert "world_grow  world 1 -> 2, downtime 2.500s" in text
+
+
+# ---------------------------------------------------------------------------
+# driver-level elastic grow
+# ---------------------------------------------------------------------------
+
+
+def test_resume_stacked_grow_resets_frontier_and_comm(tmp_path):
+    """`_resume_stacked` on a shard-count change (the grow direction):
+    the state is re-cut, the checkpointed frontier carry is dropped
+    (the resumed sweeps start from the exact all-active frontier) and
+    the cached comm capacity is discarded so `rebuild_comm` re-derives
+    `icap` for the new layout. An unchanged count keeps all three."""
+    mesh = unit_cube_mesh(2)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 2)))
+    st, _comm = split_mesh(mesh, part, 2)
+    ntet_live = int(np.asarray(jax.device_get(st.tmask)).sum())
+    fr = np.zeros((2, st.vert.shape[1]), bool)
+    fr[:, :3] = True
+
+    def resume_state():
+        # fresh snapshot per call: the re-cut path donates its input
+        # buffers (exactly like a real resume, which owns its arrays)
+        return failsafe.ResumeState(
+            it=0, meshes={"mesh": failsafe.snapshot(st)}, history=[],
+            emult=1.6,
+            meta=dict(icap=16, aux_arrays=dict(frontier=fr)),
+            source_world=2,
+        )
+
+    # unchanged layout: everything carried through
+    same, icap, fr0 = _resume_stacked(
+        resume_state(), DistOptions(nparts=2, **C_OPTS)
+    )
+    assert same.vert.shape[0] == 2 and icap == 16
+    np.testing.assert_array_equal(np.asarray(fr0), fr)
+    # grow 2 -> 4: re-cut, frontier all-active (None), icap re-derived
+    grown, icap4, fr4 = _resume_stacked(
+        resume_state(), DistOptions(nparts=4, min_shard_elts=8,
+                                    **C_OPTS)
+    )
+    assert grown.vert.shape[0] == 4
+    assert icap4 is None and fr4 is None
+    # the re-cut conserves the mesh: same live totals, owners rebuilt
+    assert int(np.asarray(jax.device_get(grown.tmask)).sum()) \
+        == ntet_live
+
+
+@pytest.mark.slow
+def test_driver_grow_under_way(tmp_path, monkeypatch):
+    """Grow UNDER WAY through the public driver: a world-1 run with
+    elasticity armed and restored capacity below its target commits
+    its epoch and raises the typed WorldReformError mid-run; the
+    relaunched larger layout resumes through the elastic re-cut and
+    finishes with comm/owner rebuilt, `icap` re-derived and the
+    quality histogram inside the m9-class gate."""
+    from parmmg_tpu.ops import quality
+    from parmmg_tpu.utils.conformity import check_mesh
+
+    spec = "mem://m20-driver-grow"
+    store = _mem_store("m20-driver-grow")
+    elastic.publish_manifest(store, 0, world=1, members=[0],
+                             target_world=2, reason="launch")
+    monkeypatch.setenv("PMMGTPU_ELASTIC", "1")
+    monkeypatch.setenv("PMMGTPU_ELASTIC_EPOCH", "0")
+    monkeypatch.setenv("PMMGTPU_ELASTIC_TARGET", "2")
+    multihost.request_capacity_restored("test grow")
+    opts2 = DistOptions(nparts=2, min_shard_elts=8,
+                        checkpoint_store=spec, **C_OPTS)
+    with pytest.raises(failsafe.WorldReformError) as ei:
+        adapt_distributed(unit_cube_mesh(2), opts2)
+    assert ei.value.kind == "grow"
+    names = sorted(store.list())
+    assert any(n.startswith("ckpt_") and n.endswith(".json")
+               for n in names), names
+    assert any(n.startswith("elastic_ack_e00000") for n in names)
+
+    # "relaunch" at the grown layout: shard count follows the larger
+    # device pool, the checkpoint re-cuts through _elastic_recut
+    monkeypatch.delenv("PMMGTPU_ELASTIC")
+    multihost.clear_capacity_signal()
+    opts4 = DistOptions(nparts=4, min_shard_elts=8,
+                        checkpoint_store=spec, **C_OPTS)
+    st, comm, info = adapt_distributed(unit_cube_mesh(2), opts4)
+    assert info["status"] == ReturnStatus.SUCCESS
+    assert st.vert.shape[0] == 4
+    assert comm is not None and comm.icap > 0
+    assert comm.owner.shape[0] == 4          # owner table per shard
+    merged = merge_adapted(st, comm)
+    assert check_mesh(merged, check_boundary=False).ok
+    h = quality.quality_histogram(merged)
+    assert float(h.qmin) > 0.2, float(h.qmin)   # the m9 small gate

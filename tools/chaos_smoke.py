@@ -763,6 +763,170 @@ def main_world(args) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# elastic autoscaling rung (--elastic)
+# ---------------------------------------------------------------------------
+
+
+def _parse_digest(text: str):
+    """(ne, qmin, status) of the last ADAPT_DIGEST line, or None."""
+    for ln in reversed(text.splitlines()):
+        if not ln.startswith("ADAPT_DIGEST"):
+            continue
+        fields = dict(
+            tok.split("=", 1) for tok in ln.split()[2:] if "=" in tok
+        )
+        return (int(fields["ne"]), float(fields["qmin"]),
+                int(fields["status"]))
+    return None
+
+
+def _world_events(obs_dir: str):
+    """{event name: [args]} of the world_shrink/world_grow records in
+    a trace dir's JSONL timelines (stdlib parse — jax-free parent)."""
+    import glob
+    import json as _json
+
+    out = {"world_shrink": [], "world_grow": []}
+    for p in glob.glob(os.path.join(obs_dir, "events_rank*.jsonl")):
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = _json.loads(line)
+                except _json.JSONDecodeError:
+                    continue
+                if rec.get("type") == "event" \
+                        and rec.get("name") in out:
+                    out[rec["name"]].append(rec.get("args", {}))
+    return out
+
+
+def main_elastic(args) -> int:
+    """The acceptance scenario of the elastic supervisor, end to end
+    and operator-free: a 2-rank fleet absorbs a preemption NOTICE at
+    rank 1 (checkpoint → world-agreed shrink to 1 → fault-free
+    continuation), then grows back to 2 on the standing
+    capacity-restored signal, and finishes with reference-class
+    quality. Asserts the full observability contract on the way:
+    ``world_shrink`` AND ``world_grow`` events with downtime seconds,
+    and the ``obs_report --chaos`` post-mortem rendering the
+    world-size timeline."""
+    tmp = tempfile.mkdtemp(prefix="parmmg_chaos_el_")
+    budget = StageBudget()
+    failures = []
+    fleet_py = os.path.join(ROOT, "tools", "fleet.py")
+
+    def run_fleet(tag, extra_args):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(JAX_PLATFORMS="cpu",
+                   PMMGTPU_CKPT_BACKOFF="0.01")
+        lp = os.path.join(tmp, f"{tag}.log")
+        p = subprocess.run(
+            [sys.executable, fleet_py, "--world", "2",
+             "--devices-per-rank", "4", "--niter", "4",
+             "--epoch-timeout", "800", "--watchdog", "120",
+             "--ckpt", os.path.join(tmp, f"ck_{tag}")] + extra_args,
+            env=env, stdout=open(lp, "w"), stderr=subprocess.STDOUT,
+            timeout=WORLD_RUN_TIMEOUT * 3, cwd=ROOT,
+        )
+        return p.returncode, open(lp).read()
+
+    try:
+        # --- the elastic seed: notice at rank 1, capacity standing ----
+        t0 = time.monotonic()
+        cap = os.path.join(tmp, "capacity_restored")
+        open(cap, "w").close()   # capacity available the moment the
+        # world runs below target: the grow follows the shrink with no
+        # operator in the loop
+        obs = os.path.join(tmp, "obs")
+        rc, text = run_fleet("elastic", [
+            "--trace", obs, "--capacity-file", cap,
+            "--faults", "it0:post:preempt-notice@rank1",
+        ])
+        budget.note(time.monotonic() - t0)
+        label = "elastic seed (notice@rank1 -> shrink -> grow)"
+        if rc != 0:
+            print(text[-4000:])
+            failures.append(f"{label}: fleet exit {rc}")
+            raise SystemExit(1)
+        if "Traceback (most recent call last)" in text:
+            failures.append(f"{label}: untyped traceback in fleet log")
+            raise SystemExit(1)
+        # world trajectory 2 -> 1 -> 2, three epochs, completed
+        assert "FLEET_OK epochs=3 final_world=2" in text, text[-2000:]
+        assert "launching world=2" in text \
+            and "launching world=1" in text, text[-2000:]
+        dig = _parse_digest(text)
+        assert dig is not None, "no ADAPT_DIGEST relayed by the fleet"
+        ne, qmin, status = dig
+        assert status == 0, f"{label}: final status {status}"
+        assert 150 <= ne <= 5000, f"{label}: implausible ne {ne}"
+        assert qmin >= 0.15, f"{label}: quality floor broken ({qmin})"
+        # both transitions in the durable timelines, with downtime
+        ev = _world_events(obs)
+        for name in ("world_shrink", "world_grow"):
+            assert ev[name], f"{label}: no {name} event in {obs}"
+            a = ev[name][0]
+            assert float(a.get("downtime_s", -1)) >= 0.0, (name, a)
+        sh, gr = ev["world_shrink"][0], ev["world_grow"][0]
+        assert (int(sh["old"]), int(sh["new"])) == (2, 1), sh
+        assert (int(gr["old"]), int(gr["new"])) == (1, 2), gr
+        # the post-mortem renders the injected notice AND the
+        # world-size timeline with downtime seconds
+        pm = _assert_postmortem(obs, label, kinds=["preempt-notice"])
+        assert "world-size timeline" in pm, pm[-1500:]
+        assert "world_shrink" in pm and "world_grow" in pm, pm[-1500:]
+        assert "downtime" in pm, pm[-1500:]
+        print(f"[chaos-elastic] {label} -> 2->1->2, ne={ne} "
+              f"qmin={qmin:.4f}, shrink downtime "
+              f"{sh['downtime_s']}s, grow downtime "
+              f"{gr['downtime_s']}s")
+
+        # --- fixed-world reference (budget-permitting): the elastic
+        # finish must land in the same quality class as a world that
+        # never reformed
+        if budget.allows_another(fallback_estimate=240.0):
+            rc, rtext = run_fleet("ref", [])
+            assert rc == 0, (rc, rtext[-2000:])
+            rdig = _parse_digest(rtext)
+            assert rdig is not None and rdig[2] == 0, rdig
+            rne, rqmin, _ = rdig
+            assert abs(ne - rne) / max(rne, 1) <= 0.5, (
+                f"{label}: elastic ne {ne} vs reference {rne}"
+            )
+            # same quality CLASS, not the same trajectory: the two
+            # re-cuts (8->4->8 shards) re-partition mid-run, so the
+            # worst element legitimately differs — gate at half the
+            # fixed-world qmin on top of the absolute floor above
+            assert qmin >= 0.5 * rqmin, (
+                f"{label}: elastic qmin {qmin} vs reference {rqmin}"
+            )
+            print(f"[chaos-elastic] reference world-2 finish ne={rne} "
+                  f"qmin={rqmin:.4f} — elastic finish is "
+                  "quality-equivalent")
+        else:
+            print("[chaos-elastic] stage budget reached — reference "
+                  "comparison skipped (absolute gates held)")
+        print("[chaos-elastic] notice -> commit -> shrink -> continue "
+              "-> grow -> quality finish: complete, zero operator "
+              "input")
+        return 0
+    except SystemExit:
+        pass
+    except AssertionError as e:
+        failures.append(str(e))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("\n[chaos-elastic] FAILURES:")
+    for f in failures:
+        print(" -", f)
+    return 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2])
@@ -772,5 +936,11 @@ if __name__ == "__main__":
     ap.add_argument("--world", type=int, default=1,
                     help="multi-rank matrix: N coordinated processes "
                          "(default 1 = the single-rank matrix)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic autoscaling rung: notice-driven "
+                         "shrink + capacity-restored grow through "
+                         "tools/fleet.py")
     args = ap.parse_args()
+    if args.elastic:
+        sys.exit(main_elastic(args))
     sys.exit(main(args) if args.world == 1 else main_world(args))

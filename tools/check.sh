@@ -59,6 +59,20 @@ rc=$?
 echo "## chaos-world2 rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
+# elastic autoscaling rung: the operator-free acceptance scenario —
+# a 2-rank fleet (tools/fleet.py) absorbs a preemption NOTICE at
+# rank 1 (checkpoint -> world-agreed shrink to 1 -> fault-free
+# continuation), grows back to 2 on the standing capacity-restored
+# signal, and finishes quality-equivalent to a fixed world; both
+# world_shrink and world_grow events (with downtime seconds) must
+# land in the obs timelines and the --chaos post-mortem must render
+# the world-size timeline. Budget-bounded like chaos-world2.
+timeout -k 10 2700 env JAX_PLATFORMS=cpu PARMMG_STAGE_BUDGET_S=2400 \
+    python tools/chaos_smoke.py --elastic
+rc=$?
+echo "## chaos-elastic rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
 # distributed-frontier smoke: 2-shard tiny run — sweep_active_fraction
 # must drain to ~0 at convergence with the drained-skip path taken,
 # frontier on/off must stay result-equivalent, and the drained
@@ -84,6 +98,18 @@ echo "## kernel-smoke rc=$rc"
 timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 rc=$?
 echo "## obs-smoke rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
+# checkpoint-overlap bench vs a gs:// store (fake-GCS server in CI;
+# a real bucket when PMMGTPU_GCS_BUCKET + auth are present): records
+# ckpt_overlap_s per epoch size through the PARMMG_BENCH_CKPT_STORE
+# wiring and gates them against the committed PERF_DB baselines (wide
+# rel-floor — wall clocks differ per container)
+timeout -k 10 900 env JAX_PLATFORMS=cpu PARMMG_STAGE_BUDGET_S=750 \
+    python tools/ckpt_bench.py --every 1,2,4 --niter 6 \
+    --db PERF_DB.jsonl --rel-floor 8
+rc=$?
+echo "## ckpt-bench rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
 # perf gate: a freshly-generated tiny CPU bench record must carry the

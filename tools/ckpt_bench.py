@@ -1,0 +1,210 @@
+"""Checkpoint-overlap bench against a real object store.
+
+Closes the ROADMAP "real-bucket bench" thread of the preemptible-fleet
+arc: measure how much checkpoint wall time the async staging writer
+(`AdaptOptions.checkpoint_async`, PR 5) hides behind compute when the
+store is a REAL ``gs://`` endpoint rather than a local directory —
+``ckpt_overlap_s`` vs epoch size (``checkpoint_every``), recorded as
+PERF_DB-enveloped records the perf gate watches.
+
+Store resolution:
+
+- ``PMMGTPU_GCS_BUCKET`` set → a real bucket:
+  ``gs://$PMMGTPU_GCS_BUCKET/<prefix>`` with auth per the
+  ``PMMGTPU_GCS_*`` contract (`parmmg_tpu/io/gcs.py`); backend tag
+  ``gcs``;
+- otherwise → a hermetic in-process fake-GCS server
+  (`tests/fake_gcs.py`) speaking the same stdlib-HTTP adapter over
+  real sockets; backend tag ``gcs-fake`` (CI mode — the adapter,
+  retry taxonomy and manifest-last publish discipline are all
+  exercised; only the WAN latency is synthetic).
+
+Each epoch size runs one checkpointing adapt through the SAME
+machinery the bench ladder arms with ``PARMMG_BENCH_CKPT=1`` (which
+now takes ``PARMMG_BENCH_CKPT_STORE`` for the store spec); the record
+carries ``wall_s`` (gated one-sided ↓), ``value`` =
+``ckpt_overlap_s`` (gated ↑ — a staging regression that stops hiding
+I/O behind compute shows up as a value drop), commits, and bytes put.
+
+Usage::
+
+  python tools/ckpt_bench.py [--every 1,2,4] [--niter 6]
+      [--json BENCH_ckpt.json] [--db PERF_DB.jsonl --update 1]
+
+Exit 0 on success (and on a budget-capped partial sweep — every
+completed epoch size still prints/commits its record).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+for _accel in ("axon", "tpu", "cuda", "rocm"):
+    _xb._backend_factories.pop(_accel, None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+WORKLOAD = dict(hsiz=0.45, max_sweeps=2, hgrad=None, polish_sweeps=0)
+
+
+def resolve_store():
+    """(spec, backend tag, cleanup fn). Real bucket when
+    PMMGTPU_GCS_BUCKET names one, else a fresh fake-GCS server."""
+    bucket = os.environ.get("PMMGTPU_GCS_BUCKET")
+    if bucket:
+        prefix = f"parmmg-ckpt-bench/{os.getpid()}-{int(time.time())}"
+        return f"gs://{bucket}/{prefix}", "gcs", (lambda: None)
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    from fake_gcs import FakeGCS
+
+    srv = FakeGCS()
+    base = srv.start()
+    os.environ["PMMGTPU_GCS_ENDPOINT"] = base
+    os.environ["PMMGTPU_GCS_AUTH"] = "anon"
+    return "gs://parmmg-bench/ckpt", "gcs-fake", srv.stop
+
+
+def run_one(every: int, niter: int, spec: str):
+    """One checkpointing adapt at epoch size `every` through the
+    bench's PARMMG_BENCH_CKPT_STORE wiring; returns the payload."""
+    import dataclasses
+
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.obs import metrics as obs_metrics
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    reg = obs_metrics.registry()
+    commits0 = reg.counter("ckpt/commits").value
+    bytes0 = reg.counter("ckpt/put_bytes").value
+    # per-epoch-size prefix: each sweep point owns its object namespace
+    # (a resumable leftover would skew the next point's trajectory)
+    opts = AdaptOptions(
+        niter=niter, checkpoint_every=every, checkpoint_async=True,
+        checkpoint_store=f"{spec}-e{every}", **WORKLOAD,
+    )
+    mesh = unit_cube_mesh(2)
+    t0 = time.perf_counter()
+    out, info = adapt(mesh, opts)
+    wall = time.perf_counter() - t0
+    overlap = float(info.get("ckpt_overlap_s", 0.0))
+    return dict(
+        metric="ckpt_bench",
+        ckpt_every=every,
+        niter=niter,
+        wall_s=round(wall, 4),
+        # the gated headline: checkpoint wall time HIDDEN behind
+        # compute by the async writer (one-sided ↑ in the gate)
+        value=round(overlap, 4),
+        ckpt_overlap_s=round(overlap, 4),
+        ckpt_commits=int(reg.counter("ckpt/commits").value - commits0),
+        ckpt_put_bytes=int(
+            reg.counter("ckpt/put_bytes").value - bytes0
+        ),
+        ne=int(out.ntet),
+        platform=jax.devices()[0].platform,
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--every", default="1,2,4",
+                    help="comma list of checkpoint_every epoch sizes")
+    ap.add_argument("--niter", type=int, default=6)
+    ap.add_argument("--json", default=None,
+                    help="write the enveloped records here")
+    ap.add_argument("--db", default=None,
+                    help="PERF_DB.jsonl to gate against")
+    ap.add_argument("--update", default="0",
+                    help="append records to --db (baseline ratchet)")
+    ap.add_argument("--rel-floor", type=float, default=0.5,
+                    help="gate tolerance floor (CI uses a wide one — "
+                         "wall clocks differ per container)")
+    args = ap.parse_args()
+
+    from parmmg_tpu.obs import history as obs_history
+
+    spec, backend, cleanup = resolve_store()
+    print(f"[ckpt-bench] store {spec} (backend {backend})")
+    budget = os.environ.get("PARMMG_STAGE_BUDGET_S")
+    budget_s = float(budget) if budget else None
+    t_start = time.monotonic()
+    # one untimed, checkpoint-free warmup: every sweep point then runs
+    # against warm jit caches, so wall_s compares epoch sizes instead
+    # of measuring which point paid the compile
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    adapt(unit_cube_mesh(2), AdaptOptions(niter=args.niter, **WORKLOAD))
+    print(f"[ckpt-bench] warmup done "
+          f"({time.monotonic() - t_start:.1f}s)")
+    records = []
+    worst = 0.0
+    try:
+        for every in [int(e) for e in args.every.split(",") if e]:
+            if budget_s is not None and (
+                time.monotonic() - t_start + worst * 1.15 > budget_s
+            ):
+                print(f"[ckpt-bench] stage budget reached — epoch "
+                      f"sizes from {every} skipped")
+                break
+            t0 = time.monotonic()
+            payload = run_one(every, args.niter, spec)
+            worst = max(worst, time.monotonic() - t0)
+            payload["backend"] = backend
+            rec = obs_history.make_record(
+                payload, rung=f"ckpt-{backend}-e{every}"
+            )
+            records.append(rec)
+            print(
+                f"[ckpt-bench] every={every}: wall {payload['wall_s']}s"
+                f"  overlap {payload['ckpt_overlap_s']}s  commits "
+                f"{payload['ckpt_commits']}  put "
+                f"{payload['ckpt_put_bytes']} B"
+            )
+    finally:
+        cleanup()
+    if not records:
+        print("[ckpt-bench] no epoch size completed", file=sys.stderr)
+        return 1
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(dict(records=records), f, indent=1)
+        print(f"[ckpt-bench] records -> {args.json}")
+    if args.db:
+        db = obs_history.load_db(args.db)
+        rc = 0
+        for rec in records:
+            res = obs_history.gate(db, rec, rel_floor=args.rel_floor)
+            for line in res.lines():
+                print(line)
+            if not res.ok:
+                rc = obs_history.REGRESSION_EXIT
+            if args.update not in ("", "0"):
+                obs_history.append_db(args.db, rec)
+        if args.update not in ("", "0"):
+            print(f"[ckpt-bench] {len(records)} record(s) appended "
+                  f"to {args.db}")
+        return rc
+    return 0
+
+
+if __name__ == "__main__":
+    main_rc = main()
+    sys.exit(main_rc)

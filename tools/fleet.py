@@ -1,0 +1,311 @@
+"""Elastic fleet supervisor: launch, shrink, grow — no operator.
+
+The process half of the elastic autoscaling story
+(`parmmg_tpu/parallel/elastic.py` is the in-worker half): this
+supervisor launches N coordinated worker ranks (the
+`tests/multihost_worker.py --elastic` workload by default), publishes
+the store-backed membership manifest each launch epoch, and turns the
+workers' typed exits into world reformations:
+
+- a **notice-driven shrink**: the noticed rank exits 86 (departure,
+  checkpoint committed), the survivors exit 90 (REFORM) at the same
+  agreed boundary — the fleet relaunches the survivors as a world of
+  N−1, which resumes from the committed epoch and re-cuts its shards
+  onto the smaller device pool;
+- a **capacity-restored grow**: a world running below the target size
+  publishes a grow request when `multihost.capacity_restored()` fires
+  (``PMMGTPU_CAPACITY_FILE`` / callback / programmatic), every rank
+  exits 90, and the fleet relaunches at N+1 with a fresh member;
+- a **whole-world preemption** (every rank 86/87 without a reform
+  record) is a plain relaunch-and-resume at the same world size.
+
+Worker teardown/re-init of ``jax.distributed`` happens by process
+replacement: this jaxlib pins the runtime's world size at
+``initialize()``, so a reformation relaunches fresh processes against
+a fresh coordinator port — the store-backed manifest (not any ack from
+the dying rank) carries the membership across, which is why a rank
+that dies without ever acking cannot wedge the reformation.
+
+Typed outcomes: exit 0 = the workload completed (final epoch all ranks
+0, ADAPT_DIGEST relayed); exit 3 = typed refusal (the world cannot
+reform: shrink below ``--min-world``, or a worker's 88-family
+refusal); exit 1 = untyped failure / hang (stage watchdog).
+
+Usage::
+
+  python tools/fleet.py --world 2 --devices-per-rank 4 \\
+      --ckpt /path/ck --trace /path/obs \\
+      [--faults it0:post:preempt-notice@rank1] \\
+      [--capacity-file /path/capacity] [--niter 4] \\
+      [--min-world 1] [--epoch-timeout 900] [--max-epochs 6] \\
+      [-- CMD ...]
+
+The fleet itself is jax-free (stdlib only): manifests are written with
+the same atomic tmp+rename discipline as `LocalFSStore`, so the
+workers' store sees whole objects. ``--ckpt`` must therefore be a
+local directory (workers on one host / a shared FS); object-store
+fleets point the WORKERS at ``gs://`` via their own env and give the
+fleet the mirror directory.
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the typed worker exit family (mirrors parmmg_tpu.failsafe without
+# importing jax into the supervisor)
+KILL = 86          # departure / whole-world preemption (ckpt committed)
+PEER_LOST = 87     # watchdog conversion of a silently dead peer
+MISMATCH = 88      # refusal family (fingerprint / unreformable world)
+CKPT_IO = 89       # store outage past bounded retries
+REFORM = 90        # survivor of an agreed reformation: relaunch me
+TYPED_RCS = {0, KILL, PEER_LOST, MISMATCH, CKPT_IO, REFORM}
+
+REFUSAL_EXIT = 3
+
+
+def _atomic_write_json(path: str, doc: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}.{time.monotonic_ns()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def publish_manifest(ckdir: str, epoch: int, members, target: int,
+                     reason: str) -> None:
+    """The fleet-side manifest publish — same name/format as
+    `parmmg_tpu.parallel.elastic.publish_manifest`, written with the
+    LocalFSStore atomicity discipline so workers read whole objects."""
+    os.makedirs(ckdir, exist_ok=True)
+    _atomic_write_json(
+        os.path.join(ckdir, f"elastic_manifest_e{epoch:05d}.json"),
+        dict(format=1, epoch=epoch, world=len(members),
+             members=list(members), target_world=target, reason=reason,
+             ts=time.time()),
+    )
+
+
+def reform_kinds(ckdir: str, epoch: int):
+    """kinds of the epoch's reform records ({'shrink'}, {'grow'}, ...)."""
+    prefix = f"elastic_reform_e{epoch:05d}_"
+    kinds = set()
+    try:
+        names = os.listdir(ckdir)
+    except FileNotFoundError:
+        return kinds
+    for name in names:
+        if not (name.startswith(prefix) and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(ckdir, name)) as f:
+                kinds.add(json.load(f).get("kind"))
+        except (OSError, ValueError):
+            continue
+    return kinds
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_epoch(args, epoch: int, members, cmd, logdir):
+    """One coordinated world: rank r of this epoch is members[r]. The
+    member id is the STABLE identity (drain files are per member, so a
+    notice aimed at a member follows it across rank renumbering)."""
+    world = len(members)
+    port = _free_port() if world > 1 else None
+    procs, logs = [], []
+    for rank, member in enumerate(members):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=ROOT,
+            PYTHONFAULTHANDLER="1",
+            XLA_FLAGS=("--xla_force_host_platform_device_count="
+                       f"{args.devices_per_rank}"),
+            PMMGTPU_ELASTIC="1",
+            PMMGTPU_ELASTIC_EPOCH=str(epoch),
+            PMMGTPU_ELASTIC_TARGET=str(args.world),
+            PMMGTPU_ELASTIC_MIN_WORLD=str(args.min_world),
+            PMMGTPU_ELASTIC_NITER=str(args.niter),
+            PMMGTPU_CKPT_DIR=args.ckpt,
+            PMMGTPU_WATCHDOG=str(args.watchdog),
+            PMMGTPU_PREEMPT_FILE=os.path.join(
+                args.ckpt, f"fleet_preempt_m{member}"
+            ),
+        )
+        for k in ("PMMGTPU_COORDINATOR", "PMMGTPU_NUM_PROCS",
+                  "PMMGTPU_PROC_ID", "PARMMG_FAULTS",
+                  "PMMGTPU_CAPACITY_FILE", "PMMGTPU_TRACE"):
+            env.pop(k, None)
+        if world > 1:
+            env.update(
+                PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
+                PMMGTPU_NUM_PROCS=str(world),
+                PMMGTPU_PROC_ID=str(rank),
+            )
+        if args.trace:
+            env["PMMGTPU_TRACE"] = args.trace
+        if args.capacity_file:
+            env["PMMGTPU_CAPACITY_FILE"] = args.capacity_file
+        if args.faults and epoch == 0:
+            # fault schedules address epoch 0's rank numbering; later
+            # epochs run fault-free (the recovery is what's under test)
+            env["PARMMG_FAULTS"] = args.faults
+        lp = os.path.join(logdir, f"e{epoch}_r{rank}_m{member}.log")
+        logs.append(lp)
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=open(lp, "w"),
+            stderr=subprocess.STDOUT, cwd=ROOT,
+        ))
+    return procs, logs
+
+
+def wait_epoch(procs, timeout: float):
+    """Bounded wait for every rank; on overrun the world is killed and
+    None returned (the zero-hang contract makes a wedged epoch a
+    FAILURE, not something to wait out)."""
+    deadline = time.monotonic() + timeout
+    rcs = []
+    try:
+        for p in procs:
+            rcs.append(p.wait(timeout=max(deadline - time.monotonic(),
+                                          1.0)))
+    except subprocess.TimeoutExpired:
+        return None
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rcs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="elastic fleet supervisor (see module docstring)"
+    )
+    ap.add_argument("--world", type=int, default=2,
+                    help="initial AND target world size")
+    ap.add_argument("--min-world", type=int, default=1)
+    ap.add_argument("--devices-per-rank", type=int, default=4)
+    ap.add_argument("--ckpt", default=None,
+                    help="checkpoint/manifest directory (default: tmp)")
+    ap.add_argument("--trace", default=None,
+                    help="PMMGTPU_TRACE dir shared by every epoch")
+    ap.add_argument("--faults", default=None,
+                    help="PARMMG_FAULTS for epoch 0 only")
+    ap.add_argument("--capacity-file", default=None)
+    ap.add_argument("--niter", type=int, default=4)
+    ap.add_argument("--watchdog", type=float, default=120)
+    ap.add_argument("--epoch-timeout", type=float, default=900)
+    ap.add_argument("--max-epochs", type=int, default=6)
+    ap.add_argument("cmd", nargs="*",
+                    help="worker command (default: "
+                         "tests/multihost_worker.py --elastic)")
+    args = ap.parse_args()
+
+    if not args.ckpt:
+        args.ckpt = tempfile.mkdtemp(prefix="parmmg_fleet_ck_")
+    os.makedirs(args.ckpt, exist_ok=True)
+    logdir = os.path.join(args.ckpt, "fleet_logs")
+    os.makedirs(logdir, exist_ok=True)
+    cmd = args.cmd or [
+        sys.executable,
+        os.path.join(ROOT, "tests", "multihost_worker.py"), "--elastic",
+    ]
+
+    members = list(range(args.world))
+    next_member = args.world
+    history = []
+    for epoch in range(args.max_epochs):
+        reason = "launch" if epoch == 0 else history[-1]
+        publish_manifest(args.ckpt, epoch, members, args.world, reason)
+        world = len(members)
+        print(f"[fleet] epoch {epoch}: launching world={world} "
+              f"members={members} ({reason})", flush=True)
+        procs, logs = launch_epoch(args, epoch, members, cmd, logdir)
+        rcs = wait_epoch(procs, args.epoch_timeout)
+        if rcs is None:
+            print(f"[fleet] FAIL epoch {epoch}: hang (epoch timeout "
+                  f"{args.epoch_timeout}s) — world killed", flush=True)
+            return 1
+        by_member = dict(zip(members, rcs))
+        print(f"[fleet] epoch {epoch}: exits {by_member}", flush=True)
+
+        untyped = {m: rc for m, rc in by_member.items()
+                   if rc not in TYPED_RCS}
+        if untyped:
+            print(f"[fleet] FAIL epoch {epoch}: untyped exits "
+                  f"{untyped} (logs under {logdir})", flush=True)
+            return 1
+        if all(rc == 0 for rc in rcs):
+            # completed: relay the digest lines for the harness
+            for lp in logs:
+                with open(lp) as f:
+                    for ln in f:
+                        if ln.startswith("ADAPT_DIGEST"):
+                            print(ln.rstrip(), flush=True)
+            print(f"[fleet] FLEET_OK epochs={epoch + 1} "
+                  f"final_world={world}", flush=True)
+            return 0
+        if any(rc == MISMATCH for rc in rcs):
+            print(f"[fleet] FLEET_REFUSED epoch {epoch}: a rank "
+                  "refused typed (unreformable world or checkpoint "
+                  "mismatch, exit 88) — see logs", flush=True)
+            return REFUSAL_EXIT
+        if any(rc == CKPT_IO for rc in rcs):
+            print(f"[fleet] FAIL epoch {epoch}: checkpoint store "
+                  "outage (exit 89)", flush=True)
+            return 1
+        if any(rc == 0 for rc in rcs):
+            # a reformation is collectively agreed: a mix of finished
+            # and reforming ranks breaks the protocol
+            print(f"[fleet] FAIL epoch {epoch}: inconsistent exits "
+                  f"{by_member} (finished ranks next to reforming "
+                  "ones)", flush=True)
+            return 1
+
+        departed = [m for m, rc in by_member.items() if rc == KILL]
+        survivors = [m for m, rc in by_member.items()
+                     if rc in (REFORM, PEER_LOST)]
+        kinds = reform_kinds(args.ckpt, epoch)
+        if "shrink" in kinds or (departed and not kinds):
+            members = survivors
+            history.append(f"shrink: members {departed} departed")
+        elif "grow" in kinds:
+            grown = min(args.world, world + 1)
+            members = survivors + departed  # departed: none on grow
+            while len(members) < grown:
+                members.append(next_member)
+                next_member += 1
+            history.append("grow: capacity restored")
+        else:
+            # whole-world preemption without a reform record: plain
+            # checkpoint-backed relaunch at the same size
+            members = survivors + departed
+            history.append("resume: whole-world preemption")
+        if len(members) < args.min_world:
+            print(f"[fleet] FLEET_REFUSED: reformation would leave "
+                  f"{len(members)} member(s), below --min-world "
+                  f"{args.min_world} — the checkpoint stands; rerun "
+                  "when capacity returns", flush=True)
+            return REFUSAL_EXIT
+    print(f"[fleet] FAIL: {args.max_epochs} epochs without completion",
+          flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
