@@ -46,6 +46,7 @@ from . import metrics as metrics_mod
 __all__ = [
     "load_trace_events", "load_timeline", "summarize", "render",
     "rank_timelines", "chaos_summary", "render_chaos",
+    "serve_summary", "render_serve",
 ]
 
 
@@ -265,6 +266,149 @@ def render_chaos(dirpath: str) -> str:
             f"   world shrinks {c['world_shrinks']}  world grows "
             f"{c['world_grows']}"
         )
+    lines.append("")
+    return "\n".join(lines)
+
+
+# the event vocabulary of the adaptation service (`parmmg_tpu.service`):
+# per-job lifecycle events carrying job_id/tenant labels, plus the
+# server-level warmup record. File order is happened-order — a server
+# restart appends to the same rank file, so one job's
+# submitted -> running -> requeued -> running -> terminal chain spans
+# a SIGKILL without any clock reconciliation.
+SERVE_JOB_EVENTS = ("job_submitted", "job_running", "job_requeued",
+                    "job_terminal")
+SERVE_REFUSAL_EVENT = "job_refused"
+SERVE_WARMUP_EVENT = "serve_warmup"
+
+
+def serve_summary(dirpath: str) -> dict:
+    """Structured per-job post-mortem of a serving run's trace
+    directory: every job's lifecycle chain (file-ordered, spanning
+    server restarts), transient refusals by code, per-tenant job
+    counts, warmups, and the merged serve/* counters."""
+    jobs: Dict[str, dict] = {}
+    refusals: Dict[str, int] = {}
+    warmups: List[dict] = []
+    order: List[str] = []
+    timelines = rank_timelines(dirpath)
+    for rank in sorted(timelines):
+        for r in timelines[rank]:
+            if r.get("type") != "event":
+                continue
+            name, args = r.get("name"), r.get("args", {})
+            if name == SERVE_WARMUP_EVENT:
+                warmups.append(args)
+                continue
+            if name == SERVE_REFUSAL_EVENT:
+                code = args.get("code", "?")
+                refusals[code] = refusals.get(code, 0) + 1
+                continue
+            if name not in SERVE_JOB_EVENTS:
+                continue
+            jid = args.get("job_id")
+            if jid is None:
+                continue
+            if jid not in jobs:
+                order.append(jid)
+                jobs[jid] = dict(job_id=jid,
+                                 tenant=args.get("tenant", "?"),
+                                 size_class=None, state=None,
+                                 code=None, attempts=0, chain=[])
+            j = jobs[jid]
+            if args.get("size_class"):
+                j["size_class"] = args["size_class"]
+            if name == "job_running":
+                j["attempts"] = max(j["attempts"],
+                                    int(args.get("attempt", 1)))
+            if name == "job_terminal":
+                j["state"] = args.get("state")
+                j["code"] = args.get("code")
+                j["wall_s"] = args.get("wall_s")
+                j["digest"] = args.get("digest")
+            j["chain"].append(dict(name=name, ts_us=r.get("ts_us", 0),
+                                   args=args))
+    tenants: Dict[str, dict] = {}
+    by_state: Dict[str, int] = {}
+    for jid in order:
+        j = jobs[jid]
+        t = tenants.setdefault(j["tenant"],
+                               dict(jobs=0, done=0, failed=0))
+        t["jobs"] += 1
+        state = j["state"] or "in-flight"
+        by_state[state] = by_state.get(state, 0) + 1
+        if state == "done":
+            t["done"] += 1
+        elif state in ("failed", "deadline", "rejected", "cancelled"):
+            t["failed"] += 1
+    counters = ((metrics_mod.merge_dir(dirpath) or {})
+                .get("counters", {}))
+    return dict(
+        dir=dirpath,
+        jobs=[jobs[jid] for jid in order],
+        by_state=by_state,
+        tenants=tenants,
+        refusals=refusals,
+        warmups=warmups,
+        counters={k: v for k, v in sorted(counters.items())
+                  if k.startswith("serve/")},
+    )
+
+
+def render_serve(dirpath: str) -> str:
+    """Human-readable serving post-mortem: one timeline per job
+    (submitted → running → … → typed terminal, spanning restarts),
+    then the per-tenant and refusal rollups."""
+    s = serve_summary(dirpath)
+    lines = [f"== serve post-mortem: {s['dir']} =="]
+    if s["warmups"]:
+        for w in s["warmups"]:
+            lines.append(
+                f"   warmup: classes {','.join(w.get('classes', []))} "
+                f"in {w.get('seconds')}s"
+            )
+    if not s["jobs"]:
+        lines.append("   (no job events found)")
+    for j in s["jobs"]:
+        lines.append("")
+        state = j["state"] or "in-flight"
+        code = f" ({j['code']})" if j.get("code") else ""
+        att = (f", {j['attempts']} attempt(s)"
+               if j["attempts"] > 1 else "")
+        lines.append(
+            f"-- job {j['job_id']} [tenant {j['tenant']}, class "
+            f"{j['size_class'] or '?'}] -> {state}{code}{att} --"
+        )
+        for c in j["chain"]:
+            args = c["args"]
+            extra = " ".join(
+                f"{k}={v}" for k, v in sorted(args.items())
+                if k not in ("job_id", "tenant")
+            )
+            lines.append(
+                f"     [{c['ts_us'] / 1e6:9.3f}s] {c['name']}"
+                + (f"  {extra}" if extra else "")
+            )
+    lines.append("")
+    lines.append("-- rollup --")
+    states = "  ".join(f"{k} {v}"
+                       for k, v in sorted(s["by_state"].items()))
+    lines.append(f"   jobs {len(s['jobs'])}: {states or '(none)'}")
+    for tenant, t in sorted(s["tenants"].items()):
+        lines.append(
+            f"   tenant {tenant}: {t['jobs']} job(s), {t['done']} "
+            f"done, {t['failed']} failed/typed"
+        )
+    if s["refusals"]:
+        ref = "  ".join(f"{k} {v}"
+                        for k, v in sorted(s["refusals"].items()))
+        lines.append(f"   transient refusals: {ref}")
+    if s["counters"]:
+        cnt = "  ".join(
+            f"{k[len('serve/'):]} {v}"
+            for k, v in s["counters"].items()
+        )
+        lines.append(f"   counters: {cnt}")
     lines.append("")
     return "\n".join(lines)
 

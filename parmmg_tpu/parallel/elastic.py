@@ -317,11 +317,15 @@ class ElasticCoordinator:
             kind = "shrink"
             new_world = self.world - len(departing)
         else:
-            # grow one rank per reformation: conservative — repeated
-            # reformations reach the target, and each one revalidates
-            # that capacity still stands
+            # batch grow: go straight to the target world in ONE
+            # reformation. Each reformation costs a full barrier +
+            # checkpoint + repartition, so growing 1 -> N as N-1
+            # single-step reforms pays that price N-1 times for the
+            # same final world; the capacity probe that triggered the
+            # vote already said the whole target stands, and a member
+            # that fails to come up is just the next shrink vote.
             kind = "grow"
-            new_world = min(self.target_world, self.world + 1)
+            new_world = self.target_world
         decision = ReformDecision(
             kind=kind, epoch=self.epoch, old_world=self.world,
             new_world=new_world, departing=departing,

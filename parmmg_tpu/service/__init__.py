@@ -1,0 +1,43 @@
+"""Adaptation-as-a-service: a fault-contained multi-tenant job server.
+
+The service arc's foundation (ROADMAP "Adaptation-as-a-service"):
+independent adaptation jobs (medit/VTK in → adapted mesh out) are
+admitted through a bounded queue with typed refusals, bucketed into
+padded size classes that share compiled executables, executed with
+per-job blast-radius isolation + deadlines, and tracked in a
+crash-safe journal on the checkpoint-store contract. `tools/serve.py`
+is the process wrapper (spool ingestion, drain-on-notice, bench);
+`tools/serve_smoke.py` the end-to-end acceptance harness.
+
+Modules: `jobs` (specs, states, typed errors), `admission` (size
+classes + bounded queue), `journal` (durable state machine),
+`server` (the serving loop).
+"""
+
+from .admission import (  # noqa: F401
+    AdmissionQueue,
+    DEFAULT_CLASSES,
+    SizeClass,
+    classify,
+    peek_counts,
+)
+from .jobs import (  # noqa: F401
+    BadJobError,
+    CANCELLED,
+    DEADLINE,
+    DONE,
+    FAILED,
+    JobCancelledError,
+    JobDeadlineError,
+    JobSpec,
+    JobTooLargeError,
+    QueueFullError,
+    REJECTED,
+    RUNNING,
+    SUBMITTED,
+    ServerDrainingError,
+    ServiceRefusal,
+    TERMINAL_STATES,
+)
+from .journal import JobJournal, JournalStateError  # noqa: F401
+from .server import JobServer, default_options, mesh_digest  # noqa: F401

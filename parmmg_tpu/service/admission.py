@@ -1,0 +1,226 @@
+"""Admission control: size classes, header peeks, the bounded queue.
+
+Why size classes at all: the whole port lives in the static-shape
+regime — `remesh_sweeps` and every other device program is compile-
+cached on the mesh's CAPACITIES (`models.adapt`, PR-1's memoized jit
+factories). A server that loaded each tenant mesh at its natural
+``counts × headroom`` capacities would recompile per job and serve
+nothing but XLA. Bucketing jobs into a small table of padded size
+classes makes every job in a class share one set of compiled
+executables: the batch IS the shared compile cache, and the per-class
+warm-boot (`JobServer.warmup`) makes even the first request
+compile-free.
+
+Admission is where the two typed refusals of the backpressure contract
+live:
+
+- :class:`~parmmg_tpu.service.jobs.QueueFullError` — the bounded queue
+  is at capacity (transient; the client retries);
+- :class:`~parmmg_tpu.service.jobs.JobTooLargeError` — no class can
+  hold ``counts × margin`` (permanent for this input; the job is
+  journaled ``rejected``).
+
+The classifier reads entity COUNTS, not the mesh: `peek_counts` scans
+the medit/VTU header (``Vertices``/``Tetrahedra`` sections,
+``NumberOfPoints``/``NumberOfCells`` attributes) so an oversized
+submission is refused for the cost of a text scan, never a device
+allocation. The ``margin`` (default 2.0) is the growth headroom a job
+keeps INSIDE its class before `adapt`'s capacity ladder would have to
+grow past the class caps and break compile sharing; it deliberately
+exceeds `Mesh.from_numpy`'s 1.5 load headroom, so a class-admitted
+mesh always loads strictly below its class capacities.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from collections import deque
+from typing import Iterable, List, Optional, Tuple
+
+from .jobs import BadJobError, JobSpec, JobTooLargeError, QueueFullError
+
+# --- size classes ----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SizeClass:
+    """One padded capacity bucket: every job admitted here runs at
+    EXACTLY these capacities, so every job here shares one compile."""
+
+    name: str
+    pcap: int
+    tcap: int
+    fcap: int
+    ecap: int
+
+    def holds(self, npoin: int, ntet: int, margin: float) -> bool:
+        return (npoin * margin <= self.pcap
+                and ntet * margin <= self.tcap)
+
+    def caps(self) -> dict:
+        return dict(pcap=self.pcap, tcap=self.tcap, fcap=self.fcap,
+                    ecap=self.ecap)
+
+
+#: default table, smallest first (the classifier picks the first fit).
+#: Sized for the CPU test fixtures up through "a real small mesh";
+#: production tables are a `JobServer(classes=...)` argument.
+DEFAULT_CLASSES = (
+    SizeClass("tiny", pcap=512, tcap=2048, fcap=512, ecap=512),
+    SizeClass("small", pcap=2048, tcap=8192, fcap=2048, ecap=2048),
+    SizeClass("medium", pcap=8192, tcap=32768, fcap=8192, ecap=8192),
+)
+
+
+def classify(npoin: int, ntet: int,
+             classes: Iterable[SizeClass] = DEFAULT_CLASSES,
+             margin: float = 2.0) -> SizeClass:
+    """Smallest class holding ``counts × margin``, or the typed
+    too-large refusal naming the largest class's capacities."""
+    table = list(classes)
+    for cls in table:
+        if cls.holds(npoin, ntet, margin):
+            return cls
+    largest = table[-1]
+    raise JobTooLargeError(
+        f"mesh with {npoin} vertices / {ntet} tets exceeds every size "
+        f"class (largest '{largest.name}': pcap {largest.pcap}, tcap "
+        f"{largest.tcap}, margin {margin})",
+        npoin=npoin, ntet=ntet, margin=margin,
+        largest_class=largest.name,
+        largest_pcap=largest.pcap, largest_tcap=largest.tcap,
+    )
+
+
+# --- header peeks ----------------------------------------------------------
+
+_VTU_RE = re.compile(
+    rb'NumberOfPoints\s*=\s*"(\d+)".*?NumberOfCells\s*=\s*"(\d+)"',
+    re.DOTALL,
+)
+
+
+def _peek_medit(path: str) -> Tuple[int, int]:
+    counts = {}
+    want = {"vertices": "np", "tetrahedra": "nt"}
+    with open(path, errors="replace") as f:
+        pending = None
+        for line in f:
+            tok = line.strip()
+            if pending is not None and tok:
+                if tok.split()[0].lstrip("-").isdigit():
+                    counts[pending] = int(tok.split()[0])
+                pending = None
+                if len(counts) == 2:
+                    break
+                continue
+            if tok.lower() in want:
+                pending = want[tok.lower()]
+    if "np" not in counts or "nt" not in counts:
+        raise ValueError(
+            f"{path}: no Vertices/Tetrahedra sections in header scan"
+        )
+    return counts["np"], counts["nt"]
+
+
+def _peek_vtu(path: str) -> Tuple[int, int]:
+    with open(path, "rb") as f:
+        head = f.read(65536)
+    m = _VTU_RE.search(head)
+    if not m:
+        raise ValueError(f"{path}: no NumberOfPoints/NumberOfCells "
+                         "attributes in header scan")
+    return int(m.group(1)), int(m.group(2))
+
+
+def peek_counts(path: str) -> Tuple[int, int]:
+    """(npoin, ntet) from the file HEADER — the admission-time size
+    check must not pay a full parse (let alone a device transfer) for
+    a mesh it is about to refuse. Raises the typed
+    :class:`BadJobError` when the input is missing or unscannable."""
+    if not os.path.exists(path):
+        raise BadJobError(f"input mesh not found: {path}", path=path)
+    ext = os.path.splitext(path)[1].lower()
+    try:
+        if ext == ".vtu":
+            return _peek_vtu(path)
+        if ext in (".mesh", ".meshb"):
+            if ext == ".meshb":
+                # binary medit: the cheap text scan does not apply;
+                # fall back to the real reader's header discipline
+                from ..io import medit
+
+                raw = medit.read_mesh(path)
+                return len(raw.verts), len(raw.tets)
+            return _peek_medit(path)
+    except BadJobError:
+        raise
+    except Exception as e:
+        raise BadJobError(
+            f"unreadable input mesh {path}: {e}", path=path
+        ) from e
+    raise BadJobError(
+        f"unknown mesh format {ext!r} for {path} (expected .mesh/"
+        ".meshb/.vtu)", path=path, ext=ext,
+    )
+
+
+# --- the bounded queue -----------------------------------------------------
+
+
+class AdmissionQueue:
+    """Bounded FIFO of admitted ``(spec, size_class)`` pairs.
+
+    ``take_batch`` pops the head job plus up to ``batch_max - 1``
+    later jobs of the SAME class (a bucket shares one compile, so a
+    batch must be class-homogeneous); jobs of other classes keep their
+    relative order — head-of-line classes cannot starve the rest
+    because the next ``take_batch`` starts from the new head."""
+
+    def __init__(self, cap: int):
+        self.cap = int(cap)
+        self._q: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def offer(self, spec: JobSpec, cls: SizeClass) -> None:
+        if len(self._q) >= self.cap:
+            raise QueueFullError(
+                f"admission queue at capacity ({self.cap}); resubmit "
+                "after the backlog drains",
+                queue_depth=len(self._q), queue_cap=self.cap,
+            )
+        self._q.append((spec, cls))
+
+    def push_front(self, items: List[Tuple[JobSpec, SizeClass]]) -> None:
+        """Restore popped-but-unrun batch members to the queue head
+        (drain interrupt) — their admission already paid the cap."""
+        for item in reversed(items):
+            self._q.appendleft(item)
+
+    def remove(self, job_id: str) -> Optional[JobSpec]:
+        """Remove a queued job (cancellation); None when not queued."""
+        for i, (spec, _cls) in enumerate(self._q):
+            if spec.job_id == job_id:
+                del self._q[i]
+                return spec
+        return None
+
+    def take_batch(self, batch_max: int) -> List[Tuple[JobSpec, SizeClass]]:
+        if not self._q:
+            return []
+        head_spec, head_cls = self._q.popleft()
+        batch = [(head_spec, head_cls)]
+        rest: deque = deque()
+        while self._q and len(batch) < batch_max:
+            spec, cls = self._q.popleft()
+            if cls.name == head_cls.name:
+                batch.append((spec, cls))
+            else:
+                rest.append((spec, cls))
+        rest.extend(self._q)
+        self._q = rest
+        return batch

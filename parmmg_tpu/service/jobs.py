@@ -1,0 +1,195 @@
+"""Job vocabulary of the adaptation service: specs, states, typed errors.
+
+The reference is a one-shot CLI — one process, one mesh, one exit code
+(`src/parmmg.c` returns `PMMG_STRONGFAILURE` and dies). A multi-tenant
+server needs the same taxonomy discipline at PER-JOB granularity: every
+way a job can end must be a machine-readable, typed outcome, so one
+tenant's bad mesh produces an error RESPONSE instead of a dead server.
+
+Two error families, mirroring `parmmg_tpu.failsafe`:
+
+- **refusals** (:class:`ServiceRefusal`, an :class:`AdaptError`): the
+  job was never admitted — bounded queue full, no size class large
+  enough, input unreadable, or the server draining on a preemption
+  notice. Each carries a stable ``code`` string (the per-request error
+  response) plus a payload with the numbers the client needs to react
+  (queue depth, the largest class's capacities, ...).
+- **in-flight interrupts** (:class:`JobDeadlineError`,
+  :class:`JobCancelledError`): raised from the driver's iteration/phase
+  boundary hook INSIDE ``adapt``. They subclass ``BaseException`` the
+  way :class:`~parmmg_tpu.failsafe.PreemptionError` does and for the
+  same reason: the in-driver recovery ladder (rollback, grow-and-retry)
+  must never absorb them — a job past its deadline must stop burning
+  its batch-mates' machine time, not retry harder.
+
+Job lifecycle (the journal's state machine, enforced by
+`service.journal`)::
+
+    submitted -> running -> done | failed | deadline
+    submitted -> cancelled | rejected
+    running   -> cancelled
+    running   -> submitted        (requeue: drain or crash replay)
+
+Terminal states carry either a ``result`` (digest, entity counts,
+wall seconds) or an ``error`` (type + code + message).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from ..failsafe import AdaptError
+
+# --- job states ------------------------------------------------------------
+
+SUBMITTED = "submitted"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+DEADLINE = "deadline"
+REJECTED = "rejected"
+CANCELLED = "cancelled"
+
+TERMINAL_STATES = frozenset({DONE, FAILED, DEADLINE, REJECTED, CANCELLED})
+
+# legal transitions: FROM state -> allowed TO states. `None` is the
+# unjournaled initial state; RUNNING -> SUBMITTED is the requeue edge
+# (graceful drain, crash replay) that makes zero-job-loss possible.
+TRANSITIONS = {
+    None: frozenset({SUBMITTED, REJECTED}),
+    SUBMITTED: frozenset({RUNNING, CANCELLED, REJECTED}),
+    RUNNING: frozenset({DONE, FAILED, DEADLINE, CANCELLED, SUBMITTED}),
+}
+
+
+# --- refusals (admission-time, typed, machine-readable) --------------------
+
+
+class ServiceRefusal(AdaptError):
+    """A job the server declined to admit. ``code`` is the stable
+    per-request error response string; ``payload`` the structured
+    context. Subclasses are DISTINCT refusals — a client retries a
+    ``queue-full`` but must re-mesh a ``too-large``."""
+
+    code = "refused"
+    #: transient refusals (client may retry unchanged) are never
+    #: journaled; permanent ones terminate the job as ``rejected``
+    transient = True
+
+    def __init__(self, message: str, **payload):
+        super().__init__(message)
+        self.payload = dict(payload)
+
+    def doc(self) -> dict:
+        """The machine-readable refusal response."""
+        return dict(error=type(self).__name__, code=self.code,
+                    transient=self.transient, message=str(self),
+                    **self.payload)
+
+
+class QueueFullError(ServiceRefusal):
+    """Backpressure: the bounded admission queue is at capacity.
+    Transient — resubmit when the queue drains."""
+
+    code = "queue-full"
+    transient = True
+
+
+class JobTooLargeError(ServiceRefusal):
+    """No configured size class can hold this mesh (with the growth
+    margin remeshing needs). Permanent for this input — the job
+    terminates ``rejected``."""
+
+    code = "too-large"
+    transient = False
+
+
+class BadJobError(ServiceRefusal):
+    """The job's input could not be read/parsed (missing file, unknown
+    format, corrupt header). Permanent — ``rejected``."""
+
+    code = "bad-input"
+    transient = False
+
+
+class ServerDrainingError(ServiceRefusal):
+    """The server holds a preemption notice (or operator drain) and has
+    stopped admitting. Transient — resubmit to the restarted server."""
+
+    code = "draining"
+    transient = True
+
+
+# --- in-flight interrupts (BaseException: unabsorbable by recovery) --------
+
+
+class JobDeadlineError(BaseException):
+    """The per-attempt deadline expired; raised at the next iteration/
+    phase boundary of the running job. The job terminates in the typed
+    ``deadline`` state; batch-mates are untouched."""
+
+    code = "deadline"
+
+    def __init__(self, job_id: str, deadline_s: float, phase: str):
+        super().__init__(
+            f"job {job_id}: deadline of {deadline_s}s exceeded at "
+            f"phase boundary '{phase}'"
+        )
+        self.job_id = job_id
+        self.deadline_s = deadline_s
+        self.phase = phase
+
+
+class JobCancelledError(BaseException):
+    """The job was cancelled while running; honored at the next
+    iteration/phase boundary. Terminal state ``cancelled``."""
+
+    code = "cancelled"
+
+    def __init__(self, job_id: str, phase: str):
+        super().__init__(
+            f"job {job_id}: cancelled at phase boundary '{phase}'"
+        )
+        self.job_id = job_id
+        self.phase = phase
+
+
+# --- the job spec ----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One tenant's adaptation request: medit/VTK in → adapted mesh out.
+
+    ``deadline_s`` is a PER-ATTEMPT execution budget (measured from the
+    attempt's start, not from submission): a server crash + journal
+    replay must not spuriously deadline every requeued job. ``faults``
+    is a job-scoped `PARMMG_FAULTS` schedule (the chaos grammar) — the
+    blast-radius tests' way of poisoning exactly one batch member."""
+
+    job_id: str
+    inmesh: str
+    tenant: str = "default"
+    insol: Optional[str] = None
+    outmesh: Optional[str] = None
+    hsiz: Optional[float] = 0.45
+    niter: int = 2
+    deadline_s: Optional[float] = None
+    faults: Optional[str] = None
+    submitted_ts: float = 0.0
+
+    def __post_init__(self):
+        if not self.job_id or "/" in self.job_id:
+            raise ValueError(f"bad job_id {self.job_id!r}")
+        if self.submitted_ts == 0.0:
+            object.__setattr__(self, "submitted_ts", time.time())
+
+    def to_doc(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_doc(doc: dict) -> "JobSpec":
+        names = {f.name for f in dataclasses.fields(JobSpec)}
+        return JobSpec(**{k: v for k, v in doc.items() if k in names})

@@ -1,0 +1,168 @@
+"""Crash-safe job journal on the checkpoint-store contract.
+
+One JSON record per job (``job_<id>.json``) in any
+:class:`~parmmg_tpu.io.ckpt_store.CheckpointStore` (LocalFS, ``mem://``,
+``gs://``) — the journal rides the exact same durable substrate, retry
+envelope and commit-token discipline as the mesh checkpoints, so a
+deployment that trusts its checkpoints already trusts its job ledger.
+
+Every transition is written with ``publish_json`` (atomic commit-token
+put): a reader sees either the previous whole record or the next whole
+record, never a torn one. A SIGKILLed server therefore leaves each job
+in exactly the last state it durably reached — ``submitted`` (queued,
+never started) or ``running`` (in flight) — and :meth:`JobJournal.replay`
+re-enqueues every non-terminal job on restart, which is the zero-job-
+loss contract: admission is acknowledged only after the ``submitted``
+record is published, so an acknowledged job can never vanish.
+
+The record::
+
+    {format: 1, job_id, tenant, state, size_class, attempts,
+     spec: {...JobSpec...},
+     history: [{state, ts, detail}, ...],
+     result: {digest, ne, np, wall_s} | error: {type, code, message}}
+
+Transitions are validated against
+:data:`~parmmg_tpu.service.jobs.TRANSITIONS`; an illegal edge raises
+:class:`JournalStateError` — a state machine that cannot be driven
+backwards is what makes the replay's "non-terminal ⇒ requeue" rule
+sound.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..io.ckpt_store import CheckpointIOError, CheckpointStore
+from .jobs import (
+    JobSpec,
+    RUNNING,
+    SUBMITTED,
+    TERMINAL_STATES,
+    TRANSITIONS,
+)
+
+JOURNAL_FORMAT = 1
+_NAME_FMT = "job_{}.json"
+_PREFIX = "job_"
+
+
+class JournalStateError(ValueError):
+    """An illegal job-state transition was attempted (programming
+    error or a corrupt record) — refused before anything is written."""
+
+
+class JobJournal:
+    """The durable job ledger. One writer (the serving process);
+    readers (replay, reports, smoke harnesses) see committed whole
+    records only."""
+
+    def __init__(self, store: CheckpointStore):
+        self.store = store
+
+    # -- reads ------------------------------------------------------------
+    def load(self, job_id: str) -> Optional[dict]:
+        try:
+            return self.store.get_json(_NAME_FMT.format(job_id))
+        except (FileNotFoundError, CheckpointIOError):
+            return None
+
+    def jobs(self) -> List[dict]:
+        """Every committed job record (torn/corrupt names skipped —
+        a broken record must not wedge a replay)."""
+        out = []
+        for name in self.store.list():
+            if not (name.startswith(_PREFIX) and name.endswith(".json")):
+                continue
+            try:
+                out.append(self.store.get_json(name))
+            except (FileNotFoundError, CheckpointIOError, ValueError):
+                continue
+        return sorted(out, key=lambda d: (d.get("history") or
+                                          [{}])[0].get("ts", 0.0))
+
+    # -- the one write path ----------------------------------------------
+    def transition(self, job_id: str, state: str, *,
+                   spec: Optional[JobSpec] = None,
+                   size_class: str = "",
+                   detail: str = "",
+                   result: Optional[dict] = None,
+                   error: Optional[dict] = None) -> dict:
+        doc = self.load(job_id)
+        old = doc.get("state") if doc else None
+        if state not in TRANSITIONS.get(old, frozenset()):
+            raise JournalStateError(
+                f"job {job_id}: illegal transition {old!r} -> {state!r}"
+            )
+        if doc is None:
+            if spec is None:
+                raise JournalStateError(
+                    f"job {job_id}: first transition needs the spec"
+                )
+            doc = dict(format=JOURNAL_FORMAT, job_id=job_id,
+                       tenant=spec.tenant, state=None,
+                       size_class=size_class, attempts=0,
+                       spec=spec.to_doc(), history=[])
+        doc["state"] = state
+        if size_class:
+            doc["size_class"] = size_class
+        if state == RUNNING:
+            doc["attempts"] = int(doc.get("attempts", 0)) + 1
+        if result is not None:
+            doc["result"] = result
+        if error is not None:
+            doc["error"] = error
+        doc.setdefault("history", []).append(
+            dict(state=state, ts=time.time(), detail=detail)
+        )
+        self.store.publish_json(_NAME_FMT.format(job_id), doc)
+        return doc
+
+    # -- lifecycle sugar ---------------------------------------------------
+    def submit(self, spec: JobSpec, size_class: str) -> dict:
+        return self.transition(spec.job_id, SUBMITTED, spec=spec,
+                               size_class=size_class, detail="admitted")
+
+    def reject(self, spec: JobSpec, error: dict, detail: str = "") -> dict:
+        from .jobs import REJECTED
+
+        return self.transition(spec.job_id, REJECTED, spec=spec,
+                               error=error,
+                               detail=detail or error.get("code", ""))
+
+    def running(self, job_id: str, detail: str = "") -> dict:
+        return self.transition(job_id, RUNNING, detail=detail)
+
+    def terminal(self, job_id: str, state: str, *,
+                 result: Optional[dict] = None,
+                 error: Optional[dict] = None,
+                 detail: str = "") -> dict:
+        if state not in TERMINAL_STATES:
+            raise JournalStateError(f"{state!r} is not terminal")
+        return self.transition(job_id, state, result=result,
+                               error=error, detail=detail)
+
+    def requeue(self, job_id: str, reason: str) -> dict:
+        """running -> submitted: the drain/crash edge. The attempt
+        count survives (it only grows on ``running``), so a job's
+        record tells its whole multi-attempt story."""
+        return self.transition(job_id, SUBMITTED,
+                               detail=f"requeued: {reason}")
+
+    # -- restart ----------------------------------------------------------
+    def replay(self) -> Dict[str, List[dict]]:
+        """Partition the ledger for a restarting server: non-terminal
+        records (to re-enqueue — ``running`` ones are first moved back
+        to ``submitted`` with a crash-replay note) vs terminal ones."""
+        requeue, terminal = [], []
+        for doc in self.jobs():
+            state = doc.get("state")
+            if state in TERMINAL_STATES:
+                terminal.append(doc)
+                continue
+            if state == RUNNING:
+                doc = self.requeue(doc["job_id"],
+                                   "crash replay: found running")
+            requeue.append(doc)
+        return dict(requeue=requeue, terminal=terminal)

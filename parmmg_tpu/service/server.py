@@ -1,0 +1,458 @@
+"""The job server: bucketed batches, blast-radius isolation, drain.
+
+`JobServer` composes every robustness layer this repo has grown into
+one serving loop:
+
+- **admission** (`service.admission`): typed refusals, bounded queue,
+  size-class bucketing — every admitted job is journaled ``submitted``
+  BEFORE the submit call returns, so acknowledgement implies
+  durability;
+- **execution**: a batch is a class-homogeneous group of jobs run
+  back-to-back through the existing `models.adapt` driver at the
+  class's pinned capacities — identical shapes mean every batch member
+  (and every later batch of the class) reuses the same compiled
+  executables (the PR-1 memoized jit factories; `warmup` pre-pays the
+  compile per class so the first request is compile-free);
+- **blast-radius isolation**: each member runs under its own typed
+  fence. A `NumericalError`/`CapacityError`/... downgrades THAT job to
+  ``failed`` with a machine-readable error doc; a deadline or
+  cancellation (BaseException-family, raised from the phase-boundary
+  hook) downgrades it to ``deadline``/``cancelled``; the loop then
+  simply continues with the next member — the poisoned job is masked
+  out of the batch and the survivors' results stand. Because the
+  service runs jobs fail-fast (``recovery_attempts=0``: retry policy
+  is a JOB-layer concern, visible in the journal's attempt count, not
+  an invisible in-driver rollback), a batch-mate's output is the SAME
+  device program on the SAME input as a solo run — asserted
+  bit-identical (`mesh_digest`) by tests/test_m21_service.py and
+  tools/serve_smoke.py;
+- **deadlines + cancellation**: wired through ``adapt``'s
+  ``phase_hook`` — the same iteration/phase boundary the failsafe
+  harness uses for checkpoints and preemption, so a job is interrupted
+  only at a consistent boundary, never mid-device-program;
+- **graceful drain**: `request_drain` (SIGTERM / preemption notice in
+  `tools/serve.py`) stops admission with the typed ``draining``
+  refusal, interrupts the in-flight job at its next boundary, and
+  journals it back to ``submitted`` (requeue) — combined with the
+  journal's replay, a drain or a SIGKILL loses zero jobs;
+- **per-tenant observability**: every transition emits a job-id/
+  tenant-labelled event + counter through `obs/`, rendered by
+  ``tools/obs_report.py --serve`` as the per-job timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import time
+from typing import Iterable, List, Optional, Tuple
+
+from ..failsafe import AdaptError, PreemptionError, WorldReformError
+from ..obs import metrics as obs_metrics, trace as obs_trace
+from . import jobs as J
+from .admission import (
+    AdmissionQueue,
+    DEFAULT_CLASSES,
+    SizeClass,
+    classify,
+    peek_counts,
+)
+from .jobs import (
+    JobCancelledError,
+    JobDeadlineError,
+    JobSpec,
+    ServerDrainingError,
+    ServiceRefusal,
+)
+from .journal import JobJournal
+
+
+class _DrainInterrupt(BaseException):
+    """Internal: the in-flight job is being requeued for a graceful
+    drain (never absorbed by the in-driver recovery ladder)."""
+
+
+def mesh_digest(mesh) -> str:
+    """Bit-level digest of a result mesh at its FULL capacities —
+    the strictest form of the isolation assertion: a batch-mate's
+    output must match a solo run of the same class byte for byte,
+    padding included."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    for name in ("vert", "vref", "vtag", "vmask", "tet", "tref",
+                 "tmask", "tria", "trref", "trmask", "met"):
+        a = getattr(mesh, name, None)
+        if a is None:
+            continue
+        arr = np.asarray(a)
+        h.update(name.encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+def default_options():
+    """The service's shared driver options: fail-fast (typed per-job
+    errors surface instead of invisible in-driver retries) and every
+    compile-keyed static fixed, so one class = one compile."""
+    from ..models.adapt import AdaptOptions
+
+    return AdaptOptions(
+        niter=2, max_sweeps=2, hgrad=None, polish_sweeps=0,
+        recovery_attempts=0,
+    )
+
+
+class JobServer:
+    """One serving process. Construction is cheap (no device touch);
+    the first executed or warmed job pays its class's compile."""
+
+    def __init__(self, store, *,
+                 classes: Iterable[SizeClass] = DEFAULT_CLASSES,
+                 queue_cap: int = 16,
+                 batch_max: int = 4,
+                 margin: float = 2.0,
+                 base_options=None):
+        self.journal = JobJournal(store)
+        self.classes = tuple(classes)
+        self.queue = AdmissionQueue(queue_cap)
+        self.batch_max = int(batch_max)
+        self.margin = float(margin)
+        self._base_options = base_options
+        self._draining = False
+        self._cancel_requested: set = set()
+        self._running_id: Optional[str] = None
+        self.warmup_s: float = 0.0
+        # test-only: a pause right after a job is journaled `running`
+        # gives the smoke harness (tools/serve_smoke.py) a deterministic
+        # SIGKILL window — journal shows terminal batch-mates PLUS one
+        # in-flight job, the exact crash the replay contract covers.
+        self._test_sleep_s = float(
+            os.environ.get("PMMGTPU_SERVE_TEST_SLEEP_S", "0") or 0.0
+        )
+
+    # -- options -----------------------------------------------------------
+    @property
+    def base_options(self):
+        if self._base_options is None:
+            self._base_options = default_options()
+        return self._base_options
+
+    def _class_named(self, name: str) -> Optional[SizeClass]:
+        for cls in self.classes:
+            if cls.name == name:
+                return cls
+        return None
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, spec: JobSpec) -> dict:
+        """Admit one job: classify, journal ``submitted``, enqueue.
+        Raises a typed :class:`ServiceRefusal`; permanent refusals
+        (too-large, bad-input) additionally journal the job as
+        ``rejected`` so it still reaches a typed TERMINAL state."""
+        reg = obs_metrics.registry()
+        if self._draining:
+            reg.counter("serve/refused_draining").inc()
+            err = ServerDrainingError(
+                "server is draining on a preemption notice/operator "
+                "stop; resubmit to the restarted server",
+            )
+            obs_trace.emit_event("job_refused", job_id=spec.job_id,
+                                 tenant=spec.tenant, code=err.code,
+                                 transient=True)
+            raise err
+        existing = self.journal.load(spec.job_id)
+        if existing is not None:
+            # idempotent resubmission (spool re-ingest after a crash
+            # between journal publish and spool unlink)
+            return existing
+        try:
+            npoin, ntet = peek_counts(spec.inmesh)
+            cls = classify(npoin, ntet, self.classes, self.margin)
+        except ServiceRefusal as err:
+            code = f"serve/refused_{err.code.replace('-', '_')}"
+            reg.counter(code).inc()
+            if not err.transient:
+                self.journal.reject(spec, err.doc())
+                obs_trace.emit_event(
+                    "job_terminal", job_id=spec.job_id,
+                    tenant=spec.tenant, state=J.REJECTED, code=err.code,
+                )
+            else:
+                obs_trace.emit_event("job_refused", job_id=spec.job_id,
+                                     tenant=spec.tenant, code=err.code,
+                                     transient=True)
+            raise
+        try:
+            self.queue.offer(spec, cls)
+        except ServiceRefusal as err:
+            reg.counter("serve/refused_queue_full").inc()
+            obs_trace.emit_event("job_refused", job_id=spec.job_id,
+                                 tenant=spec.tenant, code=err.code,
+                                 transient=True)
+            raise
+        rec = self.journal.submit(spec, cls.name)
+        reg.counter("serve/submitted").inc()
+        obs_trace.emit_event(
+            "job_submitted", job_id=spec.job_id, tenant=spec.tenant,
+            size_class=cls.name, npoin=npoin, ntet=ntet,
+        )
+        return rec
+
+    def replay(self) -> int:
+        """Restart path: re-enqueue every non-terminal journaled job
+        (``running`` records are first requeued — the crash edge).
+        Returns the number of jobs restored to the queue."""
+        restored = 0
+        for doc in self.journal.replay()["requeue"]:
+            spec = JobSpec.from_doc(doc.get("spec", {}))
+            cls = self._class_named(doc.get("size_class", ""))
+            if cls is None:
+                npoin, ntet = peek_counts(spec.inmesh)
+                cls = classify(npoin, ntet, self.classes, self.margin)
+            self.queue.offer(spec, cls)
+            restored += 1
+            obs_metrics.registry().counter("serve/replayed").inc()
+        return restored
+
+    # -- cancellation / drain ---------------------------------------------
+    def cancel(self, job_id: str) -> Optional[str]:
+        """Cancel a queued (immediate) or running (next-boundary) job.
+        Returns the resulting state, or None for unknown/terminal."""
+        if self.queue.remove(job_id) is not None:
+            self.journal.terminal(job_id, J.CANCELLED,
+                                  error=dict(code="cancelled",
+                                             message="cancelled while "
+                                                     "queued"))
+            obs_metrics.registry().counter("serve/cancelled").inc()
+            obs_trace.emit_event("job_terminal", job_id=job_id,
+                                 state=J.CANCELLED, code="cancelled")
+            return J.CANCELLED
+        if job_id == self._running_id:
+            self._cancel_requested.add(job_id)
+            return J.RUNNING
+        return None
+
+    def request_drain(self) -> None:
+        """Stop admitting (typed ``draining`` refusals) and interrupt
+        the in-flight job at its next phase boundary (requeued)."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def idle(self) -> bool:
+        return len(self.queue) == 0 and self._running_id is None
+
+    # -- warm boot ---------------------------------------------------------
+    def warmup(self, classes: Optional[Iterable[SizeClass]] = None) -> float:
+        """Pre-pay each class's compiles with a synthetic job at the
+        class's exact capacities: the same memoized jit factories real
+        jobs hit, driven to `lower().compile()` by one tiny end-to-end
+        pass (an AOT-only lower would not seed the dispatch cache the
+        executing path reads). First real request per class is then
+        compile-free."""
+        from ..models.adapt import adapt
+        from ..utils.gen import unit_cube_mesh
+
+        t0 = time.monotonic()
+        warmed = []
+        for cls in (tuple(classes) if classes is not None
+                    else self.classes):
+            mesh = unit_cube_mesh(2, **cls.caps())
+            opts = dataclasses.replace(self.base_options, niter=1,
+                                       hsiz=0.45, faults=None)
+            adapt(mesh, opts)
+            warmed.append(cls.name)
+        self.warmup_s = round(time.monotonic() - t0, 3)
+        obs_trace.emit_event("serve_warmup", classes=warmed,
+                             seconds=self.warmup_s)
+        return self.warmup_s
+
+    # -- execution ---------------------------------------------------------
+    def _load_mesh(self, spec: JobSpec, cls: SizeClass):
+        ext = os.path.splitext(spec.inmesh)[1].lower()
+        if ext == ".vtu":
+            from ..io import vtk
+
+            mesh = vtk.load_vtu(spec.inmesh)
+            return mesh.with_capacity(**cls.caps())
+        from ..io import medit
+
+        return medit.load_mesh(spec.inmesh, spec.insol, **cls.caps())
+
+    def _save_mesh(self, mesh, path: str) -> None:
+        if os.path.splitext(path)[1].lower() == ".vtu":
+            from ..io import vtk
+
+            vtk.save_vtu(mesh, path)
+            return
+        from ..io import medit
+
+        medit.save_mesh(mesh, path)
+
+    def _boundary_hook(self, spec: JobSpec, deadline_ts: Optional[float]):
+        def hook(phase: str) -> None:
+            if self._draining:
+                raise _DrainInterrupt()
+            if spec.job_id in self._cancel_requested:
+                raise JobCancelledError(spec.job_id, phase)
+            if deadline_ts is not None and time.monotonic() > deadline_ts:
+                raise JobDeadlineError(spec.job_id, spec.deadline_s,
+                                       phase)
+        return hook
+
+    def _execute(self, spec: JobSpec, cls: SizeClass):
+        from ..models.adapt import adapt
+
+        mesh = self._load_mesh(spec, cls)
+        opts = dataclasses.replace(
+            self.base_options, hsiz=spec.hsiz, niter=spec.niter,
+            faults=spec.faults,
+        )
+        deadline_ts = (time.monotonic() + spec.deadline_s
+                       if spec.deadline_s is not None else None)
+        hook = self._boundary_hook(spec, deadline_ts)
+        # the hook also covers admission->start queueing time zero:
+        # deadline_s is a per-ATTEMPT budget (see JobSpec docstring)
+        return adapt(mesh, opts, phase_hook=hook)
+
+    def _run_job(self, spec: JobSpec, cls: SizeClass) -> str:
+        """One fenced batch member: returns the terminal state (or
+        re-raises the drain interrupt after requeueing)."""
+        reg = obs_metrics.registry()
+        rec = self.journal.running(spec.job_id)
+        attempt = int(rec.get("attempts", 1))
+        obs_trace.emit_event(
+            "job_running", job_id=spec.job_id, tenant=spec.tenant,
+            size_class=cls.name, attempt=attempt,
+        )
+        self._running_id = spec.job_id
+        if self._test_sleep_s:
+            time.sleep(self._test_sleep_s)
+        tr = obs_trace.get_tracer()
+        t0 = time.monotonic()
+        try:
+            with tr.span("serve/job", job_id=spec.job_id,
+                         tenant=spec.tenant, size_class=cls.name):
+                out, info = self._execute(spec, cls)
+            wall = round(time.monotonic() - t0, 3)
+            if int(info.get("status", 0)) != 0:
+                # the driver absorbed a typed failure by rolling back
+                # to the last conformal mesh (graded LOWFAILURE — the
+                # reference's failed_handling ladder). At the SERVICE
+                # layer that is this job's typed failure, not a result:
+                # surface the absorbed error from the run history.
+                absorbed = [h for h in info.get("history", [])
+                            if h.get("error")]
+                err = (absorbed[-1] if absorbed
+                       else dict(error="AdaptError",
+                                 failure="degraded (LOWFAILURE)"))
+                self.journal.terminal(
+                    spec.job_id, J.FAILED,
+                    error=dict(type=err["error"], code=err["error"],
+                               message=str(err.get("failure", "")),
+                               status=int(info["status"])),
+                )
+                reg.counter("serve/failed").inc()
+                obs_trace.emit_event(
+                    "job_terminal", job_id=spec.job_id,
+                    tenant=spec.tenant, state=J.FAILED,
+                    code=err["error"], wall_s=wall, attempt=attempt,
+                )
+                return J.FAILED
+            digest = mesh_digest(out)
+            if spec.outmesh:
+                self._save_mesh(out, spec.outmesh)
+            result = dict(
+                digest=digest, ne=int(out.ntet), npoin=int(out.npoin),
+                status=int(info.get("status", 0)), wall_s=wall,
+            )
+            self.journal.terminal(spec.job_id, J.DONE, result=result)
+            reg.counter("serve/done").inc()
+            obs_trace.emit_event(
+                "job_terminal", job_id=spec.job_id, tenant=spec.tenant,
+                state=J.DONE, code="ok", wall_s=wall, digest=digest,
+                attempt=attempt,
+            )
+            return J.DONE
+        except JobDeadlineError as e:
+            return self._typed_terminal(spec, J.DEADLINE, e.code, e,
+                                        t0, attempt)
+        except JobCancelledError as e:
+            return self._typed_terminal(spec, J.CANCELLED, e.code, e,
+                                        t0, attempt)
+        except _DrainInterrupt:
+            self.journal.requeue(spec.job_id, "graceful drain")
+            reg.counter("serve/requeued").inc()
+            obs_trace.emit_event("job_requeued", job_id=spec.job_id,
+                                 tenant=spec.tenant,
+                                 reason="graceful drain")
+            raise
+        except (PreemptionError, WorldReformError):
+            # infrastructure (not job) failure mid-attempt: requeue the
+            # job and let the caller's typed exit drive the restart
+            self.journal.requeue(spec.job_id, "preemption during run")
+            reg.counter("serve/requeued").inc()
+            obs_trace.emit_event("job_requeued", job_id=spec.job_id,
+                                 tenant=spec.tenant,
+                                 reason="preemption during run")
+            raise
+        except AdaptError as e:
+            code = type(e).__name__
+            return self._typed_terminal(spec, J.FAILED, code, e, t0,
+                                        attempt)
+        finally:
+            self._running_id = None
+            self._cancel_requested.discard(spec.job_id)
+
+    def _typed_terminal(self, spec: JobSpec, state: str, code: str,
+                        err: BaseException, t0: float,
+                        attempt: int) -> str:
+        wall = round(time.monotonic() - t0, 3)
+        self.journal.terminal(
+            spec.job_id, state,
+            error=dict(type=type(err).__name__, code=code,
+                       message=str(err)),
+        )
+        reg = obs_metrics.registry()
+        reg.counter(f"serve/{state}").inc()
+        obs_trace.emit_event(
+            "job_terminal", job_id=spec.job_id, tenant=spec.tenant,
+            state=state, code=code, wall_s=wall, attempt=attempt,
+        )
+        return state
+
+    def run_once(self) -> int:
+        """Run ONE class-homogeneous batch off the queue head; returns
+        the number of jobs that reached a terminal state. A drain
+        interrupt requeues the in-flight member (journal + queue) and
+        pushes un-started members back untouched."""
+        batch = self.queue.take_batch(self.batch_max)
+        if not batch:
+            return 0
+        reg = obs_metrics.registry()
+        reg.counter("serve/batches").inc()
+        tr = obs_trace.get_tracer()
+        finished = 0
+        with tr.span("serve/batch", size_class=batch[0][1].name,
+                     jobs=len(batch)):
+            for i, (spec, cls) in enumerate(batch):
+                if self._draining:
+                    self._push_back(batch[i:])
+                    break
+                try:
+                    self._run_job(spec, cls)
+                    finished += 1
+                except _DrainInterrupt:
+                    # _run_job already journaled the requeue; restore
+                    # the in-memory queue (this member + the rest)
+                    self._push_back(batch[i:])
+                    break
+        return finished
+
+    def _push_back(self, members: List[Tuple[JobSpec, SizeClass]]) -> None:
+        self.queue.push_front(members)

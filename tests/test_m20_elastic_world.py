@@ -208,6 +208,19 @@ def test_capacity_driven_grow_decision():
     assert elastic.reform_records(store2, 0) == []
 
 
+def test_capacity_grow_is_batched_to_target():
+    """One capacity-restored vote grows STRAIGHT to the target world:
+    1 -> 4 is one reformation (one barrier + checkpoint + relaunch),
+    not three single-step ones."""
+    store = _mem_store("m20-batch-grow")
+    c = elastic.ElasticCoordinator(store, epoch=0, rank=0, world=1,
+                                   target_world=4)
+    multihost.request_capacity_restored("pool refilled")
+    d = c.poll(0)
+    assert d is not None and d.kind == "grow"
+    assert (d.old_world, d.new_world) == (1, 4) and d.departing == ()
+
+
 def test_unreformable_world_refusal():
     store = _mem_store("m20-refuse")
     c = elastic.ElasticCoordinator(store, epoch=0, rank=0, world=1,

@@ -1,0 +1,390 @@
+"""M21: the adaptation service — admission, isolation, journal, drain.
+
+Unit + integration coverage of `parmmg_tpu/service/` (the job server
+behind `tools/serve.py`):
+
+- the admission/refusal matrix: size-class classification, header
+  peeks, bounded-queue backpressure — every refusal typed with a
+  stable code and a machine-readable doc;
+- bucketing + padding exactness: a class-admitted mesh loads at
+  EXACTLY the class capacities (margin 2.0 > the loader's 1.5
+  headroom), which is what makes a class one shared compile;
+- poisoned-batch containment: a nan-faulted batch member ends
+  ``failed`` (typed NumericalError) while its batch-mates' digests are
+  BIT-IDENTICAL to a fresh-server solo run;
+- the journal state machine on all three store backends (LocalFS,
+  ``mem://``, fake-GCS): transition validation, crash replay,
+  attempt counting;
+- drain-on-notice requeue and per-job deadline/cancellation through
+  the phase-boundary hook.
+
+The process-level story (spool ingestion, SIGKILL mid-batch, restart
+replay, ``obs_report --serve``) lives in ``tools/serve_smoke.py``.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from fake_gcs import FakeGCS
+from parmmg_tpu.io import ckpt_store, medit
+from parmmg_tpu.service import (
+    AdmissionQueue,
+    BadJobError,
+    DEFAULT_CLASSES,
+    JobJournal,
+    JobServer,
+    JobSpec,
+    JobTooLargeError,
+    JournalStateError,
+    QueueFullError,
+    ServerDrainingError,
+    SizeClass,
+    TERMINAL_STATES,
+    classify,
+    peek_counts,
+)
+from parmmg_tpu.service import jobs as J
+from parmmg_tpu.utils.gen import unit_cube_mesh
+
+# one tiny class: every adapt in this module shares one compile
+TINY = SizeClass("t", pcap=256, tcap=1024, fcap=256, ecap=256)
+
+
+@pytest.fixture(scope="module")
+def cube_mesh_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("m21") / "cube.mesh")
+    medit.save_mesh(unit_cube_mesh(2), path)
+    return path
+
+
+def _mem_store(name):
+    ckpt_store.memory_bucket(name).clear()
+    return ckpt_store.make_store(f"mem://{name}", None)
+
+
+def _server(name, **kw):
+    kw.setdefault("classes", (TINY,))
+    return JobServer(_mem_store(name), **kw)
+
+
+# ---------------------------------------------------------------------------
+# admission: classification, peeks, backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_classify_picks_smallest_fit_with_margin():
+    classes = DEFAULT_CLASSES
+    assert classify(27, 48, classes).name == "tiny"
+    # 2x margin: 300 verts * 2 > tiny's 512? 600 > 512 -> small
+    assert classify(300, 48, classes).name == "small"
+    assert classify(3000, 12000, classes).name == "medium"
+
+
+def test_classify_too_large_refusal_is_typed():
+    with pytest.raises(JobTooLargeError) as ei:
+        classify(50000, 200000, DEFAULT_CLASSES)
+    err = ei.value
+    assert err.code == "too-large" and not err.transient
+    doc = err.doc()
+    assert doc["code"] == "too-large" and doc["transient"] is False
+    assert doc["largest_class"] == "medium"
+    assert doc["npoin"] == 50000 and doc["margin"] == 2.0
+
+
+def test_peek_counts_medit_header(cube_mesh_path, tmp_path):
+    npoin, ntet = peek_counts(cube_mesh_path)
+    assert (npoin, ntet) == (27, 48)
+    # the peek is a header scan: declared counts rule, nothing loads
+    big = tmp_path / "big.mesh"
+    big.write_text("MeshVersionFormatted 2\nDimension\n3\n"
+                   "Vertices\n50000\nTetrahedra\n200000\nEnd\n")
+    assert peek_counts(str(big)) == (50000, 200000)
+
+
+def test_peek_counts_vtu_header(tmp_path):
+    p = tmp_path / "m.vtu"
+    p.write_text('<VTKFile type="UnstructuredGrid">\n<UnstructuredGrid>'
+                 '\n<Piece NumberOfPoints="27" NumberOfCells="48">\n')
+    assert peek_counts(str(p)) == (27, 48)
+
+
+def test_peek_counts_bad_inputs(tmp_path):
+    with pytest.raises(BadJobError) as ei:
+        peek_counts(str(tmp_path / "missing.mesh"))
+    assert ei.value.code == "bad-input" and not ei.value.transient
+    weird = tmp_path / "m.stl"
+    weird.write_text("solid\n")
+    with pytest.raises(BadJobError):
+        peek_counts(str(weird))
+    corrupt = tmp_path / "c.mesh"
+    corrupt.write_text("not a medit header at all\n")
+    with pytest.raises(BadJobError):
+        peek_counts(str(corrupt))
+
+
+def test_queue_backpressure_and_class_homogeneous_batches():
+    q = AdmissionQueue(cap=3)
+    small = DEFAULT_CLASSES[1]
+    s = [JobSpec(job_id=f"j{i}", inmesh="x.mesh") for i in range(4)]
+    q.offer(s[0], TINY)
+    q.offer(s[1], small)
+    q.offer(s[2], TINY)
+    with pytest.raises(QueueFullError) as ei:
+        q.offer(s[3], TINY)
+    assert ei.value.doc()["queue_depth"] == 3
+    assert ei.value.doc()["queue_cap"] == 3
+    # head job + later SAME-class jobs; others keep their order
+    batch = q.take_batch(4)
+    assert [sp.job_id for sp, _ in batch] == ["j0", "j2"]
+    assert len(q) == 1
+    # push_front restores drain-interrupted members at the head
+    q.push_front(batch)
+    assert [sp.job_id for sp, _ in q.take_batch(4)] == ["j0", "j2"]
+    assert q.remove("j1").job_id == "j1"
+    assert q.remove("nope") is None
+
+
+def test_submit_refusal_matrix(cube_mesh_path, tmp_path):
+    srv = _server("m21-adm", queue_cap=1)
+    # queue-full: transient, NOT journaled
+    srv.submit(JobSpec(job_id="a", inmesh=cube_mesh_path))
+    with pytest.raises(QueueFullError):
+        srv.submit(JobSpec(job_id="b", inmesh=cube_mesh_path))
+    assert srv.journal.load("b") is None
+    # too-large / bad-input: permanent, journaled as typed terminals
+    big = tmp_path / "big.mesh"
+    big.write_text("MeshVersionFormatted 2\nDimension\n3\n"
+                   "Vertices\n50000\nTetrahedra\n200000\nEnd\n")
+    with pytest.raises(JobTooLargeError):
+        srv.submit(JobSpec(job_id="o", inmesh=str(big)))
+    assert srv.journal.load("o")["state"] == J.REJECTED
+    assert srv.journal.load("o")["error"]["code"] == "too-large"
+    with pytest.raises(BadJobError):
+        srv.submit(JobSpec(job_id="m",
+                           inmesh=str(tmp_path / "gone.mesh")))
+    assert srv.journal.load("m")["error"]["code"] == "bad-input"
+    # idempotent resubmission returns the journaled record
+    rec = srv.submit(JobSpec(job_id="a", inmesh=cube_mesh_path))
+    assert rec["state"] == J.SUBMITTED and len(srv.queue) == 1
+    # draining: transient refusal, nothing journaled
+    srv.request_drain()
+    with pytest.raises(ServerDrainingError):
+        srv.submit(JobSpec(job_id="z", inmesh=cube_mesh_path))
+    assert srv.journal.load("z") is None
+
+
+def test_bucketing_pads_to_exact_class_capacities(cube_mesh_path):
+    """Padding exactness: a class-admitted mesh loads at EXACTLY the
+    class capacities (one class = one compile key), and the 2.0
+    admission margin clears the loader's 1.5 growth headroom."""
+    srv = _server("m21-pad")
+    npoin, ntet = peek_counts(cube_mesh_path)
+    cls = classify(npoin, ntet, srv.classes, srv.margin)
+    assert cls is TINY
+    mesh = srv._load_mesh(JobSpec(job_id="p", inmesh=cube_mesh_path),
+                          cls)
+    assert mesh.vert.shape[0] == cls.pcap
+    assert mesh.tet.shape[0] == cls.tcap
+    assert int(mesh.npoin) == npoin and int(mesh.ntet) == ntet
+    # margin discipline: admission (x2) is strictly stricter than the
+    # loader headroom (x1.5), so admitted => loads below caps
+    assert npoin * 1.5 < cls.pcap and ntet * 1.5 < cls.tcap
+
+
+# ---------------------------------------------------------------------------
+# the journal state machine on every store backend
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gcs_server():
+    srv = FakeGCS()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(params=("localfs", "mem", "gcs"))
+def journal_store(request, tmp_path, gcs_server, monkeypatch):
+    if request.param == "localfs":
+        return ckpt_store.make_store(str(tmp_path / "j"), None)
+    if request.param == "mem":
+        return _mem_store("m21-journal")
+    monkeypatch.setenv("PMMGTPU_GCS_ENDPOINT", gcs_server.base_url)
+    monkeypatch.setenv("PMMGTPU_GCS_AUTH", "anon")
+    return ckpt_store.make_store(
+        f"gs://m21-journal/{time.monotonic_ns()}", None
+    )
+
+
+def test_journal_roundtrip_and_replay(journal_store):
+    j = JobJournal(journal_store)
+    spec = JobSpec(job_id="r1", inmesh="x.mesh", tenant="acme")
+    j.submit(spec, "tiny")
+    assert j.load("r1")["state"] == J.SUBMITTED
+    j.running("r1")
+    doc = j.load("r1")
+    assert doc["state"] == J.RUNNING and doc["attempts"] == 1
+    # crash: a second journal on the same store replays RUNNING back
+    # to SUBMITTED (requeue) and reports it; terminals stay put
+    spec2 = JobSpec(job_id="r2", inmesh="x.mesh")
+    j.submit(spec2, "tiny")
+    j.running("r2")
+    j.terminal("r2", J.DONE, result=dict(digest="abc"))
+    parts = JobJournal(journal_store).replay()
+    assert [d["job_id"] for d in parts["requeue"]] == ["r1"]
+    assert [d["job_id"] for d in parts["terminal"]] == ["r2"]
+    requeued = j.load("r1")
+    assert requeued["state"] == J.SUBMITTED
+    assert "crash replay" in requeued["history"][-1]["detail"]
+    # the requeued attempt counts up on the NEXT running edge
+    j.running("r1")
+    assert j.load("r1")["attempts"] == 2
+    j.terminal("r1", J.FAILED, error=dict(code="x", message="boom"))
+    # illegal edges refuse before writing
+    with pytest.raises(JournalStateError):
+        j.running("r1")            # terminal -> running
+    with pytest.raises(JournalStateError):
+        j.transition("r1", J.SUBMITTED)
+    with pytest.raises(JournalStateError):
+        j.terminal("new", J.DONE)  # unjournaled -> terminal
+    with pytest.raises(JournalStateError):
+        j.terminal("r2", "sideways")   # not a terminal state
+    # spec roundtrips through the record
+    back = JobSpec.from_doc(j.load("r1")["spec"])
+    assert back.job_id == "r1" and back.tenant == "acme"
+
+
+def test_journal_skips_corrupt_records():
+    store = _mem_store("m21-corrupt")
+    j = JobJournal(store)
+    j.submit(JobSpec(job_id="ok", inmesh="x.mesh"), "tiny")
+    store.put("job_torn.json", b"{ not json")
+    docs = j.jobs()
+    assert [d["job_id"] for d in docs] == ["ok"]
+
+
+# ---------------------------------------------------------------------------
+# execution: containment, deadlines, cancellation, drain
+# ---------------------------------------------------------------------------
+
+
+def test_poisoned_batch_containment_bit_identical(cube_mesh_path):
+    """One nan-faulted member ends ``failed`` (typed NumericalError);
+    its batch-mates end ``done`` with digests bit-identical to a
+    fresh-server SOLO run — the blast-radius contract, stated at the
+    strictest (full-capacity byte) level."""
+    solo = _server("m21-solo")
+    solo.submit(JobSpec(job_id="s", inmesh=cube_mesh_path, niter=1))
+    solo.run_once()
+    sdoc = solo.journal.load("s")
+    assert sdoc["state"] == J.DONE
+    solo_digest = sdoc["result"]["digest"]
+
+    srv = _server("m21-batch")
+    srv.submit(JobSpec(job_id="a", inmesh=cube_mesh_path, niter=1,
+                       tenant="acme"))
+    srv.submit(JobSpec(job_id="e", inmesh=cube_mesh_path, niter=1,
+                       tenant="evil", faults="it0:remesh:nan"))
+    srv.submit(JobSpec(job_id="f", inmesh=cube_mesh_path, niter=1,
+                       tenant="acme"))
+    finished = srv.run_once()
+    assert finished == 3
+    docs = {j: srv.journal.load(j) for j in ("a", "e", "f")}
+    assert docs["e"]["state"] == J.FAILED
+    assert "Numerical" in docs["e"]["error"]["type"]
+    for jid in ("a", "f"):
+        assert docs[jid]["state"] == J.DONE
+        assert docs[jid]["result"]["digest"] == solo_digest, (
+            f"batch-mate {jid} contaminated by the poisoned member"
+        )
+
+
+def test_deadline_is_typed_terminal(cube_mesh_path):
+    srv = _server("m21-deadline")
+    srv.submit(JobSpec(job_id="d", inmesh=cube_mesh_path, niter=1,
+                       deadline_s=1e-4))
+    srv.run_once()
+    doc = srv.journal.load("d")
+    assert doc["state"] == J.DEADLINE
+    assert doc["error"]["code"] == "deadline"
+    assert "deadline" in doc["error"]["message"]
+
+
+def test_cancellation_queued_and_running(cube_mesh_path):
+    srv = _server("m21-cancel")
+    srv.submit(JobSpec(job_id="c1", inmesh=cube_mesh_path))
+    # queued: immediate typed terminal, removed from the queue
+    assert srv.cancel("c1") == J.CANCELLED
+    assert srv.journal.load("c1")["state"] == J.CANCELLED
+    assert len(srv.queue) == 0
+    assert srv.cancel("unknown") is None
+    # running: honored at the next phase boundary
+    srv.submit(JobSpec(job_id="c2", inmesh=cube_mesh_path, niter=1))
+    srv._cancel_requested.add("c2")
+    srv.run_once()
+    doc = srv.journal.load("c2")
+    assert doc["state"] == J.CANCELLED
+    assert doc["error"]["code"] == "cancelled"
+
+
+def test_drain_requeues_unstarted_and_inflight(cube_mesh_path,
+                                               monkeypatch):
+    # unstarted members: a draining server pushes the batch back
+    srv = _server("m21-drain")
+    srv.submit(JobSpec(job_id="u1", inmesh=cube_mesh_path))
+    srv.submit(JobSpec(job_id="u2", inmesh=cube_mesh_path))
+    srv.request_drain()
+    assert srv.run_once() == 0
+    assert len(srv.queue) == 2
+    assert srv.journal.load("u1")["state"] == J.SUBMITTED
+    # in-flight member: the drain lands at the next phase boundary —
+    # journaled running -> submitted (requeue), queue restored
+    monkeypatch.setenv("PMMGTPU_SERVE_TEST_SLEEP_S", "0.5")
+    srv2 = _server("m21-drain2")
+    srv2.submit(JobSpec(job_id="i1", inmesh=cube_mesh_path, niter=1))
+    t = threading.Timer(0.1, srv2.request_drain)
+    t.start()
+    try:
+        srv2.run_once()
+    finally:
+        t.cancel()
+    doc = srv2.journal.load("i1")
+    assert doc["state"] == J.SUBMITTED
+    assert "requeued" in doc["history"][-1]["detail"]
+    assert len(srv2.queue) == 1
+    # restart path: a fresh server on the same store replays it
+    srv3 = JobServer(ckpt_store.make_store("mem://m21-drain2", None),
+                     classes=(TINY,))
+    assert srv3.replay() == 1
+    assert len(srv3.queue) == 1
+
+
+def test_replay_restores_queue_from_journal(cube_mesh_path):
+    srv = _server("m21-replay")
+    srv.submit(JobSpec(job_id="q1", inmesh=cube_mesh_path))
+    srv.submit(JobSpec(job_id="q2", inmesh=cube_mesh_path))
+    srv.journal.running("q1")   # simulate a crash mid-run
+    srv2 = JobServer(ckpt_store.make_store("mem://m21-replay", None),
+                     classes=(TINY,))
+    assert srv2.replay() == 2
+    assert {sp.job_id for sp, _ in srv2.queue.take_batch(4)} \
+        == {"q1", "q2"}
+    assert srv2.journal.load("q1")["state"] == J.SUBMITTED
+
+
+def test_terminal_states_cover_every_exit():
+    assert TERMINAL_STATES == {J.DONE, J.FAILED, J.DEADLINE,
+                               J.REJECTED, J.CANCELLED}
+    # every refusal doc is json-serializable end to end
+    for err in (QueueFullError("q", queue_depth=1, queue_cap=1),
+                JobTooLargeError("t", npoin=9),
+                BadJobError("b", path="x"),
+                ServerDrainingError("d")):
+        doc = json.loads(json.dumps(err.doc()))
+        assert doc["code"] == err.code
+        assert doc["transient"] is err.transient

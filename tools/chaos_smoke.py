@@ -813,7 +813,9 @@ def main_elastic(args) -> int:
     quality. Asserts the full observability contract on the way:
     ``world_shrink`` AND ``world_grow`` events with downtime seconds,
     and the ``obs_report --chaos`` post-mortem rendering the
-    world-size timeline."""
+    world-size timeline. A budget-permitting third run launches BELOW
+    target (``--initial-world 1 --world 3``) and asserts the grow is
+    BATCHED: one reformation straight to the target."""
     tmp = tempfile.mkdtemp(prefix="parmmg_chaos_el_")
     budget = StageBudget()
     failures = []
@@ -911,6 +913,32 @@ def main_elastic(args) -> int:
         else:
             print("[chaos-elastic] stage budget reached — reference "
                   "comparison skipped (absolute gates held)")
+
+        # --- batch grow (budget-permitting): a world launched BELOW
+        # target reaches it in ONE reformation — 1 -> 3 is one grow
+        # vote + one relaunch, not two single-step reforms
+        if budget.allows_another(fallback_estimate=240.0):
+            bobs = os.path.join(tmp, "obs_batch")
+            rc, btext = run_fleet("batchgrow", [
+                "--world", "3", "--devices-per-rank", "2",
+                "--initial-world", "1",
+                "--trace", bobs, "--capacity-file", cap,
+            ])
+            blabel = "batch grow (initial 1, target 3)"
+            assert rc == 0, (blabel, rc, btext[-2000:])
+            assert "FLEET_OK epochs=2 final_world=3" in btext, \
+                btext[-2000:]
+            assert "launching world=1" in btext \
+                and "launching world=3" in btext, btext[-2000:]
+            bev = _world_events(bobs)
+            assert bev["world_grow"], f"{blabel}: no world_grow event"
+            bg = bev["world_grow"][0]
+            assert (int(bg["old"]), int(bg["new"])) == (1, 3), bg
+            print(f"[chaos-elastic] {blabel} -> one reformation, "
+                  f"grow downtime {bg['downtime_s']}s")
+        else:
+            print("[chaos-elastic] stage budget reached — batch-grow "
+                  "scenario skipped")
         print("[chaos-elastic] notice -> commit -> shrink -> continue "
               "-> grow -> quality finish: complete, zero operator "
               "input")

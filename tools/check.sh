@@ -100,6 +100,27 @@ rc=$?
 echo "## obs-smoke rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
+# adaptation-service smoke: the mixed poisoned batch through the real
+# tools/serve.py process — typed too-large refusal, nan + deadline
+# members contained to their own typed terminals, SIGKILL mid-batch +
+# journal replay on restart with ZERO lost jobs, healthy batch-mates
+# bit-identical to a solo run, obs_report --serve rendering the
+# kill-spanning per-job timelines
+timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/serve_smoke.py
+rc=$?
+echo "## serve-smoke rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
+# serve-throughput bench: N warmed synthetic jobs of one size class on
+# a fake-GCS journal; the jobs_per_min record gates (higher-better)
+# against the committed PERF_DB baseline with the usual wide rel-floor
+timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/serve.py \
+    --bench 1 --jobs 4 --warmup 1 --classes tiny \
+    --db PERF_DB.jsonl --rel-floor 8
+rc=$?
+echo "## serve-bench rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
 # checkpoint-overlap bench vs a gs:// store (fake-GCS server in CI;
 # a real bucket when PMMGTPU_GCS_BUCKET + auth are present): records
 # ckpt_overlap_s per epoch size through the PARMMG_BENCH_CKPT_STORE

@@ -15,7 +15,11 @@ workers' typed exits into world reformations:
 - a **capacity-restored grow**: a world running below the target size
   publishes a grow request when `multihost.capacity_restored()` fires
   (``PMMGTPU_CAPACITY_FILE`` / callback / programmatic), every rank
-  exits 90, and the fleet relaunches at N+1 with a fresh member;
+  exits 90, and the fleet relaunches straight at the TARGET world in
+  one reformation (batch grow — each reformation costs a barrier +
+  checkpoint + repartition, so 1 → N is one relaunch, not N−1);
+  ``--initial-world`` launches below the target to exercise exactly
+  this edge;
 - a **whole-world preemption** (every rank 86/87 without a reform
   record) is a plain relaunch-and-resume at the same world size.
 
@@ -197,7 +201,12 @@ def main() -> int:
         description="elastic fleet supervisor (see module docstring)"
     )
     ap.add_argument("--world", type=int, default=2,
-                    help="initial AND target world size")
+                    help="target world size (and initial, unless "
+                         "--initial-world says otherwise)")
+    ap.add_argument("--initial-world", type=int, default=None,
+                    help="launch below the target: the first "
+                         "capacity-restored vote batch-grows straight "
+                         "to --world in ONE reformation")
     ap.add_argument("--min-world", type=int, default=1)
     ap.add_argument("--devices-per-rank", type=int, default=4)
     ap.add_argument("--ckpt", default=None,
@@ -226,8 +235,10 @@ def main() -> int:
         os.path.join(ROOT, "tests", "multihost_worker.py"), "--elastic",
     ]
 
-    members = list(range(args.world))
-    next_member = args.world
+    initial = (args.initial_world if args.initial_world is not None
+               else args.world)
+    members = list(range(initial))
+    next_member = initial
     history = []
     for epoch in range(args.max_epochs):
         reason = "launch" if epoch == 0 else history[-1]
@@ -285,12 +296,15 @@ def main() -> int:
             members = survivors
             history.append(f"shrink: members {departed} departed")
         elif "grow" in kinds:
-            grown = min(args.world, world + 1)
+            # batch grow: straight to the target in one relaunch —
+            # mirrors ElasticCoordinator's one-reformation grow vote
+            grown = args.world
             members = survivors + departed  # departed: none on grow
             while len(members) < grown:
                 members.append(next_member)
                 next_member += 1
-            history.append("grow: capacity restored")
+            history.append(f"grow: capacity restored, batch to "
+                           f"{grown}")
         else:
             # whole-world preemption without a reform record: plain
             # checkpoint-backed relaunch at the same size

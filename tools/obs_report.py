@@ -9,6 +9,11 @@ Usage:
                                   # rank (file-ordered JSONL, spans a
                                   # kill and its resume), merged with
                                   # the surviving metrics_rank*.json
+  python tools/obs_report.py <trace-dir> --serve 1  # per-job serving
+                                  # post-mortem: submitted -> running
+                                  # -> typed terminal timeline per job
+                                  # (file-ordered, spans server
+                                  # restarts), tenant/refusal rollups
   python tools/obs_report.py <trace-dir> --merge-metrics out.json
                                   # one world metrics doc from the
                                   # per-rank metrics_rank*.json files
@@ -58,6 +63,13 @@ def main():
             json.dump(merged, f, indent=1)
         print(f"merged {merged['world']} rank doc(s) -> "
               f"{flags['merge-metrics']}")
+        return 0
+    if flags.get("serve", "") not in ("", "0"):
+        if flags.get("json", "") not in ("", "0"):
+            print(json.dumps(obs_report.serve_summary(trace_dir),
+                             indent=1, default=str))
+            return 0
+        print(obs_report.render_serve(trace_dir))
         return 0
     if flags.get("chaos", "") not in ("", "0"):
         if flags.get("json", "") not in ("", "0"):

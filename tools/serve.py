@@ -1,0 +1,388 @@
+"""Adaptation-as-a-service process wrapper: spool server, solo, bench.
+
+The in-process serving brain is `parmmg_tpu.service.JobServer`; this
+tool is the PROCESS envelope around it — the pieces that only exist at
+the OS boundary:
+
+- **spool ingestion**: jobs arrive as ``<spool>/*.json`` JobSpec docs
+  (the transport-free stand-in for an RPC front): each file is
+  submitted and unlinked only AFTER the journal acknowledged it, so a
+  crash between publish and unlink re-ingests idempotently. Permanent
+  refusals move the file to ``<spool>/refused/`` next to a
+  ``.refusal.json`` carrying the typed response doc; transient ones
+  (queue-full) stay in place and retry next loop.
+- **drain on notice/SIGTERM**: the same two drain sources the fleet
+  workers honor (`PMMGTPU_PREEMPT_FILE` / maintenance notice via
+  `multihost.preemption_notice`, and SIGTERM) flip the server into
+  draining: in-flight work is requeued at its next phase boundary,
+  admission refuses with the typed ``draining`` code, and the process
+  exits :data:`~parmmg_tpu.failsafe.KILL_EXIT_CODE` (86) — the fleet
+  supervisor's restart-me signal. A SIGKILL needs no cooperation at
+  all: the journal replays on restart (``--replay`` is the default).
+- **journal store**: any `make_store` spec (directory, ``mem://``,
+  ``gs://``); `CheckpointIOError` exits 89 like every other tool.
+- **bench** (``--bench``): the serve throughput rung. Fake-GCS journal
+  (or a real bucket via ``PMMGTPU_GCS_BUCKET``), ``--warmup`` compile
+  pre-pay, N synthetic jobs of one size class, headline
+  ``jobs_per_min`` recorded as PERF_DB rung ``serve-<class>``.
+
+Usage::
+
+  python tools/serve.py --spool DIR [--journal SPEC] [--warmup 1]
+      [--idle-exit S] [--trace DIR]
+  python tools/serve.py --solo spec.json [--journal SPEC]
+  python tools/serve.py --bench 1 [--jobs 6] [--size-class tiny]
+      [--db PERF_DB.jsonl --update 1]
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPOOL_POLL_S = 0.2
+
+_SIGTERM = {"hit": False}
+
+
+def _on_sigterm(signum, frame):
+    _SIGTERM["hit"] = True
+
+
+def _classes_arg(spec):
+    from parmmg_tpu.service import DEFAULT_CLASSES
+
+    if not spec:
+        return DEFAULT_CLASSES
+    by_name = {c.name: c for c in DEFAULT_CLASSES}
+    out = []
+    for name in spec.split(","):
+        name = name.strip()
+        if name not in by_name:
+            raise SystemExit(
+                f"unknown size class {name!r} (have "
+                f"{','.join(by_name)})"
+            )
+        out.append(by_name[name])
+    return tuple(out)
+
+
+def _emit_exit(tracer_dir):
+    """Flush spans + counters so --serve reports see the whole story."""
+    from parmmg_tpu.obs import metrics as obs_metrics
+    from parmmg_tpu.obs import trace as obs_trace
+
+    obs_trace.get_tracer().flush()
+    if tracer_dir:
+        obs_metrics.registry().write(tracer_dir)
+
+
+def ingest_spool(server, spool):
+    """Submit every spec file in the spool; returns #admitted. Files
+    are unlinked only after the journal ack (idempotent re-ingest)."""
+    from parmmg_tpu.service import JobSpec, ServiceRefusal
+
+    admitted = 0
+    refused_dir = os.path.join(spool, "refused")
+    for name in sorted(os.listdir(spool)):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(spool, name)
+        try:
+            with open(path) as f:
+                spec = JobSpec.from_doc(json.load(f))
+        except (ValueError, TypeError, KeyError, OSError) as e:
+            os.makedirs(refused_dir, exist_ok=True)
+            doc = dict(error="BadJobError", code="bad-input",
+                       transient=False, message=str(e))
+            with open(os.path.join(refused_dir,
+                                   name + ".refusal.json"), "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(path, os.path.join(refused_dir, name))
+            print(f"[serve] {name}: unparseable spec -> refused/",
+                  file=sys.stderr)
+            continue
+        try:
+            server.submit(spec)
+        except ServiceRefusal as err:
+            if err.transient:
+                # queue-full / draining: the file IS the retry queue
+                continue
+            os.makedirs(refused_dir, exist_ok=True)
+            with open(os.path.join(refused_dir,
+                                   name + ".refusal.json"), "w") as f:
+                json.dump(err.doc(), f, indent=1)
+            os.replace(path, os.path.join(refused_dir, name))
+            print(f"[serve] {spec.job_id}: refused ({err.code})")
+            continue
+        os.unlink(path)
+        admitted += 1
+        print(f"[serve] admitted {spec.job_id} "
+              f"(tenant {spec.tenant})")
+    return admitted
+
+
+def drain_requested():
+    from parmmg_tpu.parallel import multihost
+
+    return _SIGTERM["hit"] or multihost.preemption_notice()
+
+
+def main_server(args, server):
+    """The serving loop: ingest spool -> run one batch -> repeat;
+    drain on notice/SIGTERM -> exit 86; idle-exit -> 0."""
+    from parmmg_tpu.failsafe import KILL_EXIT_CODE
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    os.makedirs(args.spool, exist_ok=True)
+    restored = server.replay()
+    if restored:
+        print(f"[serve] journal replay restored {restored} job(s)")
+    idle_since = time.monotonic()
+    while True:
+        if drain_requested():
+            server.request_drain()
+            print(f"[serve] drain requested -> exiting "
+                  f"{KILL_EXIT_CODE} (queue depth "
+                  f"{len(server.queue)})")
+            _emit_exit(args.trace)
+            return KILL_EXIT_CODE
+        ingest_spool(server, args.spool)
+        finished = server.run_once()
+        if server.draining:
+            # a mid-batch drain already requeued the in-flight job
+            print(f"[serve] drained mid-batch -> exiting "
+                  f"{KILL_EXIT_CODE}")
+            _emit_exit(args.trace)
+            return KILL_EXIT_CODE
+        if finished:
+            idle_since = time.monotonic()
+            continue
+        if server.idle():
+            if (args.idle_exit is not None
+                    and time.monotonic() - idle_since > args.idle_exit):
+                print("[serve] idle-exit: queue and spool empty")
+                _emit_exit(args.trace)
+                return 0
+            time.sleep(SPOOL_POLL_S)
+
+
+def main_solo(args, server):
+    """Run exactly one spec to a terminal state and print the
+    machine-readable JOB_RESULT line (the smoke's bit-identical
+    baseline comes from here)."""
+    from parmmg_tpu.service import ServiceRefusal, TERMINAL_STATES
+
+    with open(args.solo) as f:
+        spec_doc = json.load(f)
+    from parmmg_tpu.service import JobSpec
+
+    spec = JobSpec.from_doc(spec_doc)
+    try:
+        server.submit(spec)
+    except ServiceRefusal as err:
+        print(f"JOB_RESULT job={spec.job_id} state=rejected "
+              f"code={err.code} digest=- wall=0")
+        _emit_exit(args.trace)
+        return 0 if not err.transient else 3
+    while not server.idle():
+        server.run_once()
+    doc = server.journal.load(spec.job_id) or {}
+    state = doc.get("state", "?")
+    result = doc.get("result") or {}
+    error = doc.get("error") or {}
+    code = "ok" if state == "done" else error.get("code", "?")
+    print(f"JOB_RESULT job={spec.job_id} state={state} code={code} "
+          f"digest={result.get('digest', '-')} "
+          f"wall={result.get('wall_s', 0)}")
+    _emit_exit(args.trace)
+    return 0 if state in TERMINAL_STATES else 1
+
+
+def resolve_bench_store():
+    """(spec, backend, cleanup): real bucket when PMMGTPU_GCS_BUCKET
+    is set, else a hermetic fake-GCS server (the ckpt_bench idiom)."""
+    bucket = os.environ.get("PMMGTPU_GCS_BUCKET")
+    if bucket:
+        prefix = f"parmmg-serve-bench/{os.getpid()}-{int(time.time())}"
+        return f"gs://{bucket}/{prefix}", "gcs", (lambda: None)
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    from fake_gcs import FakeGCS
+
+    srv = FakeGCS()
+    base = srv.start()
+    os.environ["PMMGTPU_GCS_ENDPOINT"] = base
+    os.environ["PMMGTPU_GCS_AUTH"] = "anon"
+    return "gs://parmmg-bench/serve", "gcs-fake", srv.stop
+
+
+def main_bench(args):
+    """Serve-throughput rung: N synthetic jobs of one class through a
+    warmed server on a (fake-)GCS journal; headline jobs_per_min."""
+    import tempfile
+
+    import jax
+
+    from parmmg_tpu.io import medit
+    from parmmg_tpu.io.ckpt_store import make_store
+    from parmmg_tpu.obs import history as obs_history
+    from parmmg_tpu.service import JobServer, JobSpec
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    classes = _classes_arg(args.size_class)
+    cls = classes[0]
+    spec, backend, cleanup = resolve_bench_store()
+    print(f"[serve-bench] journal {spec} (backend {backend})")
+    try:
+        store = make_store(spec)
+        server = JobServer(store, classes=classes,
+                           queue_cap=max(args.jobs, 4),
+                           batch_max=args.batch_max)
+        warmup_s = server.warmup() if args.warmup else 0.0
+        if args.warmup:
+            print(f"[serve-bench] warmup {warmup_s}s "
+                  f"({len(classes)} class(es))")
+        with tempfile.TemporaryDirectory() as tmp:
+            inmesh = os.path.join(tmp, "bench_cube.mesh")
+            medit.save_mesh(unit_cube_mesh(2), inmesh)
+            for i in range(args.jobs):
+                server.submit(JobSpec(
+                    job_id=f"bench-{i:03d}", inmesh=inmesh,
+                    tenant=f"tenant{i % 2}", hsiz=0.45, niter=1,
+                ))
+            t0 = time.perf_counter()
+            while not server.idle():
+                server.run_once()
+            wall = time.perf_counter() - t0
+        docs = server.journal.jobs()
+        done = sum(1 for d in docs if d.get("state") == "done")
+        if done != args.jobs:
+            print(f"[serve-bench] only {done}/{args.jobs} jobs done",
+                  file=sys.stderr)
+            return 1
+        jpm = 60.0 * args.jobs / wall if wall > 0 else 0.0
+        payload = dict(
+            metric="jobs_per_min",
+            value=round(jpm, 3),
+            jobs=args.jobs,
+            wall_s=round(wall, 4),
+            warmup_s=round(warmup_s, 3),
+            size_class=cls.name,
+            batch_max=args.batch_max,
+            backend=backend,
+            platform=jax.devices()[0].platform,
+        )
+        rec = obs_history.make_record(payload, rung=f"serve-{cls.name}")
+        print(f"[serve-bench] {args.jobs} jobs in {payload['wall_s']}s"
+              f" -> {payload['value']} jobs/min "
+              f"(warmup {payload['warmup_s']}s)")
+    finally:
+        cleanup()
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(dict(records=[rec]), f, indent=1)
+        print(f"[serve-bench] record -> {args.json}")
+    if args.db:
+        db = obs_history.load_db(args.db)
+        res = obs_history.gate(db, rec, rel_floor=args.rel_floor)
+        for line in res.lines():
+            print(line)
+        if args.update not in ("", "0"):
+            obs_history.append_db(args.db, rec)
+            print(f"[serve-bench] record appended to {args.db}")
+        if not res.ok:
+            return obs_history.REGRESSION_EXIT
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="parmmg-tpu adaptation job server"
+    )
+    ap.add_argument("--spool", default=None,
+                    help="server mode: ingest JobSpec JSON files here")
+    ap.add_argument("--solo", default=None,
+                    help="run ONE spec file to a terminal state")
+    ap.add_argument("--bench", default="0",
+                    help="serve-throughput bench mode")
+    ap.add_argument("--journal", default=None,
+                    help="journal store spec (dir, mem://, gs://)")
+    ap.add_argument("--warmup", default="0",
+                    help="pre-pay per-class compiles before serving")
+    ap.add_argument("--classes", dest="size_class", default="",
+                    help="comma subset of the size-class table")
+    ap.add_argument("--queue-cap", type=int, default=16)
+    ap.add_argument("--batch-max", type=int, default=4)
+    ap.add_argument("--idle-exit", type=float, default=None,
+                    help="exit 0 after S idle seconds (smoke mode)")
+    ap.add_argument("--trace", default=None,
+                    help="PMMGTPU_TRACE dir for spans/events/counters")
+    ap.add_argument("--jobs", type=int, default=6,
+                    help="bench: synthetic job count")
+    ap.add_argument("--json", default=None,
+                    help="bench: write the enveloped record here")
+    ap.add_argument("--db", default=None,
+                    help="bench: PERF_DB.jsonl to gate against")
+    ap.add_argument("--update", default="0",
+                    help="bench: append the record to --db")
+    ap.add_argument("--rel-floor", type=float, default=0.5,
+                    help="bench: gate tolerance floor")
+    args = ap.parse_args()
+    args.warmup = args.warmup not in ("", "0")
+
+    if args.trace:
+        os.environ["PMMGTPU_TRACE"] = args.trace
+
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _accel in ("axon", "tpu", "cuda", "rocm"):
+        _xb._backend_factories.pop(_accel, None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from parmmg_tpu.failsafe import CKPT_IO_EXIT_CODE
+    from parmmg_tpu.io.ckpt_store import CheckpointIOError, make_store
+
+    try:
+        if args.bench not in ("", "0"):
+            return main_bench(args)
+        if not args.journal:
+            raise SystemExit("--journal STORE is required "
+                             "(or use --bench)")
+        from parmmg_tpu.service import JobServer
+
+        store = make_store(args.journal)
+        server = JobServer(store, classes=_classes_arg(args.size_class),
+                           queue_cap=args.queue_cap,
+                           batch_max=args.batch_max)
+        if args.warmup:
+            s = server.warmup()
+            print(f"[serve] warmup {s}s")
+        if args.solo:
+            return main_solo(args, server)
+        if not args.spool:
+            raise SystemExit("need --spool DIR, --solo SPEC or --bench")
+        return main_server(args, server)
+    except CheckpointIOError as e:
+        print(f"[serve] journal store I/O failure: {e}",
+              file=sys.stderr)
+        return CKPT_IO_EXIT_CODE
+
+
+if __name__ == "__main__":
+    sys.exit(main())

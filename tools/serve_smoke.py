@@ -1,0 +1,296 @@
+"""Adaptation-service acceptance smoke: the mixed poisoned batch.
+
+End-to-end proof of the four serving contracts, through the real
+`tools/serve.py` process (no in-process shortcuts):
+
+1. **typed admission**: an oversized submission (header says 50k
+   vertices) is refused ``too-large`` for the cost of a text scan and
+   journaled ``rejected`` — a typed terminal, not an exception;
+2. **blast-radius isolation**: one batch carries a healthy job, a
+   nan-poisoned job (`JobSpec.faults`, the chaos grammar) and a
+   deadline-exceeded job. The poisoned members end ``failed`` /
+   ``deadline`` with machine-readable error docs; the healthy members
+   end ``done`` with digests BIT-IDENTICAL to a solo run of the same
+   input (the strictest no-cross-contamination statement);
+3. **crash-safe journal**: the server is SIGKILLed mid-batch (the
+   ``PMMGTPU_SERVE_TEST_SLEEP_S`` window guarantees ≥1 terminal and
+   exactly one ``running`` record at kill time), restarted on the same
+   journal, and must replay to completion — every admitted job reaches
+   a typed terminal state, zero lost, the killed attempt visible as
+   ``attempts >= 2`` on the in-flight job;
+4. **observability**: the shared trace dir spans both server processes
+   (JSONL appends), and ``obs_report --serve`` renders every job's
+   submitted → running → terminal timeline across the kill.
+
+Exit 0 = all gates green; 1 = any violated (with a FAILURES list).
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+SERVE = os.path.join(ROOT, "tools", "serve.py")
+POLL_S = 0.1
+KILL_WINDOW_SLEEP_S = "2.0"
+STAGE_TIMEOUT = 600
+
+TERMINAL = {"done", "failed", "deadline", "rejected", "cancelled"}
+
+
+def _env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=ROOT,
+               TF_CPP_MIN_LOG_LEVEL="3")
+    return env
+
+
+def write_inputs(tmp):
+    """The healthy cube mesh (a real adaptable input) and the
+    oversized IMPOSTOR: a text header declaring 50k vertices — the
+    admission peek must refuse it without ever loading it."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from parmmg_tpu.io import medit
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    cube = os.path.join(tmp, "cube.mesh")
+    medit.save_mesh(unit_cube_mesh(2), cube)
+    big = os.path.join(tmp, "big.mesh")
+    with open(big, "w") as f:
+        f.write("MeshVersionFormatted 2\nDimension\n3\n"
+                "Vertices\n50000\nTetrahedra\n200000\nEnd\n")
+    return cube, big
+
+
+def journal_docs(journal_dir):
+    docs = {}
+    if not os.path.isdir(journal_dir):
+        return docs
+    for name in os.listdir(journal_dir):
+        if not (name.startswith("job_") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(journal_dir, name)) as f:
+                doc = json.load(f)
+            docs[doc["job_id"]] = doc
+        except (OSError, ValueError, KeyError):
+            continue
+    return docs
+
+
+def spool_spec(spool, doc):
+    path = os.path.join(spool, f"{doc['job_id']}.json.tmp")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    os.replace(path, path[:-len(".tmp")])
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="parmmg_serve_smoke_")
+    failures = []
+    try:
+        t_start = time.monotonic()
+        cube, big = write_inputs(tmp)
+        journal = os.path.join(tmp, "journal")
+        spool = os.path.join(tmp, "spool")
+        obs = os.path.join(tmp, "obs")
+        os.makedirs(spool, exist_ok=True)
+
+        # --- solo baseline: the digest every batched healthy job
+        # must reproduce bit for bit
+        solo_spec = os.path.join(tmp, "solo.json")
+        with open(solo_spec, "w") as f:
+            json.dump(dict(job_id="solo", inmesh=cube, hsiz=0.45,
+                           niter=1), f)
+        p = subprocess.run(
+            [sys.executable, SERVE, "--solo", solo_spec,
+             "--journal", os.path.join(tmp, "journal_solo")],
+            env=_env(), capture_output=True, text=True,
+            timeout=STAGE_TIMEOUT, cwd=ROOT,
+        )
+        line = next((ln for ln in p.stdout.splitlines()
+                     if ln.startswith("JOB_RESULT")), "")
+        fields = dict(tok.split("=", 1) for tok in line.split()[1:])
+        if p.returncode != 0 or fields.get("state") != "done":
+            failures.append(f"solo baseline: rc={p.returncode} "
+                            f"line={line!r}")
+            raise SystemExit(1)
+        solo_digest = fields["digest"]
+        print(f"[serve-smoke] solo baseline done "
+              f"(digest {solo_digest}, "
+              f"{time.monotonic() - t_start:.1f}s)")
+
+        # --- the mixed batch: 2 healthy, 1 nan-poisoned, 1 deadline,
+        # 1 oversized — spooled before the server starts so they land
+        # in ONE class-homogeneous batch (batch_max=4; the oversized
+        # one is refused at admission and never queued)
+        jobs = [
+            dict(job_id="h1", inmesh=cube, tenant="acme", niter=1),
+            dict(job_id="e", inmesh=cube, tenant="evil", niter=1,
+                 faults="it0:remesh:nan"),
+            dict(job_id="d", inmesh=cube, tenant="slow", niter=1,
+                 deadline_s=1e-4),
+            dict(job_id="h2", inmesh=cube, tenant="acme", niter=1),
+            dict(job_id="o", inmesh=big, tenant="big"),
+        ]
+        for doc in jobs:
+            spool_spec(spool, doc)
+
+        env = _env()
+        env["PMMGTPU_SERVE_TEST_SLEEP_S"] = KILL_WINDOW_SLEEP_S
+        log1 = open(os.path.join(tmp, "server1.log"), "w")
+        srv = subprocess.Popen(
+            [sys.executable, SERVE, "--spool", spool,
+             "--journal", journal, "--trace", obs,
+             "--batch-max", "4", "--idle-exit", "300"],
+            env=env, stdout=log1, stderr=subprocess.STDOUT, cwd=ROOT,
+        )
+
+        # --- SIGKILL mid-batch: wait for >=1 terminal AND one
+        # `running` record, then kill with no warning whatsoever
+        deadline = time.monotonic() + STAGE_TIMEOUT
+        killed = False
+        while time.monotonic() < deadline and srv.poll() is None:
+            docs = journal_docs(journal)
+            states = {j: d.get("state") for j, d in docs.items()}
+            n_term = sum(1 for s in states.values() if s in TERMINAL)
+            running = [j for j, s in states.items() if s == "running"]
+            if n_term >= 1 and running:
+                os.kill(srv.pid, signal.SIGKILL)
+                srv.wait()
+                killed = True
+                print(f"[serve-smoke] SIGKILL mid-batch: "
+                      f"{n_term} terminal, {running[0]} running "
+                      f"(states {states})")
+                break
+            time.sleep(POLL_S)
+        if not killed:
+            failures.append(
+                f"never reached the kill window (server rc "
+                f"{srv.poll()}, journal "
+                f"{ {j: d.get('state') for j, d in journal_docs(journal).items()} })"
+            )
+            if srv.poll() is None:
+                srv.kill()
+                srv.wait()
+            raise SystemExit(1)
+        kill_states = {j: d.get("state")
+                       for j, d in journal_docs(journal).items()}
+        in_flight = [j for j, s in kill_states.items()
+                     if s == "running"]
+
+        # --- restart on the same journal + trace dir: the replay
+        # must finish EVERY job typed, no operator input
+        log2 = open(os.path.join(tmp, "server2.log"), "w")
+        srv2 = subprocess.run(
+            [sys.executable, SERVE, "--spool", spool,
+             "--journal", journal, "--trace", obs,
+             "--batch-max", "4", "--idle-exit", "5"],
+            env=_env(), stdout=log2, stderr=subprocess.STDOUT,
+            timeout=STAGE_TIMEOUT, cwd=ROOT,
+        )
+        if srv2.returncode != 0:
+            failures.append(f"restarted server exit "
+                            f"{srv2.returncode} (wanted 0 via "
+                            "idle-exit)")
+
+        docs = journal_docs(journal)
+        expect = dict(h1="done", h2="done", e="failed", d="deadline",
+                      o="rejected")
+        for jid, want in expect.items():
+            got = docs.get(jid, {}).get("state")
+            if got != want:
+                failures.append(f"job {jid}: state {got!r}, wanted "
+                                f"{want!r}")
+        # zero lost: every journaled job terminal
+        for jid, doc in docs.items():
+            if doc.get("state") not in TERMINAL:
+                failures.append(f"job {jid}: non-terminal "
+                                f"{doc.get('state')!r} after replay")
+        # healthy batch-mates bit-identical to the solo run
+        for jid in ("h1", "h2"):
+            dig = (docs.get(jid, {}).get("result") or {}).get("digest")
+            if dig != solo_digest:
+                failures.append(
+                    f"job {jid}: digest {dig} != solo {solo_digest} "
+                    "(batch-mate output contaminated)"
+                )
+        # typed error docs on the poisoned members
+        e_err = docs.get("e", {}).get("error") or {}
+        if "Numerical" not in str(e_err.get("type", "")):
+            failures.append(f"job e: error doc {e_err} lacks the "
+                            "typed NumericalError")
+        d_err = docs.get("d", {}).get("error") or {}
+        if d_err.get("code") != "deadline":
+            failures.append(f"job d: error doc {d_err} lacks "
+                            "code=deadline")
+        o_err = docs.get("o", {}).get("error") or {}
+        if o_err.get("code") != "too-large":
+            failures.append(f"job o: error doc {o_err} lacks "
+                            "code=too-large")
+        # the killed in-flight job re-ran: its attempt count says so
+        for jid in in_flight:
+            att = int(docs.get(jid, {}).get("attempts", 0))
+            if att < 2:
+                failures.append(f"job {jid}: killed while running but "
+                                f"attempts={att} (no replay attempt)")
+        if not failures:
+            print(f"[serve-smoke] mixed batch: "
+                  + "  ".join(f"{j}->{docs[j]['state']}"
+                              for j in sorted(expect)))
+
+        # --- the per-job report must render the cross-restart story
+        p = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "tools", "obs_report.py"),
+             obs, "--serve", "1"],
+            env=_env(), capture_output=True, text=True, timeout=120,
+            cwd=ROOT,
+        )
+        rep = p.stdout
+        if p.returncode != 0:
+            failures.append(f"obs_report --serve exit {p.returncode}")
+        for needle in ("serve post-mortem", "job h1", "job e",
+                       "job d", "tenant acme"):
+            if needle not in rep:
+                failures.append(f"--serve report lacks {needle!r}")
+        for jid in in_flight:
+            if f"job {jid}" in rep and "job_requeued" not in rep \
+                    and "attempt=2" not in rep:
+                failures.append(
+                    f"--serve report: no replay evidence for the "
+                    f"killed job {jid}"
+                )
+        if not failures:
+            print("[serve-smoke] --serve post-mortem renders the "
+                  "kill-spanning timelines")
+            print(f"[serve-smoke] OK: admission refusals, poisoned-"
+                  f"batch containment, SIGKILL+replay, bit-identical "
+                  f"survivors ({time.monotonic() - t_start:.1f}s)")
+            return 0
+    except SystemExit:
+        pass
+    except subprocess.TimeoutExpired as e:
+        failures.append(f"stage timeout: {e}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("\n[serve-smoke] FAILURES:")
+    for f in failures:
+        print(" -", f)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
