@@ -103,6 +103,15 @@ def _envelope(rec, cfg):
     return obs_history.make_record(rec, rung=_rung_for_cfg(cfg))
 
 
+def _total_compile_s() -> float:
+    """Run-level AOT compile seconds from the obs cost collector (the
+    per-entry-point `compile_s` gauges summed at the source) — 0.0 when
+    the run was untraced and no capture happened."""
+    from parmmg_tpu.obs import costs as obs_costs
+
+    return obs_costs.collector().total_compile_s()
+
+
 def partial_record(cfg, died_in=None, reason="stage deadline"):
     """The committed-partial BENCH line: parseable by every consumer of
     the full record, explicitly marked, enveloped like the full record,
@@ -402,6 +411,10 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         # staging writer (0.0 when the run checkpoints synchronously or
         # not at all — see PARMMG_BENCH_CKPT above)
         "ckpt_overlap_s": float(info.get("ckpt_overlap_s", 0.0)),
+        # AOT lower+compile seconds this process paid (0.0 on untraced
+        # runs — the cost capture is trace-gated): the wall/roofline
+        # comparisons can exclude compile instead of warning about it
+        "compile_s": _total_compile_s(),
         # Pallas kernel subsystem state of THIS measurement — on/off
         # also keys the rung (…-pk) so the perf gate never mixes
         # kernel-on and kernel-off baselines
@@ -455,6 +468,11 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
               r.get("n_active", 0) / max(r.get("n_unique", 1), 1))
         for r in info["history"] if "n_unique" in r
     ]
+    # per-iteration load-imbalance factor (live-tets max/mean across
+    # shards, from the driver history): the BENCH record carries the
+    # WORST iteration so the perf gate can ratchet balance, and the
+    # whole series for the report
+    imb = [r["imbalance"] for r in info["history"] if "imbalance" in r]
 
     _note_phase("dist-converged-probe")
     dist_cfg = dict(dist=True, n=n, hsiz=hsiz, nparts=nparts,
@@ -495,6 +513,12 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
         "qmin": round(float(h.qmin), 5),
         "qavg": round(float(h.qavg), 5),
         "sweep_active_fraction": [round(x, 4) for x in saf],
+        "imbalance": round(max(imb), 4) if imb else 0.0,
+        "imbalance_series": [round(x, 4) for x in imb],
+        # AOT lower+compile seconds this process paid (0.0 on untraced
+        # runs — the cost capture is trace-gated), so wall comparisons
+        # can exclude compile instead of warning about it
+        "compile_s": _total_compile_s(),
         # the acceptance triple: dist frontier must be within 1.5x of
         # the centralized frontier sweep at equal tet count (was ~10x
         # full-table)

@@ -290,12 +290,13 @@ def _rec_from_stats(s, stats) -> dict:
 
     na = g(stats.n_active).astype(np.int64)
     nu = g(stats.n_unique).astype(np.int64)
+    shard_ne = g(s.tmask).sum(axis=1).astype(np.int64)
     return dict(
         nsplit=int(g(stats.nsplit).sum()),
         ncollapse=int(g(stats.ncollapse).sum()),
         nswap=int(g(stats.nswap).sum()),
         nmoved=int(g(stats.nmoved).sum()),
-        ne=int(g(s.tmask).sum()),
+        ne=int(shard_ne.sum()),
         np=int(g(s.vmask).sum()),
         n_unique=int(nu.max()),
         capped=bool(g(stats.split_capped).any()),
@@ -307,6 +308,14 @@ def _rec_from_stats(s, stats) -> dict:
             round(float(a) / max(int(u), 1), 4)
             for a, u in zip(na.tolist(), nu.tolist())
         ],
+        # load-imbalance accounting: live tets per shard and the
+        # max/mean factor (1.0 = perfectly even — the same shape as
+        # the GRPS_RATIO rebalance trigger, so the report, the BENCH
+        # record and the balance branch all speak one number)
+        shard_ne=[int(x) for x in shard_ne.tolist()],
+        imbalance=round(
+            float(shard_ne.max()) / max(float(shard_ne.mean()), 1.0), 4
+        ),
     )
 
 
@@ -320,12 +329,19 @@ def _drained_rec(st: Mesh, history: List[dict]) -> dict:
         if r.get("n_unique"):
             last_nu = int(r["n_unique"])
             break
+    shard_ne = np.asarray(
+        jax.device_get(jnp.sum(st.tmask, axis=1))
+    ).astype(np.int64)
     return dict(
         nsplit=0, ncollapse=0, nswap=0, nmoved=0,
-        ne=int(jax.device_get(jnp.sum(st.tmask))),
+        ne=int(shard_ne.sum()),
         np=int(jax.device_get(jnp.sum(st.vmask))),
         n_unique=last_nu, capped=False, n_active=0,
         active_fraction=0.0, shard_active=[0.0] * D,
+        shard_ne=[int(x) for x in shard_ne.tolist()],
+        imbalance=round(
+            float(shard_ne.max()) / max(float(shard_ne.mean()), 1.0), 4
+        ),
         skipped=True,
     )
 
@@ -827,6 +843,18 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
     if fs is None:
         fs = failsafe.harness(opts, driver="distributed")
     tr = obs_trace.get_tracer()
+    # one timebase for the world: estimate this rank's clock offset to
+    # rank 0 (median-of-K barrier exchange) and persist it in the trace
+    # clock header, so obs.dist can merge the rank timelines. A resumed
+    # run re-enters here with a fresh tracer and a RESTARTED clock —
+    # its new segment gets its own offset. Collective: every process
+    # reaches this boundary before any iteration work.
+    from ..parallel import multihost
+
+    if tr.enabled or multihost.is_multiprocess():
+        multihost.sync_tracer_clock(
+            tr, timeout=getattr(opts, "watchdog_timeout", None)
+        )
     nparts = opts.nparts
     emult = [emult0 if emult0 is not None else 1.6]
     icap = icap0

@@ -19,9 +19,13 @@ reference, extended to attribute time inside jitted/SPMD regions):
   backfill importer, and the noise-aware regression gate behind
   `tools/perf_gate.py`.
 - `obs.report` — the post-mortem renderer behind `tools/obs_report.py`.
+- `obs.dist` — the cross-rank performance observatory (round 11):
+  clock-aligned merged timelines, collective straggler/transfer
+  decomposition, load-imbalance accounting and per-iteration
+  critical-path extraction behind ``obs_report --dist``.
 """
 
-from . import costs, history, metrics, report, trace  # noqa: F401
+from . import costs, dist, history, metrics, report, trace  # noqa: F401
 from .metrics import MetricsRegistry, merge_rank_docs, registry  # noqa: F401
 from .trace import (  # noqa: F401
     NullTracer,

@@ -47,6 +47,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Dict, List, Optional
 
 __all__ = [
@@ -135,11 +136,20 @@ def cost_doc(fn, args=(), kwargs=None) -> dict:
     """Static XLA cost/memory analysis of one jitted callable at the
     given args: ``fn.lower(*args).compile()`` then ``cost_analysis()``
     + ``memory_analysis()``. Lowering traces but never executes — safe
-    to call with buffers the subsequent real dispatch will donate."""
+    to call with buffers the subsequent real dispatch will donate.
+
+    The wall spent in lower+compile is recorded as ``compile_s`` in
+    the doc (round 11): the AOT capture pays the SAME compile the
+    first real dispatch would, so this measures each entry point's
+    compile cost without folding it into any measured span mean — the
+    data that closes the PR-8 "cold-cache folds compile into the span
+    mean" caveat."""
     import jax
 
+    t0 = time.perf_counter()
     lowered = fn.lower(*args, **(kwargs or {}))
     comp = lowered.compile()
+    compile_s = time.perf_counter() - t0
     ca = comp.cost_analysis()
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else {}
@@ -149,6 +159,7 @@ def cost_doc(fn, args=(), kwargs=None) -> dict:
         bytes_accessed=float(ca.get("bytes accessed", 0.0)),
         transcendentals=float(ca.get("transcendentals", 0.0)),
         platform=jax.devices()[0].platform,
+        compile_s=round(compile_s, 6),
     )
     ma = comp.memory_analysis()
     if ma is not None:
@@ -190,6 +201,7 @@ class CostCollector:
         self._lock = threading.Lock()
         self._docs: Dict[str, dict] = {}
         self._seen: set = set()
+        self._compile_s = 0.0
 
     def capture(self, name: str, fn, args=(), kwargs=None) -> None:
         key = (name, _signature(args, kwargs))
@@ -202,7 +214,16 @@ class CostCollector:
         except Exception as exc:  # never fail the run for analytics
             doc = dict(flops=0.0, bytes_accessed=0.0,
                        error=f"{type(exc).__name__}: {exc}")
+        if "compile_s" in doc:
+            # per-entry-point compile gauge (summed over shape
+            # variants): lets the bench/report exclude compile from
+            # wall comparisons instead of warning about it
+            from . import metrics as _metrics
+
+            g = _metrics.registry().gauge(f"compile_s/{name}")
+            g.set(round(g.value + doc["compile_s"], 6))
         with self._lock:
+            self._compile_s += doc.get("compile_s", 0.0)
             prev = self._docs.get(name)
             if prev is None:
                 doc["variants"] = 1
@@ -219,10 +240,19 @@ class CostCollector:
         with self._lock:
             return {k: dict(v) for k, v in self._docs.items()}
 
+    def total_compile_s(self) -> float:
+        """Total AOT lower+compile seconds across every capture this
+        process paid (all names, ALL shape variants — not just the
+        dominant doc per name): the run-level ``compile_s`` BENCH
+        field."""
+        with self._lock:
+            return round(self._compile_s, 6)
+
     def reset(self) -> None:
         with self._lock:
             self._docs.clear()
             self._seen.clear()
+            self._compile_s = 0.0
 
     def write(self, dirpath: str, rank: int = 0) -> Optional[str]:
         """Atomic per-rank cost-doc file in the trace directory (None

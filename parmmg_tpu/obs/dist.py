@@ -1,0 +1,562 @@
+"""Cross-rank performance observatory (round 11).
+
+The single-rank report (`obs.report`) answers "where did THIS process
+spend its wall"; this module answers the question the distributed perf
+arc is blocked on — *which rank's which phase gated the world*. The
+reference's schedule lives or dies on balance (the remesh/repartition
+loop exists to keep per-group work even, `PMMG_loadBalancing`), and
+BENCH_r06's ~500x distributed gap cannot be attributed from per-rank
+wall numbers alone: per-rank clocks are unaligned, collective waits
+fold stragglers' lag into everyone's wall, and migration stalls hide
+inside one span mean.
+
+Four lenses over one trace directory, all host/stdlib (never touches
+the accelerator):
+
+- **clock alignment** (:func:`rank_segments` / :func:`aligned_
+  timelines`): every ``events_rank<r>.jsonl`` starts each tracer life
+  with a ``type="clock"`` header (``t0_us`` = the tracer's monotonic
+  origin) and `multihost.sync_tracer_clock` appends the rank's
+  median-of-K offset to rank 0's clock. Aligned time of a record is
+  ``t0_us + ts_us + offset_us`` — one timebase for the world, per
+  SEGMENT, so a resume-restarted clock (fresh tracer appending to the
+  same file) re-aligns instead of interleaving;
+- **collective decomposition** (:func:`collective_instances` /
+  :func:`decompose_collectives`): the ``coll:<name>`` spans
+  (`multihost._coll_span`) and the ``migrate_exchange`` device-spans
+  are matched across ranks by per-name sequence — dispatch order is
+  identical on every process — and each world instance splits into
+  straggler lag (last entrant minus first entrant: time the early
+  ranks burned waiting) vs true transfer (last entrant to last exit:
+  time the collective itself cost);
+- **load imbalance**: the distributed history records carry
+  ``shard_ne``/``imbalance`` (live-tets max/mean), mirrored into the
+  ``work/*`` gauges by `metrics.record_sweep` and into the BENCH/
+  PERF_DB envelope by `bench.run_dist` (gate key ``imbalance``,
+  lower-better);
+- **critical path** (:func:`critical_path`): per iteration, walk the
+  world-matched collectives in completion order — the segment between
+  two sync points is gated by the rank that entered the closing
+  collective LAST, and the gating phase is whatever span that rank was
+  inside — rendered as a table plus a Perfetto-loadable merged trace
+  (:func:`write_merged_trace`).
+
+CLI: ``python tools/obs_report.py <dir> --dist 1`` (``--json 1`` for
+the structured document); asserted end to end by
+``tools/dist_obs_smoke.py`` (the check.sh ``dist-obs`` stage).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional
+
+from . import metrics as metrics_mod
+
+__all__ = [
+    "rank_segments", "aligned_timelines", "collective_instances",
+    "decompose_collectives", "critical_path", "write_merged_trace",
+    "dist_summary", "render_dist",
+]
+
+# span names treated as world-synchronous collectives: the nth
+# occurrence on each rank is the same world instance
+_COLL_PREFIX = "coll:"
+_COLL_NAMES = ("migrate_exchange",)
+
+
+def _is_coll(name: str) -> bool:
+    return name.startswith(_COLL_PREFIX) or name in _COLL_NAMES
+
+
+# ---------------------------------------------------------------------------
+# clock segments + alignment
+# ---------------------------------------------------------------------------
+
+
+def rank_segments(dirpath: str) -> Dict[int, List[dict]]:
+    """Per-rank clock segments of a trace directory, file-ordered.
+
+    Each segment is one tracer life: ``{"t0_us", "offset_us",
+    "err_us", "rounds", "aligned", "records"}``. A ``type="clock"``
+    record with ``restart`` opens a new segment; a non-restart clock
+    record (the persisted offset estimate) updates the CURRENT
+    segment. Records preceding any header (pre-round-11 files) land in
+    an implicit unaligned segment with ``t0_us=0``. Tolerates
+    truncated final lines (a process killed mid-write)."""
+    out: Dict[int, List[dict]] = {}
+    for path in sorted(glob.glob(
+            os.path.join(dirpath, "events_rank*.jsonl"))):
+        stem = os.path.basename(path)[len("events_rank"):-len(".jsonl")]
+        try:
+            rank = int(stem)
+        except ValueError:
+            continue
+        segs: List[dict] = []
+
+        def seg(t0_us=0.0, offset_us=0.0, aligned=False):
+            s = dict(t0_us=float(t0_us), offset_us=float(offset_us),
+                     err_us=0.0, rounds=0, aligned=bool(aligned),
+                     records=[])
+            segs.append(s)
+            return s
+
+        cur: Optional[dict] = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("type") == "clock":
+                    if rec.get("restart") or cur is None:
+                        cur = seg(t0_us=rec.get("t0_us", 0.0),
+                                  offset_us=rec.get("offset_us", 0.0))
+                    else:
+                        cur["offset_us"] = float(
+                            rec.get("offset_us", 0.0)
+                        )
+                        cur["err_us"] = float(rec.get("err_us", 0.0))
+                        cur["rounds"] = int(rec.get("rounds", 0))
+                        cur["aligned"] = True
+                    continue
+                if cur is None:
+                    cur = seg()
+                cur["records"].append(rec)
+        out[rank] = segs
+    return out
+
+
+def aligned_timelines(dirpath: str) -> Dict[int, List[dict]]:
+    """Per-rank span/event records with aligned timestamps applied:
+    every record gains ``ats_us`` (= segment ``t0_us + ts_us +
+    offset_us`` — rank 0's timebase) and spans gain ``aend_us``.
+    Sorted by aligned START time: the JSONL writes a span at its
+    EXIT, so file order is completion order — sorting restores
+    dispatch order (what occurrence matching needs) and, with correct
+    offsets, keeps segment-2 records after segment-1 records even
+    across a mid-file clock restart."""
+    out: Dict[int, List[dict]] = {}
+    for rank, segs in rank_segments(dirpath).items():
+        recs: List[dict] = []
+        for s in segs:
+            base = s["t0_us"] + s["offset_us"]
+            for r in s["records"]:
+                r = dict(r)
+                r["ats_us"] = base + float(r.get("ts_us", 0))
+                if r.get("type") == "span":
+                    r["aend_us"] = r["ats_us"] + float(
+                        r.get("dur_us", 0)
+                    )
+                recs.append(r)
+        recs.sort(key=lambda r: r["ats_us"])
+        out[rank] = recs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# collective skew / straggler decomposition
+# ---------------------------------------------------------------------------
+
+
+def collective_instances(
+        timelines: Dict[int, List[dict]]) -> List[dict]:
+    """World-matched collective instances from aligned timelines.
+
+    Spans named ``coll:*`` (and ``migrate_exchange``) are matched by
+    ``(name, seq)`` — ``args.seq`` when the emitter recorded one
+    (`multihost._coll_span`), else the rank's occurrence index of that
+    name. Each instance decomposes into::
+
+      lag_us      last entrant - first entrant  (straggler lag: what
+                  the early ranks burned waiting at the rendezvous)
+      transfer_us last exit - last entrant      (the collective's own
+                  cost once everyone arrived)
+      straggler   the last-entering rank
+
+    Sorted by world enter time."""
+    inst: Dict[tuple, dict] = {}
+    for rank, recs in timelines.items():
+        occ: Dict[str, int] = {}
+        for r in recs:
+            if r.get("type") != "span" or not _is_coll(r.get("name", "")):
+                continue
+            name = r["name"]
+            n = occ.get(name, 0)
+            occ[name] = n + 1
+            args = r.get("args") or {}
+            seq = args.get("seq", n)
+            key = (name, seq)
+            it = inst.setdefault(key, dict(
+                name=name, seq=seq, enter_us={}, exit_us={},
+                tag=args.get("tag"), it=args.get("it"),
+            ))
+            it["enter_us"][rank] = r["ats_us"]
+            it["exit_us"][rank] = r["aend_us"]
+    rows = []
+    for it in inst.values():
+        enters = it["enter_us"]
+        first = min(enters.values())
+        last = max(enters.values())
+        end = max(it["exit_us"].values())
+        it["first_enter_us"] = first
+        it["last_enter_us"] = last
+        it["lag_us"] = last - first
+        it["transfer_us"] = max(end - last, 0.0)
+        it["straggler"] = max(enters, key=lambda r: enters[r])
+        it["world"] = len(enters)
+        rows.append(it)
+    rows.sort(key=lambda d: d["first_enter_us"])
+    return rows
+
+
+def decompose_collectives(
+        timelines: Dict[int, List[dict]]) -> dict:
+    """Aggregate the instance decomposition per collective phase and
+    per rank: ``phases[name]`` carries calls / lag_s / transfer_s and
+    the worst straggler rank (most accumulated lag while last in);
+    ``per_rank[r]`` carries ``wait_s`` (seconds rank r sat inside
+    collectives) and ``skew_s`` (seconds rank r arrived after the
+    first entrant — how much it straggled)."""
+    rows = collective_instances(timelines)
+    phases: Dict[str, dict] = {}
+    per_rank: Dict[int, dict] = {
+        r: dict(wait_s=0.0, skew_s=0.0) for r in timelines
+    }
+    for it in rows:
+        ph = phases.setdefault(it["name"], dict(
+            calls=0, lag_s=0.0, transfer_s=0.0, by_rank_lag={},
+        ))
+        ph["calls"] += 1
+        ph["lag_s"] += it["lag_us"] / 1e6
+        ph["transfer_s"] += it["transfer_us"] / 1e6
+        brl = ph["by_rank_lag"]
+        brl[it["straggler"]] = (
+            brl.get(it["straggler"], 0.0) + it["lag_us"] / 1e6
+        )
+        first = it["first_enter_us"]
+        for r, ent in it["enter_us"].items():
+            per_rank.setdefault(r, dict(wait_s=0.0, skew_s=0.0))
+            per_rank[r]["wait_s"] += (
+                it["exit_us"][r] - ent
+            ) / 1e6
+            per_rank[r]["skew_s"] += (ent - first) / 1e6
+    for name, ph in phases.items():
+        brl = ph.pop("by_rank_lag")
+        if brl:
+            worst = max(brl, key=lambda r: brl[r])
+            ph["worst_rank"] = worst
+            ph["worst_rank_lag_s"] = round(brl[worst], 6)
+        ph["lag_s"] = round(ph["lag_s"], 6)
+        ph["transfer_s"] = round(ph["transfer_s"], 6)
+    for r in per_rank:
+        per_rank[r]["wait_s"] = round(per_rank[r]["wait_s"], 6)
+        per_rank[r]["skew_s"] = round(per_rank[r]["skew_s"], 6)
+    return dict(phases=phases, per_rank=per_rank,
+                instances=len(rows))
+
+
+# ---------------------------------------------------------------------------
+# critical path
+# ---------------------------------------------------------------------------
+
+
+def _gating_phase(recs: List[dict], at_us: float) -> Optional[str]:
+    """Deepest non-collective span on one rank covering ``at_us`` —
+    the phase label a critical-path segment is attributed to."""
+    best = None
+    best_depth = -1
+    for r in recs:
+        if r.get("type") != "span" or _is_coll(r.get("name", "")):
+            continue
+        if r["ats_us"] <= at_us <= r["aend_us"]:
+            d = int(r.get("depth", 0))
+            if d >= best_depth:
+                best_depth = d
+                best = r["name"]
+    return best
+
+
+def critical_path(timelines: Dict[int, List[dict]]) -> List[dict]:
+    """The cross-rank critical path, per iteration.
+
+    Collectives are the world's sync points: the wall between two of
+    them is gated by whichever rank entered the CLOSING collective
+    last (everyone else was already waiting at the rendezvous). Per
+    iteration (matched across ranks by the ``iteration`` span's
+    ``it`` arg), walk its collective instances in completion order and
+    emit one row per inter-sync segment::
+
+      {it, rank, phase, gate, start_us, dur_us}
+
+    where ``phase`` is the deepest span the gating rank was inside
+    mid-segment and ``gate`` names the closing sync (the final segment
+    closes at the iteration's world end). Degenerates gracefully on a
+    single rank: every segment is gated by rank 0."""
+    # iteration windows: it -> (world start, world end)
+    iters: Dict[int, List[float]] = {}
+    for recs in timelines.values():
+        for r in recs:
+            if r.get("type") == "span" and r.get("name") == "iteration":
+                itn = (r.get("args") or {}).get("it")
+                if itn is None:
+                    continue
+                itn = int(itn)
+                w = iters.setdefault(itn, [r["ats_us"], r["aend_us"]])
+                w[0] = min(w[0], r["ats_us"])
+                w[1] = max(w[1], r["aend_us"])
+    colls = collective_instances(timelines)
+    rows: List[dict] = []
+    for itn in sorted(iters):
+        lo, hi = iters[itn]
+        inside = [
+            c for c in colls
+            if lo <= c["first_enter_us"] and c["last_enter_us"] <= hi
+        ]
+        inside.sort(key=lambda c: c["last_enter_us"])
+        cursor = lo
+        for c in inside:
+            seg_end = c["last_enter_us"]
+            dur = seg_end - cursor
+            if dur <= 0:
+                cursor = max(cursor, max(c["exit_us"].values()))
+                continue
+            gater = c["straggler"]
+            mid = cursor + dur / 2.0
+            phase = _gating_phase(
+                timelines.get(gater, []), mid
+            ) or c["name"]
+            rows.append(dict(
+                it=itn, rank=gater, phase=phase, gate=c["name"],
+                start_us=round(cursor, 1), dur_us=round(dur, 1),
+            ))
+            cursor = max(c["exit_us"].values())
+        if hi > cursor:
+            # tail segment: whoever finished the iteration last
+            ends = {
+                r: max((x["aend_us"] for x in recs
+                        if x.get("type") == "span"
+                        and x.get("name") == "iteration"
+                        and (x.get("args") or {}).get("it") == itn),
+                       default=None)
+                for r, recs in timelines.items()
+            }
+            ends = {r: e for r, e in ends.items() if e is not None}
+            gater = max(ends, key=lambda r: ends[r]) if ends else 0
+            mid = cursor + (hi - cursor) / 2.0
+            phase = _gating_phase(
+                timelines.get(gater, []), mid
+            ) or "iteration"
+            rows.append(dict(
+                it=itn, rank=gater, phase=phase, gate="iteration_end",
+                start_us=round(cursor, 1),
+                dur_us=round(hi - cursor, 1),
+            ))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# merged Perfetto trace
+# ---------------------------------------------------------------------------
+
+
+def write_merged_trace(dirpath: str,
+                       out_path: Optional[str] = None) -> Optional[str]:
+    """One Perfetto-loadable Chrome trace of every rank on rank 0's
+    timebase: each ``trace_rank<r>.json`` carries its tracer's clock
+    segment (``t0_us``/``offset_us`` — `Tracer.flush` stamps it), so
+    every timed event is shifted by ``t0_us + offset_us``. Rank tracks
+    keep their pid; load the result in Perfetto and the ranks line up.
+    Returns the written path (default ``trace_merged.json`` inside the
+    directory), or None when no rank traces exist."""
+    events: List[dict] = []
+    found = False
+    for path in sorted(glob.glob(
+            os.path.join(dirpath, "trace_rank*.json"))):
+        with open(path) as f:
+            doc = json.load(f)
+        found = True
+        clock = doc.get("clock") or {}
+        shift = float(clock.get("t0_us", 0.0)) \
+            + float(clock.get("offset_us", 0.0))
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            if ev.get("ph") != "M" and "ts" in ev:
+                ev["ts"] = ev["ts"] + shift
+            events.append(ev)
+    if not found:
+        return None
+    out_path = out_path or os.path.join(dirpath, "trace_merged.json")
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+# ---------------------------------------------------------------------------
+# summary + render
+# ---------------------------------------------------------------------------
+
+
+def dist_summary(dirpath: str) -> dict:
+    """The structured ``--dist`` document: clock table, per-rank
+    aligned spans + wait/skew, per-phase collective decomposition,
+    the work/imbalance picture from the merged metrics, and the
+    critical-path rows."""
+    segs = rank_segments(dirpath)
+    tls = aligned_timelines(dirpath)
+    clocks = {
+        r: [
+            dict(t0_us=s["t0_us"], offset_us=s["offset_us"],
+                 err_us=s["err_us"], rounds=s["rounds"],
+                 aligned=s["aligned"], records=len(s["records"]))
+            for s in ss
+        ]
+        for r, ss in segs.items()
+    }
+    ranks = {}
+    for r, recs in tls.items():
+        spans = [x for x in recs if x.get("type") == "span"]
+        remesh = sum(
+            x["dur_us"] for x in spans
+            if x.get("name", "").startswith("phase:remesh")
+        ) / 1e6
+        ranks[r] = dict(
+            spans=len(spans),
+            events=len(recs) - len(spans),
+            start_us=round(min((x["ats_us"] for x in recs),
+                               default=0.0), 1),
+            end_us=round(max((x.get("aend_us", x["ats_us"])
+                              for x in recs), default=0.0), 1),
+            remesh_wall_s=round(remesh, 6),
+        )
+    comm = decompose_collectives(tls)
+    for r, d in comm["per_rank"].items():
+        if r in ranks:
+            ranks[r].update(wait_s=d["wait_s"], skew_s=d["skew_s"])
+    merged = metrics_mod.merge_dir(dirpath)
+    work = {}
+    if merged:
+        g = merged.get("gauges", {})
+        if "work/imbalance" in g:
+            work["imbalance"] = g["work/imbalance"]
+        shards = {
+            k[len("work/live_tets/shard"):]: v
+            for k, v in g.items()
+            if k.startswith("work/live_tets/shard")
+        }
+        if shards:
+            work["live_tets_per_shard"] = {
+                k: v.get("max") if isinstance(v, dict) else v
+                for k, v in sorted(shards.items(),
+                                   key=lambda kv: int(kv[0]))
+            }
+        if "comm/wait_s" in g:
+            work["comm_wait_s_gauge"] = g["comm/wait_s"]
+    return dict(
+        dir=dirpath,
+        world=len(tls),
+        clocks=clocks,
+        ranks=ranks,
+        collectives=comm,
+        work=work,
+        critical_path=critical_path(tls),
+    )
+
+
+def _fmt_s(us: float) -> str:
+    return f"{us / 1e6:9.4f}"
+
+
+def render_dist(dirpath: str) -> str:
+    """Human-readable ``--dist`` report (see README "Distributed
+    observability" for how to read it)."""
+    s = dist_summary(dirpath)
+    L: List[str] = []
+    L.append(f"== obs report: distributed ({s['world']} rank(s)) ==")
+    L.append("")
+    L.append("-- clock alignment --")
+    L.append("rank  seg  offset_us      err_us  rounds  aligned  "
+             "records")
+    for r in sorted(s["clocks"]):
+        for i, seg in enumerate(s["clocks"][r]):
+            L.append(
+                f"{r:4d}  {i:3d}  {seg['offset_us']:12.1f}  "
+                f"{seg['err_us']:8.1f}  {seg['rounds']:6d}  "
+                f"{str(seg['aligned']):>7s}  {seg['records']:7d}"
+            )
+    L.append("")
+    L.append("-- per-rank aligned timelines --")
+    L.append("rank   spans  events     start_s       end_s  "
+             "remesh_s    wait_s    skew_s")
+    for r in sorted(s["ranks"]):
+        d = s["ranks"][r]
+        L.append(
+            f"{r:4d}  {d['spans']:6d}  {d['events']:6d}  "
+            f"{_fmt_s(d['start_us']):>10s}  {_fmt_s(d['end_us']):>10s}"
+            f"  {d['remesh_wall_s']:8.4f}"
+            f"  {d.get('wait_s', 0.0):8.4f}"
+            f"  {d.get('skew_s', 0.0):8.4f}"
+        )
+    L.append("")
+    L.append("-- collective decomposition (straggler lag vs "
+             "transfer) --")
+    phases = s["collectives"]["phases"]
+    if phases:
+        L.append("phase                      calls     lag_s  "
+                 "transfer_s  worst-rank (lag_s)")
+        for name in sorted(phases):
+            ph = phases[name]
+            worst = ph.get("worst_rank")
+            wtxt = (f"rank {worst} ({ph.get('worst_rank_lag_s', 0.0):.4f})"
+                    if worst is not None else "-")
+            L.append(
+                f"{name:<24s}  {ph['calls']:5d}  {ph['lag_s']:8.4f}  "
+                f"{ph['transfer_s']:10.4f}  {wtxt}"
+            )
+    else:
+        L.append("(no collective spans — single-process run?)")
+    if s["work"]:
+        L.append("")
+        L.append("-- load imbalance --")
+        imb = s["work"].get("imbalance")
+        if imb is not None:
+            per = imb.get("per_rank", imb) if isinstance(imb, dict) \
+                else {"*": imb}
+            txt = ", ".join(
+                f"rank {k}: {v:.4f}" for k, v in sorted(per.items())
+            )
+            L.append(f"imbalance (live-tets max/mean): {txt}")
+        shards = s["work"].get("live_tets_per_shard")
+        if shards:
+            L.append("live tets per shard: " + ", ".join(
+                f"s{k}={int(v)}" for k, v in shards.items()
+            ))
+    L.append("")
+    L.append("-- critical path (which rank gated the world) --")
+    cp = s["critical_path"]
+    if cp:
+        L.append("  it  rank  phase                      "
+                 "gate                      dur_s")
+        for row in cp:
+            L.append(
+                f"{row['it']:4d}  {row['rank']:4d}  "
+                f"{row['phase']:<24s}  {row['gate']:<24s}  "
+                f"{row['dur_us'] / 1e6:8.4f}"
+            )
+    else:
+        L.append("(no matched iteration spans)")
+    merged = os.path.join(dirpath, "trace_merged.json")
+    L.append("")
+    L.append(
+        f"merged Perfetto trace: {merged}"
+        + ("" if os.path.exists(merged)
+           else "  (write with obs.dist.write_merged_trace)")
+    )
+    return "\n".join(L)

@@ -62,6 +62,11 @@ GATE_KEYS: Dict[str, str] = {
     "wall_s": "lower",
     "steady_recompiles": "lower",
     "qmin": "higher",
+    # load-imbalance factor (live-tets max/mean across shards, worst
+    # iteration): distributed records carry it so the gate ratchets
+    # BALANCE, not just throughput — absent from centralized records,
+    # and absent keys are skipped
+    "imbalance": "lower",
 }
 
 _ENVELOPE = ("schema", "run_id", "git_sha", "timestamp", "platform",

@@ -19,6 +19,12 @@ Naming convention (what `tools/obs_report.py` renders):
   migrate/payload_bytes  estimated migration payload
   comm/barriers          coordination barriers entered
   comm/collectives       cross-process gathers dispatched
+  comm/wait_s            gauge: seconds this rank spent blocked
+                         inside coordination collectives
+  work/imbalance         gauge: live-tets max/mean across shards
+  work/live_tets/shard<i>  gauge: live tets on shard i
+  compile_s/<name>       gauge: AOT lower+compile seconds per jitted
+                         entry point (obs.costs capture)
   ckpt/ops, ckpt/retries, ckpt/commits, ckpt/put_bytes, ckpt/get_bytes
   ckpt/op_seconds        histogram of store-operation latency
   retry/attempts         generic utils.retry re-attempts
@@ -214,6 +220,13 @@ def record_sweep(rec: dict) -> None:
             reg.gauge("sweep_active_fraction").set(n_act / nu)
     for i, frac in enumerate(rec.get("shard_active", ())):
         reg.gauge(f"sweep_active_fraction/shard{i}").set(frac)
+    # load-imbalance accounting (round 11): live tets per shard and
+    # the max/mean imbalance factor the distributed records carry —
+    # the gauges `obs_report --dist` and the BENCH envelope read
+    if "imbalance" in rec:
+        reg.gauge("work/imbalance").set(rec["imbalance"])
+    for i, ne in enumerate(rec.get("shard_ne", ())):
+        reg.gauge(f"work/live_tets/shard{i}").set(ne)
 
 
 # ---------------------------------------------------------------------------

@@ -46,8 +46,22 @@ from . import metrics as metrics_mod
 __all__ = [
     "load_trace_events", "load_timeline", "summarize", "render",
     "rank_timelines", "chaos_summary", "render_chaos",
-    "serve_summary", "render_serve",
+    "serve_summary", "render_serve", "dist_summary", "render_dist",
 ]
+
+
+def dist_summary(dirpath: str) -> dict:
+    """Cross-rank view (clock-aligned timelines, collective skew,
+    imbalance, critical path) — delegates to :mod:`..obs.dist`."""
+    from . import dist as dist_mod
+    return dist_mod.dist_summary(dirpath)
+
+
+def render_dist(dirpath: str) -> str:
+    """Render the cross-rank ``--dist`` report (see obs.dist)."""
+    from . import dist as dist_mod
+    dist_mod.write_merged_trace(dirpath)
+    return dist_mod.render_dist(dirpath)
 
 
 def load_trace_events(dirpath: str) -> List[dict]:
@@ -62,8 +76,11 @@ def load_trace_events(dirpath: str) -> List[dict]:
 
 
 def load_timeline(dirpath: str) -> List[dict]:
-    """All JSONL records of every rank, time-ordered. Tolerates a
-    truncated final line (a process killed mid-write)."""
+    """All span/event JSONL records of every rank, time-ordered.
+    Tolerates a truncated final line (a process killed mid-write).
+    ``type="clock"`` headers (the obs.dist alignment contract) are
+    bookkeeping, not timeline content — skipped here; `obs.dist`
+    reads them via :func:`parmmg_tpu.obs.dist.rank_segments`."""
     recs: List[dict] = []
     for path in sorted(glob.glob(
             os.path.join(dirpath, "events_rank*.jsonl"))):
@@ -73,9 +90,11 @@ def load_timeline(dirpath: str) -> List[dict]:
                 if not line:
                     continue
                 try:
-                    recs.append(json.loads(line))
+                    rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if rec.get("type") != "clock":
+                    recs.append(rec)
     recs.sort(key=lambda r: (r.get("ts_us", 0), r.get("rank", 0)))
     return recs
 
@@ -101,9 +120,13 @@ def rank_timelines(dirpath: str) -> Dict[int, List[dict]]:
                 if not line:
                     continue
                 try:
-                    recs.append(json.loads(line))
+                    rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                # clock headers are segment bookkeeping for obs.dist,
+                # not chain content
+                if rec.get("type") != "clock":
+                    recs.append(rec)
         out[rank] = recs
     return out
 

@@ -93,6 +93,9 @@ class NullTracer:
     def event(self, name, **args):
         return None
 
+    def set_clock_offset(self, offset_us, err_us=0.0, rounds=0):
+        return None
+
     def current_span(self) -> Optional[str]:
         return None
 
@@ -170,6 +173,18 @@ class Tracer:
         self._jsonl = open(
             os.path.join(dirpath, f"events_rank{self.rank}.jsonl"), "a"
         )
+        # clock header: opens a new CLOCK SEGMENT in the (append-mode)
+        # JSONL — every ts_us below it is relative to this tracer's
+        # _t0. A resumed run appends a fresh header with its restarted
+        # origin, which is what lets obs.dist re-align the two runs
+        # onto one timebase instead of interleaving them.
+        self._clock_offset_us = 0.0
+        self._clock_err_us = 0.0
+        with self._lock:
+            self._write_jsonl(dict(
+                type="clock", rank=self.rank, restart=True,
+                t0_us=self._t0 // 1000, offset_us=0.0,
+            ))
         self._profiling = False
         if profile:
             self._start_profile()
@@ -266,6 +281,24 @@ class Tracer:
         st = self._stack()
         return st[-1] if st else None
 
+    def set_clock_offset(self, offset_us: float, err_us: float = 0.0,
+                         rounds: int = 0) -> None:
+        """Record this rank's estimated clock offset to rank 0's
+        monotonic clock (µs, ADD to a local absolute time to land on
+        rank 0's timebase). Persisted as a ``type="clock"`` JSONL
+        record updating the current clock segment — `obs.dist` applies
+        it when merging rank timelines. Estimated by
+        `parallel.multihost.sync_tracer_clock` (median of K barrier
+        exchanges); 0.0 with no error on a single-process run."""
+        self._clock_offset_us = float(offset_us)
+        self._clock_err_us = float(err_us)
+        with self._lock:
+            self._write_jsonl(dict(
+                type="clock", rank=self.rank, restart=False,
+                t0_us=self._t0 // 1000, offset_us=float(offset_us),
+                err_us=float(err_us), rounds=int(rounds),
+            ))
+
     def flush(self) -> None:
         """Write the Chrome trace JSON (idempotent — rewrites the whole
         file from the buffer), flush the JSONL stream, snapshot the
@@ -281,6 +314,15 @@ class Tracer:
                  "tid": 0, "args": {"name": f"rank{self.rank}"}},
             ] + events,
             "displayTimeUnit": "ms",
+            # clock segment of THIS tracer (ts values are relative to
+            # t0_us on the local monotonic clock): obs.dist uses it to
+            # shift every rank's Chrome events onto rank 0's timebase
+            # in the merged Perfetto trace
+            "clock": {
+                "rank": self.rank, "t0_us": self._t0 // 1000,
+                "offset_us": self._clock_offset_us,
+                "err_us": self._clock_err_us,
+            },
         }
         path = os.path.join(self.dir, f"trace_rank{self.rank}.json")
         tmp = path + ".tmp"

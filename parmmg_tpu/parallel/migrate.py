@@ -677,14 +677,17 @@ def migrate(stacked: Mesh, color: jax.Array, nparts: int,
     # leg of the migration — the integrate side is a vmapped scatter of
     # the same payload), under the migrate_exchange device-span name
     from ..obs import costs as obs_costs
+    from ..obs import trace as obs_trace
 
     obs_costs.capture(
         "migrate_exchange", _pack, (stacked, color),
         dict(slot_cap=slot_cap, tria_cap=tria_cap, edge_cap=edge_cap),
     )
-    (bti, btf, bfi, bei, tria_keep, edge_keep, pack_n), out_t = jit_retry(
-        _pack, stacked, color, slot_cap, tria_cap, edge_cap
-    )
+    tr = obs_trace.get_tracer()
+    with tr.span("migrate:pack", slot_cap=slot_cap):
+        (bti, btf, bfi, bei, tria_keep, edge_keep, pack_n), out_t = \
+            jit_retry(_pack, stacked, color, slot_cap, tria_cap,
+                      edge_cap)
     # pack-side overflow check: a slot cap that undershoots would DROP
     # outgoing entities (their source copies are already released), so
     # verify the true per-destination counts before anything is applied.
@@ -699,11 +702,18 @@ def migrate(stacked: Mesh, color: jax.Array, nparts: int,
             f"{caps.tolist()}) — raise slot_cap",
             counts=pn, caps=caps,
         )
-    rti, rtf, rfi, rei = (
-        _exchange(bti), _exchange(btf), _exchange(bfi), _exchange(bei)
-    )
-    out, overflow = jit_retry(_integrate, stacked, out_t, rti, rtf, rfi,
-                              rei, tria_keep, edge_keep)
+    # the transfer leg proper: the (src,dst)-slot buffers swap owners
+    # here — obs.dist reads this sub-span (inside the world-matched
+    # migrate_exchange device-span) as the TRUE transfer time, vs the
+    # straggler lag it measures from the enclosing span's entries
+    with tr.span("migrate:xchg"):
+        rti, rtf, rfi, rei = (
+            _exchange(bti), _exchange(btf), _exchange(bfi),
+            _exchange(bei)
+        )
+    with tr.span("migrate:integrate"):
+        out, overflow = jit_retry(_integrate, stacked, out_t, rti, rtf,
+                                  rfi, rei, tria_keep, edge_keep)
     over = np.asarray(jax.device_get(overflow))
     if (over > 0).any():
         raise CapacityError(

@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import os
 import threading
+import time
+from contextlib import contextmanager
 
 import jax
 import numpy as np
@@ -448,6 +450,41 @@ def run_with_watchdog(fn, tag: str = "collective",
     return box.get("value")
 
 
+# per-name collective sequence numbers: collectives are dispatched in
+# the same order by every process (the whole coordination layer depends
+# on that), so the nth `coll:<name>` span on each rank is the SAME
+# world instance — the matching key `obs.dist` uses to decompose a
+# collective into straggler lag vs true transfer time across ranks
+_COLL_SEQ: dict = {}
+# accumulated seconds THIS rank spent blocked inside coordination
+# collectives (always-on, like the comm/* counters); the per-rank
+# `comm/wait_s` gauge survives the world merge as a per-rank map
+_COLL_WAIT = [0.0]
+
+
+@contextmanager
+def _coll_span(name: str, tag: str):
+    """Paired enter/exit attribution around one collective dispatch.
+
+    Traced runs get a ``coll:<name>`` span carrying the per-name
+    sequence number; untraced runs still pay two clock reads to keep
+    the `comm/wait_s` gauge honest. Host-side coordination code — the
+    clocks here never sit under a jitted region."""
+    seq = _COLL_SEQ.get(name, 0)
+    _COLL_SEQ[name] = seq + 1
+    tr = obs_trace.get_tracer()
+    t0 = time.perf_counter()
+    try:
+        if tr.enabled:
+            with tr.span(f"coll:{name}", tag=tag, seq=seq):
+                yield
+        else:
+            yield
+    finally:
+        _COLL_WAIT[0] += time.perf_counter() - t0
+        obs_metrics.registry().gauge("comm/wait_s").set(_COLL_WAIT[0])
+
+
 def _barrier_fn():
     """One compiled psum-of-ones over ALL global devices — the barrier
     collective. Built lazily and memoized on first use (rebuilding
@@ -525,7 +562,8 @@ def barrier(tag: str = "parmmg-barrier",
             )
 
     try:
-        run_with_watchdog(_sync, tag=tag, timeout=timeout)
+        with _coll_span("barrier", tag):
+            run_with_watchdog(_sync, tag=tag, timeout=timeout)
     except PeerLostError:
         raise
     except Exception as e:
@@ -587,7 +625,8 @@ def agree_flags(value: int, tag: str = "agree",
         return int(jax.device_get(fn(x)))
 
     try:
-        total = run_with_watchdog(_vote, tag=tag, timeout=timeout)
+        with _coll_span("agree_flags", tag):
+            total = run_with_watchdog(_vote, tag=tag, timeout=timeout)
     except PeerLostError:
         raise
     except Exception as e:
@@ -626,6 +665,126 @@ def _agree_fn():
     ))
     _AGREE = (fn, sh, len(devs))
     return _AGREE
+
+
+_TSX = None
+
+
+def _tsx_fn():
+    """Memoized timestamp-allgather for :func:`estimate_clock_offset`:
+    one psum over a ``[ndev, nprocs]`` float64 one-hot (each device
+    carries its owner's timestamp at its owner's column), so every
+    process reads back every rank's clock sample in one collective.
+    float64 µs keeps sub-µs precision out to ~decades of uptime (the
+    drivers run under jax_enable_x64; without it the estimate degrades
+    to float32 and the reported err_us says so)."""
+    global _TSX
+    if _TSX is not None:
+        return _TSX
+    import jax.numpy as jnp
+    from jax.sharding import (
+        Mesh as DeviceMesh, NamedSharding, PartitionSpec as P,
+    )
+
+    devs = jax.devices()
+    nproc = jax.process_count()
+    dmesh = DeviceMesh(np.array(devs), ("procs",))
+    sh = NamedSharding(dmesh, P("procs"))
+
+    def body(blk):
+        return jax.lax.psum(jnp.sum(blk, axis=0), "procs")
+
+    # parmmg-lint: disable=PML004 -- built once, memoized in _TSX
+    fn = jax.jit(jax.shard_map(
+        body, mesh=dmesh, in_specs=(P("procs"),), out_specs=P()
+    ))
+    _TSX = (fn, sh, len(devs), nproc)
+    return _TSX
+
+
+def _exchange_timestamps(value_us: float,
+                         timeout: float | None = None) -> np.ndarray:
+    """All ranks' ``value_us`` samples (µs, local monotonic clocks),
+    indexed by process rank — one watchdogged psum round."""
+    fn, sh, ndev, nproc = _tsx_fn()
+    nloc = jax.local_device_count()
+    rank = jax.process_index()
+
+    def _cb(idx):
+        sl = idx[0]
+        lo = 0 if sl.start is None else sl.start
+        hi = ndev if sl.stop is None else sl.stop
+        block = np.zeros((hi - lo, nproc), np.float64)
+        block[:, rank] = value_us
+        return block
+
+    def _round():
+        x = jax.make_array_from_callback((ndev, nproc), sh, _cb)
+        return np.asarray(jax.device_get(fn(x)), np.float64) / nloc
+
+    return run_with_watchdog(_round, tag="clock_sync", timeout=timeout)
+
+
+def estimate_clock_offset(rounds: int = 5,
+                          timeout: float | None = None):
+    """Median-of-K offset (µs) from THIS rank's monotonic clock to
+    rank 0's, plus a spread-based error bound: ``(offset_us, err_us)``.
+
+    Protocol: K+1 timestamp-psum rounds. Every rank exits a psum at
+    (nearly) the same instant — the collective cannot complete until
+    every rank contributed — so round ``k`` exchanges each rank's
+    EXIT timestamp of round ``k-1`` and each sample of the offset is
+    ``exit_us[rank0] - exit_us[me]`` for one shared exit instant. The
+    median over K rounds rejects stragglers (a rank descheduled across
+    one exit); the error bound is the median absolute deviation. Rank 0
+    measures exactly 0 by construction. Single-process: ``(0.0, 0.0)``
+    without touching the device."""
+    if not is_multiprocess():
+        return 0.0, 0.0
+    from ..failsafe import PeerLostError
+
+    if _PEER_LOSS.is_set():
+        raise PeerLostError(
+            "clock_sync refused: a peer is already reported lost "
+            f"({_PEER_LOSS_STATUS[-1] if _PEER_LOSS_STATUS else ''})"
+        )
+    obs_metrics.registry().counter("comm/collectives").inc()
+    samples = []
+    prev_exit = time.perf_counter_ns() / 1e3
+    for _ in range(max(int(rounds), 1) + 1):
+        vec = _exchange_timestamps(prev_exit, timeout=timeout)
+        samples.append(float(vec[0]) - prev_exit)
+        prev_exit = time.perf_counter_ns() / 1e3
+    # the first exchange carried ENTRY timestamps (no shared exit
+    # instant behind them yet) — drop it, keep the K exit-anchored ones
+    offs = np.asarray(samples[1:], np.float64)
+    off = float(np.median(offs))
+    err = float(np.median(np.abs(offs - off)))
+    return off, err
+
+
+def sync_tracer_clock(tracer=None, rounds: int = 5,
+                      timeout: float | None = None) -> float:
+    """Estimate this rank's clock offset to rank 0 and persist it into
+    the active tracer's JSONL clock header (`obs.dist` applies it when
+    merging rank timelines onto one timebase). No-op when tracing is
+    disabled; writes an exact-zero offset single-process — which still
+    marks the segment as aligned, the contract resumed runs rely on.
+    MUST be called at the same point on every process (it is a
+    collective)."""
+    tr = tracer if tracer is not None else obs_trace.get_tracer()
+    if not tr.enabled:
+        # keep the collective schedule identical whether or not a rank
+        # traces: all current callers trace on every rank or none, but
+        # a lopsided config must not desync the world
+        if is_multiprocess():
+            off, _err = estimate_clock_offset(rounds=rounds,
+                                              timeout=timeout)
+            return off
+        return 0.0
+    off, err = estimate_clock_offset(rounds=rounds, timeout=timeout)
+    tr.set_clock_offset(off, err_us=err, rounds=int(rounds))
+    return off
 
 
 def put_sharded_global(tree, dmesh):
@@ -713,9 +872,10 @@ def gather_stacked(tree, timeout: float | None = None):
             rep = _replicate_fn(dev)(sub)
             return [np.asarray(r.addressable_data(0)) for r in rep]
 
-        vals = run_with_watchdog(
-            _gather, tag="gather_stacked", timeout=timeout
-        )
+        with _coll_span("gather", "gather_stacked"):
+            vals = run_with_watchdog(
+                _gather, tag="gather_stacked", timeout=timeout
+            )
         for i, v in zip(idx, vals):
             leaves[i] = v
     # host numpy / fully-addressable leaves are already whole on every

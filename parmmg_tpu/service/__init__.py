@@ -11,7 +11,8 @@ is the process wrapper (spool ingestion, drain-on-notice, bench);
 
 Modules: `jobs` (specs, states, typed errors), `admission` (size
 classes + bounded queue), `journal` (durable state machine),
-`server` (the serving loop).
+`server` (the serving loop), `status` (the Prometheus scrape
+endpoint behind ``tools/serve.py --status``).
 """
 
 from .admission import (  # noqa: F401
@@ -41,3 +42,4 @@ from .jobs import (  # noqa: F401
 )
 from .journal import JobJournal, JournalStateError  # noqa: F401
 from .server import JobServer, default_options, mesh_digest  # noqa: F401
+from .status import StatusServer, status_text  # noqa: F401

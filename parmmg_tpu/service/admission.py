@@ -37,7 +37,7 @@ import dataclasses
 import os
 import re
 from collections import deque
-from typing import Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from .jobs import BadJobError, JobSpec, JobTooLargeError, QueueFullError
 
@@ -194,6 +194,15 @@ class AdmissionQueue:
                 queue_depth=len(self._q), queue_cap=self.cap,
             )
         self._q.append((spec, cls))
+
+    def occupancy(self) -> Dict[str, int]:
+        """Queued jobs per size-class name (the ``--status``
+        endpoint's occupancy gauge; classes with no queued jobs are
+        simply absent — the renderer zero-fills from the table)."""
+        out: Dict[str, int] = {}
+        for _spec, cls in self._q:
+            out[cls.name] = out.get(cls.name, 0) + 1
+        return out
 
     def push_front(self, items: List[Tuple[JobSpec, SizeClass]]) -> None:
         """Restore popped-but-unrun batch members to the queue head

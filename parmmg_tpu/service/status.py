@@ -1,0 +1,112 @@
+"""Prometheus status endpoint for the job server (``--status PORT``).
+
+The serving loop already counts everything that matters into the
+always-on metrics registry (``serve/*`` counters: submitted, done,
+failed, refused_*, requeued, batches, ...). This module is the thin
+scrape surface over it: :func:`status_text` renders those counters
+plus the live queue picture (depth, per-size-class occupancy from
+:meth:`~parmmg_tpu.service.admission.AdmissionQueue.occupancy`, the
+draining flag) in Prometheus text exposition format 0.0.4, and
+:class:`StatusServer` is a daemon-threaded stdlib ``http.server``
+exposing it at ``/metrics`` (plus a trivial ``/healthz``) so
+``tools/serve.py --status <port>`` can be scraped without touching
+the serving loop. Pure stdlib — no client library, no new deps.
+"""
+
+from __future__ import annotations
+
+import http.server
+import re
+import threading
+
+from ..obs import metrics as obs_metrics
+
+__all__ = ["status_text", "StatusServer"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    """Registry key -> legal Prometheus metric name (``serve/done``
+    -> ``parmmg_serve_done``)."""
+    return "parmmg_" + _NAME_RE.sub("_", name)
+
+
+def status_text(server) -> str:
+    """Prometheus text-format snapshot of one
+    :class:`~parmmg_tpu.service.server.JobServer`."""
+    doc = obs_metrics.registry().to_doc()
+    lines = []
+    for key in sorted(doc.get("counters", {})):
+        if not key.startswith("serve/"):
+            continue
+        name = _prom_name(key)
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {doc['counters'][key]}")
+    depth = _prom_name("serve/queue_depth")
+    lines.append(f"# TYPE {depth} gauge")
+    lines.append(f"{depth} {len(server.queue)}")
+    occ = server.queue.occupancy()
+    occ_name = _prom_name("serve/queue_occupancy")
+    lines.append(f"# TYPE {occ_name} gauge")
+    for cls in server.classes:
+        lines.append(
+            f'{occ_name}{{size_class="{cls.name}"}} '
+            f"{occ.get(cls.name, 0)}"
+        )
+    drain = _prom_name("serve/draining")
+    lines.append(f"# TYPE {drain} gauge")
+    lines.append(f"{drain} {1 if server.draining else 0}")
+    return "\n".join(lines) + "\n"
+
+
+class StatusServer:
+    """Daemon-threaded HTTP scrape endpoint for one job server.
+
+    Binds immediately (``port=0`` picks an ephemeral port — read
+    ``.port`` after construction), serves on a daemon thread after
+    :meth:`start`, and never blocks the serving loop: every request
+    renders a fresh :func:`status_text` snapshot."""
+
+    def __init__(self, server, port: int = 0,
+                 host: str = "127.0.0.1"):
+        job_server = server
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.startswith("/healthz"):
+                    body = b"ok\n"
+                    ctype = "text/plain"
+                else:
+                    body = status_text(job_server).encode()
+                    ctype = "text/plain; version=0.0.4"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass  # scrapes are not server events
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host, port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "StatusServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="serve-status",
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

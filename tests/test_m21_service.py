@@ -388,3 +388,64 @@ def test_terminal_states_cover_every_exit():
         doc = json.loads(json.dumps(err.doc()))
         assert doc["code"] == err.code
         assert doc["transient"] is err.transient
+
+
+# ---------------------------------------------------------------------------
+# --status endpoint (round 11): Prometheus text over the live registry
+# ---------------------------------------------------------------------------
+
+
+def test_status_text_counters_queue_and_occupancy(cube_mesh_path):
+    from parmmg_tpu.obs import metrics as obs_metrics
+    from parmmg_tpu.service import status_text
+
+    obs_metrics.registry().reset()
+    srv = _server("m21-status", queue_cap=4)
+    srv.submit(JobSpec(job_id="s1", inmesh=cube_mesh_path))
+    srv.submit(JobSpec(job_id="s2", inmesh=cube_mesh_path))
+    text = status_text(srv)
+    lines = text.splitlines()
+    assert "# TYPE parmmg_serve_submitted counter" in lines
+    assert "parmmg_serve_submitted 2" in lines
+    assert "parmmg_serve_queue_depth 2" in lines
+    assert 'parmmg_serve_queue_occupancy{size_class="t"} 2' in lines
+    assert "parmmg_serve_draining 0" in lines
+    srv.request_drain()
+    assert "parmmg_serve_draining 1" in status_text(srv).splitlines()
+    obs_metrics.registry().reset()
+
+
+def test_status_http_endpoint_scrapes(cube_mesh_path):
+    import urllib.request
+
+    from parmmg_tpu.obs import metrics as obs_metrics
+    from parmmg_tpu.service import StatusServer
+
+    obs_metrics.registry().reset()
+    srv = _server("m21-status-http", queue_cap=4)
+    srv.submit(JobSpec(job_id="h1", inmesh=cube_mesh_path))
+    status = StatusServer(srv, port=0).start()
+    try:
+        base = f"http://{status.host}:{status.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert "parmmg_serve_queue_depth 1" in body
+        assert 'parmmg_serve_queue_occupancy{size_class="t"} 1' in body
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert r.read() == b"ok\n"
+    finally:
+        status.close()
+    obs_metrics.registry().reset()
+
+
+def test_admission_queue_occupancy_counts_per_class(cube_mesh_path):
+    q = AdmissionQueue(cap=8)
+    small = SizeClass("s2", pcap=512, tcap=2048, fcap=512, ecap=512)
+    q.offer(JobSpec(job_id="a", inmesh=cube_mesh_path), TINY)
+    q.offer(JobSpec(job_id="b", inmesh=cube_mesh_path), small)
+    q.offer(JobSpec(job_id="c", inmesh=cube_mesh_path), TINY)
+    assert q.occupancy() == {"t": 2, "s2": 1}
+    q.take_batch(4)
+    assert q.occupancy() == {"s2": 1}

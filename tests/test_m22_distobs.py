@@ -1,0 +1,347 @@
+"""Round 11 — the cross-rank performance observatory (obs.dist).
+
+Synthetic-fixture tests for the pieces a 2-process smoke cannot pin
+down numerically: clock-segment parsing, aligned merge under skewed
+AND resume-restarted clocks, the straggler-lag/transfer decomposition
+math, critical-path attribution, the merged Perfetto trace shift, and
+the compile_s capture closing the PR-8 cold-cache caveat. The live
+2-rank end-to-end lives in tools/dist_obs_smoke.py (check.sh stage
+``dist-obs``).
+"""
+
+import json
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from parmmg_tpu.obs import dist as obs_dist  # noqa: E402
+from parmmg_tpu.obs import report as obs_report  # noqa: E402
+from parmmg_tpu.obs import trace as obs_trace  # noqa: E402
+
+
+def _w(path, recs):
+    with open(path, "a") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _clock(rank, t0_us, offset_us=0.0, restart=True, **kw):
+    return dict(type="clock", rank=rank, restart=restart,
+                t0_us=t0_us, offset_us=offset_us, **kw)
+
+
+def _span(rank, name, ts_us, dur_us, depth=0, **args):
+    return dict(type="span", rank=rank, name=name, ts_us=ts_us,
+                dur_us=dur_us, depth=depth, args=args)
+
+
+# ---------------------------------------------------------------------------
+# clock segments + aligned merge
+# ---------------------------------------------------------------------------
+
+
+def test_rank_segments_parse_headers_and_offset_updates(tmp_path):
+    d = str(tmp_path)
+    _w(os.path.join(d, "events_rank0.jsonl"), [
+        _clock(0, t0_us=1000.0),
+        _clock(0, t0_us=1000.0, restart=False, offset_us=0.0,
+               err_us=0.5, rounds=5),
+        _span(0, "iteration", 10.0, 100.0, it=0),
+    ])
+    segs = obs_dist.rank_segments(d)
+    assert list(segs) == [0]
+    (s,) = segs[0]
+    assert s["t0_us"] == 1000.0
+    assert s["aligned"] is True
+    assert s["rounds"] == 5
+    assert len(s["records"]) == 1
+
+
+def test_aligned_merge_under_skewed_clocks(tmp_path):
+    # rank 1's monotonic clock reads 5000us AHEAD of rank 0's for the
+    # same world instant -> its offset to rank 0's timebase is -5000.
+    d = str(tmp_path)
+    _w(os.path.join(d, "events_rank0.jsonl"), [
+        _clock(0, t0_us=0.0),
+        _clock(0, t0_us=0.0, restart=False, offset_us=0.0),
+        _span(0, "coll:barrier", 100.0, 10.0, seq=0, tag="t"),
+    ])
+    _w(os.path.join(d, "events_rank1.jsonl"), [
+        _clock(1, t0_us=0.0),
+        _clock(1, t0_us=0.0, restart=False, offset_us=-5000.0),
+        _span(1, "coll:barrier", 5103.0, 7.0, seq=0, tag="t"),
+    ])
+    tls = obs_dist.aligned_timelines(d)
+    e0 = [r for r in tls[0] if r["name"] == "coll:barrier"][0]
+    e1 = [r for r in tls[1] if r["name"] == "coll:barrier"][0]
+    # raw timestamps are 5003us apart; aligned they are 3us apart
+    assert abs(e1["ats_us"] - e0["ats_us"]) == pytest.approx(3.0)
+
+
+def test_aligned_merge_across_midfile_clock_restart(tmp_path):
+    # a resume appends a FRESH tracer to the same file: new t0, new
+    # offset. Aligned timestamps must stay monotone across the seam
+    # even though raw ts_us resets to ~0.
+    d = str(tmp_path)
+    _w(os.path.join(d, "events_rank0.jsonl"), [
+        _clock(0, t0_us=10_000.0),
+        _clock(0, t0_us=10_000.0, restart=False, offset_us=0.0),
+        _span(0, "iteration", 100.0, 500.0, it=0),
+        # restart: clock origin jumped forward (same machine, later
+        # boot of the tracer) and raw ts_us starts over
+        _clock(0, t0_us=60_000.0),
+        _clock(0, t0_us=60_000.0, restart=False, offset_us=0.0),
+        _span(0, "iteration", 5.0, 400.0, it=1),
+    ])
+    segs = obs_dist.rank_segments(d)
+    assert len(segs[0]) == 2
+    tls = obs_dist.aligned_timelines(d)
+    ats = [r["ats_us"] for r in tls[0]]
+    assert ats == sorted(ats), "aligned order must be monotone " \
+        "across a mid-file clock restart"
+    assert ats[1] == pytest.approx(60_005.0)
+
+
+def test_legacy_file_without_clock_header_still_loads(tmp_path):
+    d = str(tmp_path)
+    _w(os.path.join(d, "events_rank0.jsonl"), [
+        _span(0, "iteration", 50.0, 10.0, it=0),
+    ])
+    segs = obs_dist.rank_segments(d)
+    (s,) = segs[0]
+    assert s["aligned"] is False and s["t0_us"] == 0.0
+    tls = obs_dist.aligned_timelines(d)
+    assert tls[0][0]["ats_us"] == pytest.approx(50.0)
+
+
+# ---------------------------------------------------------------------------
+# collective decomposition
+# ---------------------------------------------------------------------------
+
+
+def _two_rank_dir(tmp_path):
+    """rank 1 enters the barrier 40us late; transfer itself takes
+    10us. Aligned clocks (offsets already zero)."""
+    d = str(tmp_path)
+    _w(os.path.join(d, "events_rank0.jsonl"), [
+        _clock(0, t0_us=0.0),
+        _clock(0, t0_us=0.0, restart=False, offset_us=0.0),
+        _span(0, "iteration", 0.0, 200.0, depth=0, it=0),
+        _span(0, "phase:wait", 80.0, 60.0, depth=1, it=0),
+        _span(0, "coll:barrier", 100.0, 50.0, depth=2, seq=0, tag="x"),
+    ])
+    _w(os.path.join(d, "events_rank1.jsonl"), [
+        _clock(1, t0_us=0.0),
+        _clock(1, t0_us=0.0, restart=False, offset_us=0.0),
+        _span(1, "iteration", 0.0, 200.0, depth=0, it=0),
+        _span(1, "phase:remesh", 10.0, 130.0, depth=1, it=0),
+        _span(1, "coll:barrier", 140.0, 10.0, depth=2, seq=0, tag="x"),
+    ])
+    return d
+
+
+def test_straggler_lag_vs_transfer_decomposition(tmp_path):
+    d = _two_rank_dir(tmp_path)
+    tls = obs_dist.aligned_timelines(d)
+    (inst,) = obs_dist.collective_instances(tls)
+    assert inst["name"] == "coll:barrier"
+    assert inst["world"] == 2
+    assert inst["straggler"] == 1
+    assert inst["lag_us"] == pytest.approx(40.0)   # 140 - 100
+    assert inst["transfer_us"] == pytest.approx(10.0)  # 150 - 140
+    comm = obs_dist.decompose_collectives(tls)
+    ph = comm["phases"]["coll:barrier"]
+    assert ph["worst_rank"] == 1
+    assert ph["lag_s"] == pytest.approx(40e-6)
+    assert ph["transfer_s"] == pytest.approx(10e-6)
+    # rank 0 sat 50us inside the barrier; rank 1 arrived 40us late
+    assert comm["per_rank"][0]["wait_s"] == pytest.approx(50e-6)
+    assert comm["per_rank"][0]["skew_s"] == pytest.approx(0.0)
+    assert comm["per_rank"][1]["skew_s"] == pytest.approx(40e-6)
+
+
+def test_collectives_matched_by_seq_not_wallclock(tmp_path):
+    # rank 1 missed seq 0 entirely (e.g. joined late): seq matching
+    # must NOT pair rank 0's seq-0 with rank 1's seq-1.
+    d = str(tmp_path)
+    _w(os.path.join(d, "events_rank0.jsonl"), [
+        _clock(0, t0_us=0.0),
+        _span(0, "coll:barrier", 10.0, 5.0, seq=0),
+        _span(0, "coll:barrier", 100.0, 5.0, seq=1),
+    ])
+    _w(os.path.join(d, "events_rank1.jsonl"), [
+        _clock(1, t0_us=0.0),
+        _span(1, "coll:barrier", 12.0, 5.0, seq=1),
+    ])
+    insts = obs_dist.collective_instances(
+        obs_dist.aligned_timelines(d)
+    )
+    worlds = {i["seq"]: i["world"] for i in insts}
+    assert worlds == {0: 1, 1: 2}
+
+
+# ---------------------------------------------------------------------------
+# critical path
+# ---------------------------------------------------------------------------
+
+
+def test_critical_path_names_gating_rank_and_phase(tmp_path):
+    d = _two_rank_dir(tmp_path)
+    tls = obs_dist.aligned_timelines(d)
+    rows = obs_dist.critical_path(tls)
+    assert rows, "expected critical-path rows"
+    head = rows[0]
+    # the segment up to the barrier is gated by rank 1 (last entrant),
+    # which was inside phase:remesh at the segment midpoint (70us)
+    assert head["it"] == 0
+    assert head["rank"] == 1
+    assert head["gate"] == "coll:barrier"
+    assert head["phase"] == "phase:remesh"
+    assert head["dur_us"] == pytest.approx(140.0)
+    # the iteration tail after the barrier exit belongs to someone
+    assert rows[-1]["gate"] == "iteration_end"
+
+
+def test_critical_path_single_rank_degenerates(tmp_path):
+    d = str(tmp_path)
+    _w(os.path.join(d, "events_rank0.jsonl"), [
+        _clock(0, t0_us=0.0),
+        _span(0, "iteration", 0.0, 100.0, it=0),
+        _span(0, "phase:remesh", 10.0, 80.0, depth=1, it=0),
+    ])
+    rows = obs_dist.critical_path(obs_dist.aligned_timelines(d))
+    assert len(rows) == 1
+    assert rows[0]["rank"] == 0
+    assert rows[0]["phase"] == "phase:remesh"
+
+
+# ---------------------------------------------------------------------------
+# merged Perfetto trace + render
+# ---------------------------------------------------------------------------
+
+
+def test_merged_trace_applies_clock_shift(tmp_path):
+    d = str(tmp_path)
+    for rank, (t0, off) in enumerate([(0.0, 0.0), (100.0, -30.0)]):
+        doc = dict(
+            traceEvents=[
+                dict(ph="M", pid=rank, name="process_name",
+                     args=dict(name=f"rank{rank}")),
+                dict(ph="X", pid=rank, tid=1, name="s", ts=10.0,
+                     dur=5.0),
+            ],
+            clock=dict(rank=rank, t0_us=t0, offset_us=off),
+        )
+        with open(os.path.join(d, f"trace_rank{rank}.json"),
+                  "w") as f:
+            json.dump(doc, f)
+    out = obs_dist.write_merged_trace(d)
+    assert out and out.endswith("trace_merged.json")
+    with open(out) as f:
+        merged = json.load(f)
+    ts = {e["pid"]: e["ts"] for e in merged["traceEvents"]
+          if e["ph"] == "X"}
+    assert ts[0] == pytest.approx(10.0)
+    assert ts[1] == pytest.approx(80.0)  # 10 + 100 - 30
+    meta = [e for e in merged["traceEvents"] if e["ph"] == "M"]
+    assert len(meta) == 2 and "ts" not in meta[0]
+
+
+def test_render_dist_sections(tmp_path):
+    d = _two_rank_dir(tmp_path)
+    text = obs_report.render_dist(d)
+    for want in ("clock alignment", "per-rank aligned timelines",
+                 "collective decomposition", "critical path",
+                 "coll:barrier", "trace_merged.json"):
+        assert want in text, f"missing section {want!r}"
+    # no trace_rank*.json fixtures here -> merged trace not written
+    assert not os.path.exists(os.path.join(d, "trace_merged.json"))
+    doc = obs_report.dist_summary(d)
+    assert doc["world"] == 2
+    assert doc["collectives"]["phases"]["coll:barrier"]["calls"] == 1
+
+
+# ---------------------------------------------------------------------------
+# real tracer integration: clock headers, chaos rendering unchanged
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_writes_clock_header_and_offset(tmp_path):
+    d = str(tmp_path)
+    tr = obs_trace.Tracer(d, rank=0)
+    with tr.span("iteration", it=0):
+        pass
+    tr.set_clock_offset(123.5, err_us=2.0, rounds=5)
+    tr.flush()
+    segs = obs_dist.rank_segments(d)
+    (s,) = segs[0]
+    assert s["aligned"] is True
+    assert s["offset_us"] == pytest.approx(123.5)
+    assert s["rounds"] == 5
+    assert s["t0_us"] > 0
+    # the chrome doc carries the clock for the merged-trace writer
+    with open(os.path.join(d, "trace_rank0.json")) as f:
+        doc = json.load(f)
+    assert doc["clock"]["offset_us"] == pytest.approx(123.5)
+    # single-rank timeline loaders must not see clock records
+    tl = obs_report.load_timeline(d)
+    assert all(r.get("type") != "clock" for r in tl)
+
+
+def test_resumed_tracer_appends_fresh_clock_segment(tmp_path):
+    d = str(tmp_path)
+    tr = obs_trace.Tracer(d, rank=0)
+    with tr.span("iteration", it=0):
+        pass
+    tr.flush()
+    tr2 = obs_trace.Tracer(d, rank=0)  # resume: same file, appended
+    with tr2.span("iteration", it=1):
+        pass
+    tr2.set_clock_offset(-7.0)
+    tr2.flush()
+    segs = obs_dist.rank_segments(d)
+    assert len(segs[0]) == 2
+    assert segs[0][1]["offset_us"] == pytest.approx(-7.0)
+    tls = obs_dist.aligned_timelines(d)
+    ats = [r["ats_us"] for r in tls[0] if r.get("type") == "span"
+           and r["name"] == "iteration"]
+    assert ats == sorted(ats)
+
+
+def test_chaos_report_unchanged_by_clock_records(tmp_path):
+    d = str(tmp_path)
+    tr = obs_trace.Tracer(d, rank=0)
+    tr.event("fault_injected", kind="kill", it=1)
+    tr.flush()
+    tl = obs_report.load_timeline(d)
+    assert tl and tl[0]["name"] == "fault_injected"
+    summary = obs_report.chaos_summary(d)
+    assert summary["ranks"]
+    assert "fault_injected" in obs_report.render_chaos(d)
+
+
+# ---------------------------------------------------------------------------
+# compile_s capture (PR-8 cold-cache caveat)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_s_captured_per_entry_point():
+    import jax.numpy as jnp
+
+    from parmmg_tpu.obs import costs as obs_costs
+    from parmmg_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.registry().reset()
+    col = obs_costs.CostCollector()
+    fn = jax.jit(lambda x: jnp.sin(x) * 2.0)
+    col.capture("unit_sin", fn, (jnp.ones((8,)),))
+    total = col.total_compile_s()
+    assert total > 0.0, "lower+compile wall must be recorded"
+    g = obs_metrics.registry().gauge("compile_s/unit_sin")
+    assert g.value == pytest.approx(total, rel=1e-6)
+    # a second shape variant accumulates
+    col.capture("unit_sin", fn, (jnp.ones((16,)),))
+    assert col.total_compile_s() > total
+    obs_metrics.registry().reset()

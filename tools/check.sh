@@ -82,6 +82,19 @@ rc=$?
 echo "## frontier-smoke rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
+# distributed-observability smoke: a traced 2-process run must leave
+# clock-ALIGNED per-rank timelines (synced offsets persisted in the
+# JSONL clock segments, rank 0 anchoring), a nonzero straggler-lag vs
+# transfer decomposition of the matched coll:* spans with per-rank
+# comm/wait_s gauges, the live-tets imbalance factor riding the
+# PERF_DB bench envelope (gate key `imbalance`), a rendered
+# critical-path table and the merged Perfetto trace
+timeout -k 10 900 env JAX_PLATFORMS=cpu PARMMG_STAGE_BUDGET_S=750 \
+    python tools/dist_obs_smoke.py
+rc=$?
+echo "## dist-obs rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
 # Pallas-kernel smoke: interpret-mode run of every registered kernel
 # on the tiny fixture with equivalence vs its lax reference, vmap +
 # shard_map dispatch parity, and the PMMGTPU_KERNELS=off driver A/B

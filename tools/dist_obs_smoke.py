@@ -1,0 +1,230 @@
+"""Distributed-observability smoke for the CI gate (check.sh dist-obs).
+
+The round-11 acceptance, end to end on the 2-process CPU fixture: a
+traced 2-rank `adapt_stacked_input` run (each rank owning 4 of the 8
+CPU devices, collectives crossing the process boundary) must leave a
+trace directory from which the cross-rank observatory reconstructs:
+
+1. **aligned timelines** — both ranks' clock segments carry a
+   synced offset (``sync_tracer_clock``'s median-of-K estimate, rank 0
+   exactly 0) and the aligned per-rank timelines are monotone;
+2. **collective decomposition** — the ``coll:*`` spans match across
+   ranks and split into nonzero straggler-lag + transfer, with a
+   worst-straggler rank named per phase and per-rank ``comm/wait_s``
+   both in the report and in the always-on metrics gauges;
+3. **imbalance in the bench record** — the per-iteration live-tets
+   max/mean factor rides the history records and lands in the PERF_DB
+   envelope (gate key ``imbalance``) exactly as `bench.run_dist`
+   publishes it;
+4. **critical path** — per-iteration rows naming the gating rank and
+   phase render, and the merged Perfetto trace is written.
+
+Run hermetically on CPU: ``python tools/dist_obs_smoke.py``; exit 0 =
+the whole pipeline behaved. ``--worker`` is the child mode (do not run
+directly). Budget knob: PARMMG_STAGE_BUDGET_S bounds the worker wait.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def worker() -> int:
+    """Child mode: one rank of the traced 2-process adapt run. The
+    PMMGTPU_* env (coordinator, trace dir, watchdog) comes from the
+    parent; prints DIST_IMB with the per-iteration imbalance series so
+    the parent can build the bench record without a second run."""
+    from parmmg_tpu.parallel import multihost
+
+    multi = multihost.init_from_env()
+
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _accel in ("axon", "tpu", "cuda", "rocm"):
+        _xb._backend_factories.pop(_accel, None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from parmmg_tpu import failsafe
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_stacked_input,
+    )
+    from parmmg_tpu.parallel.distribute import split_mesh
+    from parmmg_tpu.parallel.partition import sfc_partition
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    assert multi and jax.process_count() == 2, "2-process env required"
+    watchdog = float(os.environ.get("PMMGTPU_WATCHDOG", "120"))
+
+    mesh = unit_cube_mesh(3)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 8)))
+    st, comm = split_mesh(mesh, part, 8)
+    opts = DistOptions(
+        hsiz=0.32, niter=2, max_sweeps=4, nparts=8, min_shard_elts=8,
+        hgrad=None, polish_sweeps=0, watchdog_timeout=watchdog,
+    )
+    try:
+        _out, _comm2, info = adapt_stacked_input(st, comm, opts)
+    except failsafe.PeerLostError as e:
+        print(f"PEER_LOST rank={jax.process_index()}: {e}", flush=True)
+        os._exit(failsafe.PEER_LOST_EXIT_CODE)
+    imb = [r["imbalance"] for r in info["history"]
+           if "imbalance" in r]
+    print(f"DIST_IMB {json.dumps(imb)}", flush=True)
+    print(f"DIST_OK rank={jax.process_index()} "
+          f"status={int(info['status'])}", flush=True)
+    return 0
+
+
+def _spawn_pair(tmp: str, obs: str, timeout: float):
+    """fault_smoke's 2-process launch idiom, plus PMMGTPU_TRACE."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs, logs = [], []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PYTHONPATH=ROOT,
+            PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
+            PMMGTPU_NUM_PROCS="2",
+            PMMGTPU_PROC_ID=str(pid),
+            PMMGTPU_TRACE=obs,
+            PMMGTPU_WATCHDOG="120",
+            PYTHONFAULTHANDLER="1",
+        )
+        lp = os.path.join(tmp, f"rank{pid}.log")
+        logs.append(lp)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env, stdout=open(lp, "w"),
+            stderr=subprocess.STDOUT, cwd=ROOT,
+        ))
+    try:
+        rcs = [p.wait(timeout=timeout) for p in procs]
+    finally:
+        for p in procs:
+            p.kill()
+    return rcs, [open(lp).read() for lp in logs]
+
+
+def main() -> int:
+    budget = float(os.environ.get("PARMMG_STAGE_BUDGET_S", "600"))
+    tmp = tempfile.mkdtemp(prefix="parmmg_dist_obs_")
+    obs = os.path.join(tmp, "obs")
+    try:
+        rcs, logs = _spawn_pair(tmp, obs, timeout=budget)
+        if rcs != [0, 0]:
+            for i, log in enumerate(logs):
+                print(f"---- rank{i} log ----\n{log[-4000:]}",
+                      file=sys.stderr)
+            print(f"[dist-obs] worker exits {rcs}", file=sys.stderr)
+            return 1
+        assert all("DIST_OK" in log for log in logs), "no DIST_OK"
+
+        from parmmg_tpu.obs import dist as obs_dist
+        from parmmg_tpu.obs import history as obs_history
+        from parmmg_tpu.obs import metrics as obs_metrics
+        from parmmg_tpu.obs import report as obs_report
+
+        # 1. both ranks traced, clocks synced, timelines monotone ----
+        segs = obs_dist.rank_segments(obs)
+        assert sorted(segs) == [0, 1], f"ranks traced: {sorted(segs)}"
+        for rank in (0, 1):
+            last = segs[rank][-1]
+            assert last["aligned"], f"rank {rank} clock never synced"
+            assert last["rounds"] > 0, last
+        assert segs[0][-1]["offset_us"] == 0.0, "rank 0 must anchor"
+        off1 = segs[1][-1]["offset_us"]
+        tls = obs_dist.aligned_timelines(obs)
+        for rank, recs in tls.items():
+            ats = [r["ats_us"] for r in recs]
+            assert ats == sorted(ats), f"rank {rank} not monotone"
+
+        # 2. collective decomposition: nonzero wait, worst rank -----
+        comm = obs_dist.decompose_collectives(tls)
+        assert comm["instances"] > 0, "no matched collectives"
+        world2 = [n for n, ph in comm["phases"].items()
+                  if any(i["world"] == 2 for i in
+                         obs_dist.collective_instances(tls)
+                         if i["name"] == n)]
+        assert world2, "no collective matched across both ranks"
+        total_wait = {r: d["wait_s"] for r, d in
+                      comm["per_rank"].items()}
+        assert all(w > 0 for w in total_wait.values()), total_wait
+        named = [ph for ph in comm["phases"].values()
+                 if "worst_rank" in ph]
+        assert named, "no worst-straggler rank named"
+        merged = obs_metrics.merge_dir(obs)
+        assert merged and "comm/wait_s" in merged["gauges"], \
+            "comm/wait_s gauge missing"
+        gw = merged["gauges"]["comm/wait_s"]["per_rank"]
+        assert len(gw) == 2 and all(v > 0 for v in gw.values()), gw
+        assert "work/imbalance" in merged["gauges"], \
+            "work/imbalance gauge missing"
+
+        # 3. imbalance factor rides the bench/PERF_DB record --------
+        imb_line = next(ln for ln in logs[0].splitlines()
+                        if ln.startswith("DIST_IMB "))
+        imb = json.loads(imb_line[len("DIST_IMB "):])
+        assert imb and all(x >= 1.0 for x in imb), imb
+        import bench
+
+        payload = dict(metric="wall_s", value=0.0,
+                       imbalance=round(max(imb), 4),
+                       imbalance_series=imb)
+        rec = bench._envelope(payload, dict(dist=True, nparts=8))
+        assert rec["imbalance"] == round(max(imb), 4)
+        assert rec["rung"] == "dist-p8", rec["rung"]
+        assert "imbalance" in obs_history.GATE_KEYS, \
+            "perf gate cannot ratchet balance"
+
+        # 4. critical path renders; merged Perfetto trace written ---
+        cp = obs_dist.critical_path(tls)
+        assert cp, "no critical-path rows"
+        text = obs_report.render_dist(obs)
+        for want in ("clock alignment", "collective decomposition",
+                     "critical path", "trace_merged.json"):
+            assert want in text, f"report missing {want!r}"
+        assert os.path.exists(os.path.join(obs, "trace_merged.json"))
+
+        gated = {}
+        for row in cp:
+            gated[row["rank"]] = gated.get(row["rank"], 0.0) \
+                + row["dur_us"] / 1e6
+        print(f"[dist-obs] rank1 offset {off1:.1f}us "
+              f"(+/-{segs[1][-1]['err_us']:.1f}); "
+              f"wait {', '.join(f'r{r}={w:.3f}s' for r, w in sorted(total_wait.items()))}; "
+              f"imbalance max {max(imb):.4f}; "
+              f"critical path {len(cp)} row(s), gated "
+              f"{', '.join(f'r{r}={s:.3f}s' for r, s in sorted(gated.items()))}")
+        print("[dist-obs] aligned timelines, skew decomposition, "
+              "bench imbalance and critical path all verified")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(worker() if "--worker" in sys.argv else main())

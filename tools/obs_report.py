@@ -14,6 +14,14 @@ Usage:
                                   # -> typed terminal timeline per job
                                   # (file-ordered, spans server
                                   # restarts), tenant/refusal rollups
+  python tools/obs_report.py <trace-dir> --dist 1   # cross-rank view:
+                                  # clock-aligned per-rank timelines,
+                                  # per-phase collective decomposition
+                                  # (straggler lag vs transfer, worst
+                                  # rank named), load-imbalance factor
+                                  # and the per-iteration critical
+                                  # path; also writes the merged
+                                  # Perfetto trace trace_merged.json
   python tools/obs_report.py <trace-dir> --merge-metrics out.json
                                   # one world metrics doc from the
                                   # per-rank metrics_rank*.json files
@@ -63,6 +71,13 @@ def main():
             json.dump(merged, f, indent=1)
         print(f"merged {merged['world']} rank doc(s) -> "
               f"{flags['merge-metrics']}")
+        return 0
+    if flags.get("dist", "") not in ("", "0"):
+        if flags.get("json", "") not in ("", "0"):
+            print(json.dumps(obs_report.dist_summary(trace_dir),
+                             indent=1, default=str))
+            return 0
+        print(obs_report.render_dist(trace_dir))
         return 0
     if flags.get("serve", "") not in ("", "0"):
         if flags.get("json", "") not in ("", "0"):

@@ -29,7 +29,7 @@ the OS boundary:
 Usage::
 
   python tools/serve.py --spool DIR [--journal SPEC] [--warmup 1]
-      [--idle-exit S] [--trace DIR]
+      [--idle-exit S] [--trace DIR] [--status PORT]
   python tools/serve.py --solo spec.json [--journal SPEC]
   python tools/serve.py --bench 1 [--jobs 6] [--size-class tiny]
       [--db PERF_DB.jsonl --update 1]
@@ -331,6 +331,10 @@ def main() -> int:
                     help="exit 0 after S idle seconds (smoke mode)")
     ap.add_argument("--trace", default=None,
                     help="PMMGTPU_TRACE dir for spans/events/counters")
+    ap.add_argument("--status", type=int, default=None,
+                    help="serve Prometheus serve/* counters + queue "
+                         "occupancy at http://127.0.0.1:PORT/metrics "
+                         "(0 = ephemeral port)")
     ap.add_argument("--jobs", type=int, default=6,
                     help="bench: synthetic job count")
     ap.add_argument("--json", default=None,
@@ -373,11 +377,24 @@ def main() -> int:
         if args.warmup:
             s = server.warmup()
             print(f"[serve] warmup {s}s")
-        if args.solo:
-            return main_solo(args, server)
-        if not args.spool:
-            raise SystemExit("need --spool DIR, --solo SPEC or --bench")
-        return main_server(args, server)
+        status = None
+        if args.status is not None:
+            from parmmg_tpu.service import StatusServer
+
+            status = StatusServer(server, port=args.status).start()
+            print(f"[serve] status endpoint: "
+                  f"http://{status.host}:{status.port}/metrics")
+        try:
+            if args.solo:
+                return main_solo(args, server)
+            if not args.spool:
+                raise SystemExit(
+                    "need --spool DIR, --solo SPEC or --bench"
+                )
+            return main_server(args, server)
+        finally:
+            if status is not None:
+                status.close()
     except CheckpointIOError as e:
         print(f"[serve] journal store I/O failure: {e}",
               file=sys.stderr)
