@@ -389,6 +389,10 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         round(r["n_active"] / max(r["n_unique"], 1), 4)
         for r in info["history"] if "n_active" in r
     ]
+    # unit-band edge fraction trajectory (round 12 obs.health
+    # telemetry): the final value is the `len/in_band` gate key —
+    # quality in the reference's own -prilen terms
+    band = [r["in_band"] for r in info["history"] if "in_band" in r]
     _note_phase("converged-probe")
     return _envelope({
         "metric": "tets_per_sec",
@@ -403,6 +407,8 @@ def run(n=10, hsiz=0.05, niter=1, max_sweeps=12, anchor=CPU_ANCHOR_TPS,
         "recompiles": dict(counter.counts),
         "steady_recompiles": steady_misses,
         "sweep_active_fraction": saf,
+        "len/in_band": band[-1] if band else 0.0,
+        "in_band_series": band,
         # cost of one converged (no-op) sweep, full-table vs drained
         # frontier — the centralized half of the adapt-vs-distributed
         # parity check (run_dist records the distributed half)
@@ -473,6 +479,7 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
     # WORST iteration so the perf gate can ratchet balance, and the
     # whole series for the report
     imb = [r["imbalance"] for r in info["history"] if "imbalance" in r]
+    band = [r["in_band"] for r in info["history"] if "in_band" in r]
 
     _note_phase("dist-converged-probe")
     dist_cfg = dict(dist=True, n=n, hsiz=hsiz, nparts=nparts,
@@ -515,6 +522,8 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
         "sweep_active_fraction": [round(x, 4) for x in saf],
         "imbalance": round(max(imb), 4) if imb else 0.0,
         "imbalance_series": [round(x, 4) for x in imb],
+        "len/in_band": band[-1] if band else 0.0,
+        "in_band_series": band,
         # AOT lower+compile seconds this process paid (0.0 on untraced
         # runs — the cost capture is trace-gated), so wall comparisons
         # can exclude compile instead of warning about it

@@ -22,7 +22,12 @@ import numpy as np
 
 from ..core import adjacency, metric as metric_mod, tags
 from ..core.mesh import Mesh, compact, compact_aux
-from ..obs import costs as obs_costs, metrics as obs_metrics, trace as obs_trace
+from ..obs import (
+    costs as obs_costs,
+    health as obs_health,
+    metrics as obs_metrics,
+    trace as obs_trace,
+)
 from ..ops import analysis, collapse, common, quality, smooth, split, swap
 
 
@@ -169,6 +174,29 @@ class SweepStats(NamedTuple):
     n_unique: jax.Array
     split_capped: jax.Array
     n_active: jax.Array     # active edges offered to this sweep's ops
+    # unit-mesh telemetry (ParMmg -prilen analog, health observatory):
+    # edges of the sweep's exit tables whose metric length lands in
+    # [LSHRT, LLONG], and the edge count they were measured over
+    n_len_unit: jax.Array
+    n_len_edges: jax.Array
+
+
+def _len_band_counts(mesh: Mesh, edges, emask):
+    """Device-side unit-band edge count over the sweep's exit tables:
+    (n_in_band, n_edges), both int32. One `edge_length` pass — the same
+    metric lengths the split/collapse gates consume — so it rides every
+    dispatch path (fused while_loop, unfused host loop, vmap, shard_map)
+    at one reduction's cost. In frontier mode the tables may carry
+    pending level-1 deltas (telemetry-grade mid-run, exact at
+    convergence when the tables are clean)."""
+    p0, p1 = mesh.vert[edges[:, 0]], mesh.vert[edges[:, 1]]
+    m0, m1 = mesh.met[edges[:, 0]], mesh.met[edges[:, 1]]
+    l = metric_mod.edge_length(p0, p1, m0, m1)
+    band = emask & (l >= metric_mod.LSHRT) & (l <= metric_mod.LLONG)
+    return (
+        jnp.sum(band.astype(jnp.int32)),
+        jnp.sum(emask.astype(jnp.int32)),
+    )
 
 
 class Frontier(NamedTuple):
@@ -657,6 +685,7 @@ def _sweep_body(
                 mesh, edges, emask, t2e, n_unique, chg, adja_ok
             )
 
+    n_len_unit, n_len_edges = _len_band_counts(mesh, edges, emask)
     stats = SweepStats(
         nsplit=s_split.nsplit,
         ncollapse=ncollapse,
@@ -665,6 +694,8 @@ def _sweep_body(
         n_unique=n_unique,
         split_capped=s_split.capped,
         n_active=n_active,
+        n_len_unit=n_len_unit,
+        n_len_edges=n_len_edges,
     )
     if not fr:
         return mesh, stats
@@ -700,7 +731,7 @@ UNFUSED_TCAP = int(os.environ.get("PARMMG_UNFUSED_TCAP", 600_000))
 # history columns of remesh_sweeps: one int32 row per executed sweep
 HIST_COLS = (
     "nsplit", "ncollapse", "nswap", "nmoved", "ne", "np", "n_unique",
-    "capped", "n_active",
+    "capped", "n_active", "n_len_unit", "n_len_edges",
 )
 
 
@@ -711,7 +742,7 @@ def _hist_row(stats: "SweepStats", ne, npo):
         stats.nsplit, stats.ncollapse, stats.nswap, stats.nmoved,
         jnp.asarray(ne, jnp.int32), jnp.asarray(npo, jnp.int32),
         stats.n_unique, stats.split_capped.astype(jnp.int32),
-        stats.n_active,
+        stats.n_active, stats.n_len_unit, stats.n_len_edges,
     ]).astype(jnp.int32)  # counters can arrive int64 under x64
 
 
@@ -1037,6 +1068,18 @@ def ensure_capacity(mesh: Mesh, opts: AdaptOptions) -> Mesh:
     return mesh
 
 
+def _rec_in_band(rec: dict) -> dict:
+    """Attach the unit-band edge fraction (`in_band`, the `len/in_band`
+    telemetry scalar) to a HIST_COLS host record. Idempotent: a record
+    that already carries `in_band` (distributed world sums) is left
+    alone; one without the length columns gets nothing."""
+    if "in_band" not in rec and "n_len_unit" in rec:
+        rec["in_band"] = round(
+            rec["n_len_unit"] / max(rec.get("n_len_edges", 0), 1), 6
+        )
+    return rec
+
+
 def run_sweep_loop(
     state,
     opts: AdaptOptions,
@@ -1070,6 +1113,7 @@ def run_sweep_loop(
         # XLA device trace
         with tr.device_span("sweep", it=it, sweep=sweep):
             state, rec = sweep_fn(state, ecap)
+        _rec_in_band(rec)
         obs_metrics.record_sweep(rec)
         overflow = rec["n_unique"] > ecap
         if overflow:
@@ -1184,6 +1228,7 @@ def run_batched_sweep_loop(
         for i, row in enumerate(rows):
             rec = dict(zip(HIST_COLS, (int(x) for x in row)))
             rec["capped"] = bool(rec["capped"])
+            _rec_in_band(rec)
             rec.update(iter=it, sweep=done + i)
             history.append(rec)
             obs_metrics.record_sweep(rec)
@@ -1380,12 +1425,23 @@ def adapt(
         # untraced: the hbm/* gauges are always-on metrics
         _close_phase()
         _phase_name[0] = name
+        # live endpoint: phase + heartbeat refresh (obs.health run
+        # state, served under PMMGTPU_STATUS_PORT)
+        obs_health.run_state().update(phase=name, driver="centralized")
         if tr.enabled:
             _phase_span[0] = tr.span(f"phase:{name}")
             _phase_span[0].__enter__()
         if opts.verbose >= 2:
             print(f"  ## phase: {name}", flush=True)
 
+    # live run endpoint (PMMGTPU_STATUS_PORT contract): serves
+    # /healthz + /metrics from the first phase through the iteration
+    # loop. Lazy import — the service package is a consumer of this
+    # module. Closed in the loop's finally; a pre-loop exception leaks
+    # only a daemon thread (same contract as the open phase span).
+    from ..service import status as service_status
+
+    status_srv = service_status.serve_run_from_env()
     resume = fs.resume()
     if resume is not None:
         _phase("resume")
@@ -1471,6 +1527,7 @@ def adapt(
     fs.arm_preemption()
     try:
         while it < opts.niter:
+            obs_health.run_state().update(iteration=it)
             if fs.preempt_requested:
                 raise failsafe.PreemptionError(
                     f"SIGTERM received before iteration {it} — the "
@@ -1594,6 +1651,8 @@ def adapt(
         # the open phase span must not leak past an exception exit —
         # the timeline should end where the run did
         _close_phase()
+        if status_srv is not None:
+            status_srv.close()
 
     # once, after the final iteration — polishing between iterations is
     # wasted work (the next iteration's insertion sweeps disturb it)
@@ -1605,8 +1664,25 @@ def adapt(
 
         mesh = interp.interp_fields_only(mesh, old_snapshot)
     h1 = quality.quality_histogram(mesh)
+    # unit-mesh goal on the FINAL mesh (-prilen role): exact edge tables
+    # from the compacted connectivity, one device reduction
+    len_out = quality.mesh_length_stats(mesh)
+    len_doc = quality.length_stats_doc(len_out)
+    verdict = obs_health.assess(
+        history, converge_frac=opts.converge_frac,
+        max_sweeps=opts.max_sweeps, status=int(status),
+    )
+    obs_health.emit_run_health(
+        history, length_doc=len_doc, verdict=verdict,
+        driver="centralized", tracer=tr,
+    )
+    obs_health.run_state().update(
+        phase="done", verdict=verdict["verdict"],
+        in_band=len_doc["in_band"],
+    )
     _close_phase()
     info = dict(history=history, qual_in=h0, qual_out=h1,
+                len_out=len_out, health=verdict,
                 presize_skipped=presize_skipped,
                 mem_budget_mb=opts.mem_budget_mb,
                 ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),

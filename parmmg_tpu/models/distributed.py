@@ -34,7 +34,12 @@ import numpy as np
 from ..core import adjacency, tags
 from ..core.mesh import Mesh, compact, compact_aux
 from ..failsafe import CapacityError
-from ..obs import costs as obs_costs, metrics as obs_metrics, trace as obs_trace
+from ..obs import (
+    costs as obs_costs,
+    health as obs_health,
+    metrics as obs_metrics,
+    trace as obs_trace,
+)
 from ..ops import analysis, interp, quality
 from ..parallel.distribute import (
     ShardComm,
@@ -291,6 +296,11 @@ def _rec_from_stats(s, stats) -> dict:
     na = g(stats.n_active).astype(np.int64)
     nu = g(stats.n_unique).astype(np.int64)
     shard_ne = g(s.tmask).sum(axis=1).astype(np.int64)
+    # unit-mesh telemetry: world sums of the per-shard band counts
+    # (interface edges count once per owning shard — thin-band
+    # approximation, see quality.reduce_length_stats)
+    n_len_unit = int(g(stats.n_len_unit).astype(np.int64).sum())
+    n_len_edges = int(g(stats.n_len_edges).astype(np.int64).sum())
     return dict(
         nsplit=int(g(stats.nsplit).sum()),
         ncollapse=int(g(stats.ncollapse).sum()),
@@ -304,6 +314,9 @@ def _rec_from_stats(s, stats) -> dict:
         active_fraction=round(
             float(na.sum()) / max(int(nu.sum()), 1), 6
         ),
+        n_len_unit=n_len_unit,
+        n_len_edges=n_len_edges,
+        in_band=round(n_len_unit / max(n_len_edges, 1), 6),
         shard_active=[
             round(float(a) / max(int(u), 1), 4)
             for a, u in zip(na.tolist(), nu.tolist())
@@ -329,10 +342,17 @@ def _drained_rec(st: Mesh, history: List[dict]) -> dict:
         if r.get("n_unique"):
             last_nu = int(r["n_unique"])
             break
+    # a drained sweep changes no edges: the unit-band fraction carries
+    # forward from the last measured sweep
+    last_band = None
+    for r in reversed(history):
+        if "in_band" in r:
+            last_band = float(r["in_band"])
+            break
     shard_ne = np.asarray(
         jax.device_get(jnp.sum(st.tmask, axis=1))
     ).astype(np.int64)
-    return dict(
+    rec = dict(
         nsplit=0, ncollapse=0, nswap=0, nmoved=0,
         ne=int(shard_ne.sum()),
         np=int(jax.device_get(jnp.sum(st.vmask))),
@@ -344,6 +364,9 @@ def _drained_rec(st: Mesh, history: List[dict]) -> dict:
         ),
         skipped=True,
     )
+    if last_band is not None:
+        rec["in_band"] = last_band
+    return rec
 
 
 def _frontier_stale(fr: Frontier, s: Mesh, ecap: int) -> bool:
@@ -681,6 +704,38 @@ def _resume_stacked(resume, opts: DistOptions):
     return stacked, icap, fr0
 
 
+def _finish_dist_info(stacked: Mesh, history: List[dict], h_in, fs,
+                      status, opts: "DistOptions", driver: str) -> dict:
+    """Common exit bookkeeping of both distributed entry points: the
+    world quality histogram, the world edge-length histogram (per-shard
+    unique edges merged like `merge_stacked_histograms` — the
+    `PMMG_prilen` world totals), the obs.health termination verdict and
+    its tracer emission. Returns the info dict."""
+    h_out = quality.merge_stacked_histograms(
+        jax.vmap(quality.quality_histogram)(stacked)
+    )
+    ecap = int(stacked.tet.shape[1] * 1.7) + 64
+    len_out = quality.merge_stacked_length_stats(
+        jax.vmap(lambda m: quality.mesh_length_stats(m, ecap))(stacked)
+    )
+    len_doc = quality.length_stats_doc(len_out)
+    verdict = obs_health.assess(
+        history, converge_frac=opts.converge_frac,
+        max_sweeps=opts.max_sweeps, status=int(status),
+    )
+    obs_health.emit_run_health(
+        history, length_doc=len_doc, verdict=verdict, driver=driver,
+    )
+    obs_health.run_state().update(
+        phase="done", verdict=verdict["verdict"],
+        in_band=len_doc["in_band"],
+    )
+    return dict(history=history, qual_in=h_in, qual_out=h_out,
+                len_out=len_out, health=verdict,
+                ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),
+                status=status)
+
+
 @obs_trace.traced("adapt_distributed", driver="distributed")
 def adapt_distributed(
     mesh: Mesh,
@@ -731,12 +786,9 @@ def adapt_distributed(
             ckpt_meta=dict(qual_in=resume.meta.get("qual_in")),
             fr0=fr0,
         )
-        h_out = quality.merge_stacked_histograms(
-            jax.vmap(quality.quality_histogram)(stacked)
+        info = _finish_dist_info(
+            stacked, history, h_in, fs, status, opts, "distributed"
         )
-        info = dict(history=history, qual_in=h_in, qual_out=h_out,
-                    ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),
-                    status=status)
         return stacked, comm, info
 
     # --- preprocess (reference PMMG_preprocessMesh, src/libparmmg.c:128) --
@@ -786,12 +838,9 @@ def adapt_distributed(
         stacked, opts, hausd, history, fs=fs,
         ckpt_meta=dict(qual_in=failsafe._histo_to_json(h_in)),
     )
-    h_out = quality.merge_stacked_histograms(
-        jax.vmap(quality.quality_histogram)(stacked)
+    info = _finish_dist_info(
+        stacked, history, h_in, fs, status, opts, "distributed"
     )
-    info = dict(history=history, qual_in=h_in, qual_out=h_out,
-                ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),
-                status=status)
     return stacked, comm, info
 
 
@@ -871,6 +920,11 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
     # continuation gates its sweeps exactly like the uninterrupted run
     # (bit-identical resume holds with the frontier on).
     fr_carry = None if fr0 is None else jnp.asarray(fr0, bool)
+    # live status endpoint (PMMGTPU_STATUS_PORT contract): lazy import
+    # keeps models free of a module-level service dependency
+    from ..service import status as service_status
+
+    status_srv = service_status.serve_run_from_env()
     fs.arm_preemption()
     try:
         while it < opts.niter:
@@ -885,6 +939,9 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
             # a typed PeerLostError instead of a hang in the first
             # collective of the iteration (no-op single-process)
             fs.heartbeat(it)
+            obs_health.run_state().update(
+                iteration=it, phase="iteration", driver="distributed"
+            )
 
             def _iteration(st, cm, ic, fr):
                 st, cm, ic, fr = _one_iteration(
@@ -1034,6 +1091,8 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
         # async staging: commit any staged epoch before control leaves
         # the loop — every exit path ends with the queue drained
         fs.finish()
+        if status_srv is not None:
+            status_srv.close()
 
     stacked = assign_global_ids(stacked)
     comm = rebuild_comm(stacked, icap)
@@ -1059,6 +1118,7 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
     # src/grpsplit_pmmg.c:1224) — needs fresh adjacency for the walk
     old = jax.vmap(adjacency.build_adjacency)(stacked)
 
+    obs_health.run_state().update(phase="remesh")
     with tr.span("phase:remesh", it=it):
         stacked, fr = remesh_phase(stacked, opts, emult, history, it,
                                    hausd, fs=fs, fr0=fr)
@@ -1072,6 +1132,7 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
     stacked = fs.fire(it, "remesh", stacked)
 
     # interpolate metric + fields from the snapshot
+    obs_health.run_state().update(phase="interp")
     with tr.device_span("phase:interp", it=it):
         stacked = interp_phase(stacked, old, opts)
     obs_costs.record_hbm("interp")
@@ -1353,13 +1414,9 @@ def adapt_stacked_input(
             ckpt_meta=dict(qual_in=resume.meta.get("qual_in")),
             fr0=fr0,
         )
-        h_out = quality.merge_stacked_histograms(
-            jax.vmap(quality.quality_histogram)(st)
+        return st, comm, _finish_dist_info(
+            st, history, h_in, fs, status, opts, "distributed-input"
         )
-        return st, comm, dict(history=history, qual_in=h_in,
-                              qual_out=h_out,
-                              ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),
-                              status=status)
 
     # per-shard preprocess: adjacency + analysis + metric, then the
     # cross-shard feature agreement pass for surface edges split by an
@@ -1404,12 +1461,9 @@ def adapt_stacked_input(
         icap0=comm.icap if comm is not None else None,
         fs=fs, ckpt_meta=dict(qual_in=failsafe._histo_to_json(h_in)),
     )
-    h_out = quality.merge_stacked_histograms(
-        jax.vmap(quality.quality_histogram)(stacked)
+    info = _finish_dist_info(
+        stacked, history, h_in, fs, status, opts, "distributed-input"
     )
-    info = dict(history=history, qual_in=h_in, qual_out=h_out,
-                ckpt_overlap_s=round(fs.ckpt_overlap_s, 3),
-                status=status)
     return stacked, comm, info
 
 

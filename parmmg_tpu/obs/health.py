@@ -1,0 +1,485 @@
+"""Run-health observatory: termination verdicts, churn detection,
+drain curves and the live run state behind `PMMGTPU_STATUS_PORT`.
+
+ParMmg judges an adaptation by the unit-mesh goal — the fraction of
+edges whose metric length lands in [1/sqrt2, sqrt2] (`PMMG_prilen`,
+reference `src/quality_pmmg.c:591`) — yet "why did the run stop?" is
+normally answered by reading stdout. This module turns the driver
+history (the HIST_COLS per-sweep records, now carrying
+`n_len_unit`/`n_len_edges` and the derived `in_band` fraction) into:
+
+- :func:`assess` — a typed per-run termination verdict
+  (``converged | stalled | oscillating | budget_exhausted``) folding
+  operator-acceptance decay, the frontier drain curve, the in-band
+  trajectory and a split<->collapse churn detector (same-region thrash:
+  sweep k's splits undone by sweep k+1's collapses and vice versa);
+- :func:`emit_run_health` — the `health:*` tracer events the drivers
+  flush at run end, from which :func:`health_summary` /
+  :func:`render_health` (CLI ``tools/obs_report.py --health``)
+  reconstruct the post-mortem: verdict, world edge-length histogram
+  and drain curve;
+- :func:`run_state` — the process-local live snapshot (phase /
+  iteration / in-band / heartbeat age / drain ETA) that
+  `service.status.run_status_text` serves over HTTP while the run is
+  still going (`PMMGTPU_STATUS_PORT` contract).
+
+Everything here is host-side dict arithmetic over already-materialized
+history records — no device work, no extra syncs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from . import trace as trace_mod
+
+__all__ = [
+    "VERDICTS", "assess", "churn_scores", "drain_curve",
+    "format_history_rows", "render_length_doc", "emit_run_health",
+    "health_summary", "render_health", "run_state", "note_sweep",
+    "history_in_band",
+]
+
+VERDICTS = ("converged", "stalled", "oscillating", "budget_exhausted")
+
+# churn detector tuning: a consecutive sweep pair where at least
+# CHURN_MIN_FRACTION of the combined split+collapse work mutually
+# cancels (sweep k's splits matched by sweep k+1's collapses and vice
+# versa) counts as thrash; CHURN_PAIRS such pairs among the last
+# CHURN_WINDOW make the run "oscillating"
+CHURN_MIN_FRACTION = 0.35
+CHURN_WINDOW = 4
+CHURN_PAIRS = 2
+
+# acceptance decay: ops at budget end below this fraction of the
+# window start count as "still converging" (budget_exhausted), not
+# stalled
+DECAY_RATIO = 0.7
+
+# history rows shipped in the health:history tracer event are capped so
+# a 10k-sweep run cannot bloat the JSONL; the drop is recorded
+HISTORY_EVENT_CAP = 512
+
+
+def sweep_records(history: Sequence[dict]) -> List[dict]:
+    """The operator-sweep records of a driver history — `failure`
+    entries (rollbacks) carry no counters and are skipped."""
+    return [r for r in history if "nsplit" in r]
+
+
+def history_in_band(history: Sequence[dict]) -> Optional[float]:
+    """Last known unit-band fraction of a run history (None when no
+    sweep measured one — e.g. a pre-health checkpoint resumed)."""
+    for r in reversed(sweep_records(history)):
+        if "in_band" in r:
+            return float(r["in_band"])
+    return None
+
+
+def _ops(rec: dict) -> int:
+    return int(rec.get("nsplit", 0)) + int(rec.get("ncollapse", 0)) \
+        + int(rec.get("nswap", 0))
+
+
+def _active_fraction(rec: dict) -> float:
+    if "active_fraction" in rec:
+        return float(rec["active_fraction"])
+    return rec.get("n_active", 0) / max(rec.get("n_unique", 1), 1)
+
+
+def churn_scores(recs: Sequence[dict]) -> List[float]:
+    """Per consecutive same-iteration sweep pair: the fraction of the
+    pair's combined split+collapse work that mutually cancels —
+    min(split_k, collapse_{k+1}) + min(collapse_k, split_{k+1}) over
+    the pair's total ops. 1.0 = pure thrash, 0.0 = disjoint work."""
+    out: List[float] = []
+    for a, b in zip(recs, recs[1:]):
+        if a.get("iter") != b.get("iter"):
+            continue
+        cancel = (
+            min(int(a.get("nsplit", 0)), int(b.get("ncollapse", 0)))
+            + min(int(a.get("ncollapse", 0)), int(b.get("nsplit", 0)))
+        )
+        out.append(2.0 * cancel / max(_ops(a) + _ops(b), 1))
+    return out
+
+
+def drain_curve(recs: Sequence[dict]) -> dict:
+    """Frontier drain telemetry: the active-fraction series and a
+    linear-extrapolation ETA (sweeps until the active set reaches zero
+    at the recent drain rate; None when not draining)."""
+    series = [round(_active_fraction(r), 4) for r in recs]
+    eta = None
+    if len(series) >= 2:
+        k = min(len(series), 4)
+        rate = (series[-k] - series[-1]) / (k - 1)
+        if rate > 1e-6 and series[-1] > 0:
+            eta = round(series[-1] / rate, 1)
+        elif series[-1] == 0:
+            eta = 0.0
+    return dict(series=series, eta_sweeps=eta)
+
+
+def assess(
+    history: Sequence[dict],
+    converge_frac: float = 0.005,
+    max_sweeps: Optional[int] = None,
+    status: Optional[int] = None,
+) -> dict:
+    """Fold a driver history into the typed termination verdict.
+
+    Rules, in priority order over the final iteration's sweeps:
+
+    1. ``converged`` — the last sweep met the driver's own stopping
+       rule (ops <= converge_frac * ne, not capped) or the frontier
+       fully drained;
+    2. ``oscillating`` — sustained split<->collapse churn
+       (>= CHURN_PAIRS of the last CHURN_WINDOW pairs above
+       CHURN_MIN_FRACTION) with non-negligible ops;
+    3. ``budget_exhausted`` — the sweep budget ran out while
+       acceptance was still clearly decaying (>= 3 sweeps of evidence,
+       last ops <= DECAY_RATIO * window start);
+    4. ``stalled`` — everything else: ops neither converged nor
+       decaying (includes the forced max_sweeps=1 case, where one
+       sweep gives no decay evidence).
+    """
+    recs = sweep_records(history)
+    failures = len(history) - len(recs)
+    if not recs:
+        return dict(
+            verdict="stalled", reason="no operator sweeps recorded",
+            sweeps=0, iterations=0, failures=failures,
+            in_band_first=None, in_band_last=None,
+            churn=dict(scores=[], sustained=False),
+            drain=dict(series=[], eta_sweeps=None),
+            status=status,
+        )
+
+    last = recs[-1]
+    last_it = last.get("iter", 0)
+    tail = [r for r in recs if r.get("iter", 0) == last_it]
+    ops_tail = [_ops(r) for r in tail]
+    drain = drain_curve(recs)
+    bands = [float(r["in_band"]) for r in recs if "in_band" in r]
+
+    converged = (
+        not last.get("capped")
+        and _ops(last) <= converge_frac * max(int(last.get("ne", 0)), 1)
+    ) or (last.get("n_active", None) == 0 and last.get("skipped"))
+
+    scores = churn_scores(recs)
+    window = scores[-CHURN_WINDOW:]
+    hot = sum(1 for s in window if s >= CHURN_MIN_FRACTION)
+    sustained = (
+        hot >= CHURN_PAIRS
+        and _ops(last) > converge_frac * max(int(last.get("ne", 0)), 1)
+    )
+
+    decaying = (
+        len(ops_tail) >= 3
+        and ops_tail[-1] < ops_tail[0]
+        and ops_tail[-1] <= DECAY_RATIO * max(ops_tail[0], 1)
+    )
+    budget_hit = max_sweeps is None or len(tail) >= max_sweeps
+
+    if converged:
+        verdict, reason = "converged", (
+            f"last sweep ops {_ops(last)} <= "
+            f"{converge_frac:g} * ne {int(last.get('ne', 0))}"
+            if not last.get("skipped")
+            else "frontier drained (converged sweep skipped)"
+        )
+    elif sustained:
+        verdict, reason = "oscillating", (
+            f"{hot}/{len(window)} recent sweep pairs above "
+            f"{CHURN_MIN_FRACTION:.0%} split<->collapse churn "
+            f"(max {max(window):.0%})"
+        )
+    elif decaying and budget_hit:
+        verdict, reason = "budget_exhausted", (
+            f"ops still decaying ({ops_tail[0]} -> {ops_tail[-1]}) "
+            f"when the sweep budget ran out"
+        )
+    else:
+        verdict, reason = "stalled", (
+            f"ops flat at {_ops(last)} (neither converged nor "
+            f"decaying) after {len(tail)} sweep(s)"
+        )
+
+    return dict(
+        verdict=verdict, reason=reason,
+        sweeps=len(recs),
+        iterations=len({r.get("iter", 0) for r in recs}),
+        failures=failures,
+        ops_first=_ops(recs[0]), ops_last=_ops(last),
+        in_band_first=bands[0] if bands else None,
+        in_band_last=bands[-1] if bands else None,
+        churn=dict(
+            scores=[round(s, 4) for s in window],
+            max_score=round(max(scores), 4) if scores else 0.0,
+            sustained=sustained,
+        ),
+        drain=drain,
+        status=int(status) if status is not None else None,
+    )
+
+
+# -- formatting -----------------------------------------------------------
+
+def format_history_rows(history: Sequence[dict]) -> str:
+    """One line per sweep record — the single sweep-history formatter
+    (tools/sweep_hist.py renders through this; `--health` renders the
+    reconstructed rows through it too)."""
+    lines = []
+    for r in sweep_records(history):
+        band = f" band={float(r['in_band']):7.2%}" if "in_band" in r \
+            else ""
+        act = f" act={_active_fraction(r):4.0%}" \
+            if "n_active" in r or "active_fraction" in r else ""
+        flags = " CAP" if r.get("capped") else ""
+        flags += " skip" if r.get("skipped") else ""
+        lines.append(
+            f"it{r.get('iter', 0)} sw{r.get('sweep', 0):2d}: "
+            f"split={int(r.get('nsplit', 0)):6d} "
+            f"coll={int(r.get('ncollapse', 0)):6d} "
+            f"swap={int(r.get('nswap', 0)):6d} "
+            f"moved={int(r.get('nmoved', 0)):6d} "
+            f"ne={int(r.get('ne', 0)):8d}{act}{band}{flags}"
+        )
+    return "\n".join(lines)
+
+
+def render_length_doc(doc: dict) -> str:
+    """Render a `quality.length_stats_doc` payload — the post-mortem
+    twin of `quality.format_length_stats` (which needs device arrays)."""
+    def fin(v, fmt="12.4f"):
+        return format(float(v), fmt) if v is not None else "   --   "
+
+    ne = max(int(doc.get("nedge", 0)), 1)
+    edges = doc.get("edges", [])
+    counts = doc.get("counts", [])
+    lines = [
+        f"  -- UNIT EDGE LENGTHS  {int(doc.get('nedge', 0))} edges",
+        f"     AVERAGE LENGTH {fin(doc.get('lavg'))}",
+        f"     SMALLEST EDGE  {fin(doc.get('lmin'))}",
+        f"     LARGEST  EDGE  {fin(doc.get('lmax'))}",
+        f"     unit [1/sqrt2, sqrt2]: {int(doc.get('n_unit', 0))} "
+        f"({100.0 * int(doc.get('n_unit', 0)) / ne:.2f} %)",
+    ]
+    for k in range(len(edges) - 1):
+        c = counts[k + 1] if k + 1 < len(counts) else 0
+        lines.append(
+            f"     {edges[k]:6.2f} < L < {edges[k + 1]:6.2f}  "
+            f"{c:10d}  {100.0 * c / ne:6.2f} %"
+        )
+    if edges:
+        c_over = counts[len(edges)] if len(edges) < len(counts) else 0
+        lines.append(
+            f"     {edges[-1]:6.2f} < L          {c_over:10d}  "
+            f"{100.0 * c_over / ne:6.2f} %"
+        )
+    return "\n".join(lines)
+
+
+# -- tracer emission + post-mortem reconstruction -------------------------
+
+_HEALTH_ROW_COLS = (
+    "iter", "sweep", "nsplit", "ncollapse", "nswap", "nmoved", "ne",
+    "n_unique", "n_active", "in_band", "capped", "skipped",
+)
+
+
+def _compact_rows(recs: Sequence[dict]) -> List[list]:
+    return [[r.get(k) for k in _HEALTH_ROW_COLS] for r in recs]
+
+
+def emit_run_health(
+    history: Sequence[dict],
+    length_doc: Optional[dict] = None,
+    verdict: Optional[dict] = None,
+    driver: str = "centralized",
+    tracer=None,
+) -> None:
+    """Flush the run's health section as `health:*` tracer events (the
+    durable JSONL is what `--health` reconstructs from). World-level
+    payloads are emitted from rank 0 only — the history records are
+    already world sums on the distributed paths, so every rank would
+    write identical copies."""
+    tr = tracer or trace_mod.get_tracer()
+    if not tr.enabled or getattr(tr, "rank", 0) != 0:
+        return
+    recs = sweep_records(history)
+    rows = _compact_rows(recs)
+    dropped = max(len(rows) - HISTORY_EVENT_CAP, 0)
+    if dropped:
+        rows = rows[-HISTORY_EVENT_CAP:]
+    tr.event(
+        "health:history", driver=driver, cols=list(_HEALTH_ROW_COLS),
+        rows=rows, dropped=dropped,
+    )
+    if length_doc is not None:
+        tr.event("health:length_histogram", driver=driver, **length_doc)
+    if verdict is not None:
+        tr.event("health:verdict", driver=driver, **verdict)
+
+
+def _last_event(recs: Sequence[dict], name: str) -> Optional[dict]:
+    for r in reversed(recs):
+        if r.get("type") == "event" and r.get("name") == name:
+            return r.get("args", {})
+    return None
+
+
+def health_summary(dirpath: str) -> dict:
+    """Reconstruct the run-health section from a trace directory's
+    per-rank JSONL timelines. A run killed before its exit emit leaves
+    no `health:verdict` — the summary then re-assesses from whatever
+    `health:history` rows survived (possibly none)."""
+    from . import report as report_mod  # deferred: report imports health
+
+    tls = report_mod.rank_timelines(dirpath)
+    ranks = sorted(tls)
+    merged: List[dict] = [r for rank in ranks for r in tls[rank]]
+    hist_ev = _last_event(merged, "health:history")
+    history: List[dict] = []
+    if hist_ev:
+        cols = hist_ev.get("cols", list(_HEALTH_ROW_COLS))
+        for row in hist_ev.get("rows", []):
+            rec = {k: v for k, v in zip(cols, row) if v is not None}
+            history.append(rec)
+    verdict = _last_event(merged, "health:verdict")
+    if verdict is None and history:
+        verdict = assess(history)
+        verdict["reassessed"] = True
+    length = _last_event(merged, "health:length_histogram")
+    return dict(
+        dir=dirpath, ranks=ranks, history=history,
+        dropped=hist_ev.get("dropped", 0) if hist_ev else 0,
+        verdict=verdict, length=length,
+        drain=drain_curve(sweep_records(history)),
+        in_band=history_in_band(history),
+    )
+
+
+def render_health(dirpath: str) -> str:
+    """The ``--health`` report: verdict, unit edge-length histogram,
+    drain curve and the per-sweep history table."""
+    s = health_summary(dirpath)
+    lines = [f"== run health ({s['dir']}) =="]
+    lines.append(f"ranks traced: {s['ranks'] or 'none'}")
+    v = s["verdict"]
+    if v:
+        lines.append(
+            f"verdict: {v.get('verdict', '?')}"
+            + (" (reassessed post-mortem)" if v.get("reassessed") else "")
+        )
+        lines.append(f"  reason: {v.get('reason', '')}")
+        lines.append(
+            f"  sweeps {v.get('sweeps', 0)} over "
+            f"{v.get('iterations', 0)} iteration(s), "
+            f"failures {v.get('failures', 0)}"
+        )
+        if v.get("in_band_last") is not None:
+            first = v.get("in_band_first")
+            lines.append(
+                "  in-band trajectory: "
+                + (f"{first:.2%} -> " if first is not None else "")
+                + f"{v['in_band_last']:.2%}"
+            )
+        ch = v.get("churn", {})
+        if ch:
+            lines.append(
+                f"  churn: max {ch.get('max_score', 0.0):.0%}, "
+                f"sustained={bool(ch.get('sustained'))}"
+            )
+    else:
+        lines.append("verdict: unknown (no health events in trace)")
+    d = s["drain"]
+    if d["series"]:
+        lines.append("-- drain curve (active fraction per sweep) --")
+        lines.append(
+            "  " + " ".join(f"{x:.2f}" for x in d["series"][-16:])
+        )
+        eta = d["eta_sweeps"]
+        lines.append(
+            f"  eta: ~{eta:g} sweep(s) to empty frontier"
+            if eta is not None else "  eta: not draining"
+        )
+    if s["length"]:
+        lines.append(render_length_doc(s["length"]))
+    if s["history"]:
+        lines.append("-- sweep history --")
+        if s["dropped"]:
+            lines.append(f"  ({s['dropped']} earlier sweep(s) dropped "
+                         "from the trace event)")
+        lines.append(format_history_rows(s["history"]))
+    return "\n".join(lines)
+
+
+# -- live run state (PMMGTPU_STATUS_PORT backing store) -------------------
+
+class RunState:
+    """Process-local snapshot of the running adaptation for the live
+    status endpoint: phase, iteration, sweep, in-band fraction, drain
+    ETA and the monotonic heartbeat stamp every update refreshes. All
+    writes are a dict-merge under one lock — always-on like the
+    metrics registry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._doc: Dict[str, object] = {}
+        self._fracs: List[float] = []
+
+    def update(self, **kw) -> None:
+        # monotonic, not wall clock: the heartbeat AGE is what the
+        # endpoint serves, and it must survive wall-clock steps
+        with self._lock:
+            self._doc.update(
+                {k: v for k, v in kw.items() if v is not None}
+            )
+            self._doc["heartbeat_ts"] = time.monotonic()
+
+    def note_sweep(self, rec: dict) -> None:
+        af = _active_fraction(rec)
+        with self._lock:
+            self._fracs.append(af)
+            del self._fracs[:-8]
+            fr = list(self._fracs)
+        d = drain_curve([dict(active_fraction=x) for x in fr])
+        self.update(
+            sweep=rec.get("sweep"), in_band=rec.get("in_band"),
+            active_fraction=round(af, 4),
+            drain_eta_sweeps=d["eta_sweeps"],
+        )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            d = dict(self._doc)
+        ts = d.pop("heartbeat_ts", None)
+        d["heartbeat_age_s"] = (
+            round(time.monotonic() - ts, 3) if ts is not None else None
+        )
+        return d
+
+    def reset(self) -> None:
+        with self._lock:
+            self._doc.clear()
+            self._fracs.clear()
+
+
+_RUN_STATE = RunState()
+
+
+def run_state() -> RunState:
+    """The process-global live run state (the drivers write it at phase
+    / iteration / sweep boundaries; `service.status` serves it)."""
+    return _RUN_STATE
+
+
+def note_sweep(rec: dict) -> None:
+    """Hook called by `obs.metrics.record_sweep` for every sweep record
+    on every driver path — keeps the live endpoint current without
+    separate instrumentation sites."""
+    _RUN_STATE.note_sweep(rec)

@@ -67,6 +67,10 @@ GATE_KEYS: Dict[str, str] = {
     # BALANCE, not just throughput — absent from centralized records,
     # and absent keys are skipped
     "imbalance": "lower",
+    # unit-mesh goal (obs.health, round 12): final unit-band edge
+    # fraction of the run — the gate ratchets mesh QUALITY in the
+    # reference's own -prilen terms, alongside qmin
+    "len/in_band": "higher",
 }
 
 _ENVELOPE = ("schema", "run_id", "git_sha", "timestamp", "platform",

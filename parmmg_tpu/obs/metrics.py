@@ -15,6 +15,9 @@ Naming convention (what `tools/obs_report.py` renders):
   ops/candidates         active edges offered to the operators
   sweeps                 executed operator sweeps
   sweep_active_fraction  gauge: last sweep's active fraction
+  len/in_band            gauge: last sweep's unit-band edge fraction
+                         (metric length in [1/sqrt2, sqrt2] — the
+                         obs.health unit-mesh telemetry)
   migrate/cells_moved    tets exchanged between shards
   migrate/payload_bytes  estimated migration payload
   comm/barriers          coordination barriers entered
@@ -227,6 +230,14 @@ def record_sweep(rec: dict) -> None:
         reg.gauge("work/imbalance").set(rec["imbalance"])
     for i, ne in enumerate(rec.get("shard_ne", ())):
         reg.gauge(f"work/live_tets/shard{i}").set(ne)
+    # unit-mesh telemetry (round 12): the in-band edge fraction rides
+    # every sweep record — gauge for the live endpoint / reports, and
+    # the obs.health run state is refreshed in the same stroke
+    if "in_band" in rec:
+        reg.gauge("len/in_band").set(rec["in_band"])
+    from . import health as health_mod  # deferred: health is pure host
+
+    health_mod.note_sweep(rec)
 
 
 # ---------------------------------------------------------------------------

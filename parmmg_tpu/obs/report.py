@@ -47,6 +47,7 @@ __all__ = [
     "load_trace_events", "load_timeline", "summarize", "render",
     "rank_timelines", "chaos_summary", "render_chaos",
     "serve_summary", "render_serve", "dist_summary", "render_dist",
+    "health_summary", "render_health",
 ]
 
 
@@ -62,6 +63,19 @@ def render_dist(dirpath: str) -> str:
     from . import dist as dist_mod
     dist_mod.write_merged_trace(dirpath)
     return dist_mod.render_dist(dirpath)
+
+
+def health_summary(dirpath: str) -> dict:
+    """Run-health view (unit-length histogram, termination verdict,
+    drain curve, sweep history) — delegates to :mod:`..obs.health`."""
+    from . import health as health_mod
+    return health_mod.health_summary(dirpath)
+
+
+def render_health(dirpath: str) -> str:
+    """Render the run-health ``--health`` report (see obs.health)."""
+    from . import health as health_mod
+    return health_mod.render_health(dirpath)
 
 
 def load_trace_events(dirpath: str) -> List[dict]:
@@ -349,6 +363,11 @@ def serve_summary(dirpath: str) -> dict:
                 j["code"] = args.get("code")
                 j["wall_s"] = args.get("wall_s")
                 j["digest"] = args.get("digest")
+                # round 12 quality column: the server stamps the final
+                # unit-band edge fraction and the obs.health verdict
+                # on the terminal event
+                j["in_band"] = args.get("in_band")
+                j["verdict"] = args.get("verdict")
             j["chain"].append(dict(name=name, ts_us=r.get("ts_us", 0),
                                    args=args))
     tenants: Dict[str, dict] = {}
@@ -398,9 +417,14 @@ def render_serve(dirpath: str) -> str:
         code = f" ({j['code']})" if j.get("code") else ""
         att = (f", {j['attempts']} attempt(s)"
                if j["attempts"] > 1 else "")
+        qual = ""
+        if j.get("in_band") is not None:
+            qual = f"  in-band {float(j['in_band']):.3f}"
+        if j.get("verdict"):
+            qual += f"  verdict {j['verdict']}"
         lines.append(
             f"-- job {j['job_id']} [tenant {j['tenant']}, class "
-            f"{j['size_class'] or '?'}] -> {state}{code}{att} --"
+            f"{j['size_class'] or '?'}] -> {state}{code}{att}{qual} --"
         )
         for c in j["chain"]:
             args = c["args"]

@@ -135,15 +135,25 @@ def merge_stacked_histograms(h: QualityHisto) -> QualityHisto:
     )
 
 
+def _finite_or_dash(v, fmt: str = "8.6f") -> str:
+    """Render a summary scalar, or dashes when the reduction ran over an
+    empty set (min over nothing is +/-inf, averages can be nan) — an
+    empty shard or a fully-drained frontier must still format."""
+    v = float(v)
+    return format(v, fmt) if math.isfinite(v) else "   --   "
+
+
 def format_histogram(h: QualityHisto, label: str = "MESH QUALITY") -> str:
     """Human-readable report in the spirit of the reference's stdout
-    histogram (verbosity-gated in `PMMG_qualhisto`)."""
+    histogram (verbosity-gated in `PMMG_qualhisto`). Safe on empty
+    histograms (ne=0): summary scalars render as dashes, percentages
+    as 0."""
     counts = [int(c) for c in jax.device_get(h.counts)]
     n = len(counts)
     lines = [
         f"  -- {label}  {int(h.ne)} elements",
-        f"     BEST {float(h.qmax):8.6f}  AVRG {float(h.qavg):8.6f} "
-        f" WRST {float(h.qmin):8.6f} (elt {int(h.worst_elt)}"
+        f"     BEST {_finite_or_dash(h.qmax)}  AVRG {_finite_or_dash(h.qavg)} "
+        f" WRST {_finite_or_dash(h.qmin)} (elt {int(h.worst_elt)}"
         + (f" on shard {int(h.worst_shard)})" if int(h.worst_shard) >= 0 else ")"),
     ]
     ne = max(int(h.ne), 1)
@@ -203,17 +213,99 @@ def length_stats(mesh: Mesh, edges, emask) -> LengthStats:
     return LengthStats(ne, lmin, lmax, lavg, small, large, unit, counts)
 
 
+def mesh_length_stats(mesh: Mesh, ecap: int | None = None) -> LengthStats:
+    """Whole-mesh edge-length histogram: derive the unique-edge tables
+    from the tet connectivity (no prebuilt adjacency needed) and reduce.
+    Pure jnp — vmappable over stacked shards and usable inside
+    shard_map bodies (pass a static `ecap` there)."""
+    from ..core import adjacency  # deferred: adjacency pulls ops.common
+
+    if ecap is None:
+        ecap = int(mesh.tcap * 1.7) + 64
+    edges, emask, _, _ = adjacency.unique_edges(mesh, ecap)
+    return length_stats(mesh, edges, emask)
+
+
+def in_band_fraction(ls: LengthStats) -> float:
+    """Unit-mesh goal as one scalar: the fraction of edges whose metric
+    length lies in [LSHRT, LLONG] (0.0 for an empty edge set). This is
+    the `len/in_band` value that rides history records, the bench
+    envelope and the PERF_DB gate."""
+    ne = int(ls.nedge)
+    return float(int(ls.n_unit)) / ne if ne > 0 else 0.0
+
+
+def reduce_length_stats(ls: LengthStats, axis_name: str) -> LengthStats:
+    """Cross-shard reduction of per-shard LengthStats inside shard_map —
+    the `PMMG_prilen` world totals (reference MPI_Reduce over
+    lenStats, `src/quality_pmmg.c:591`). Counts/averages sum exactly;
+    interface edges appear once per owning shard, so world counts weigh
+    shared edges per replica (documented, exact for fractions up to the
+    thin interface band)."""
+    ne = jax.lax.psum(ls.nedge, axis_name)
+    lavg = jax.lax.psum(
+        ls.lavg * ls.nedge.astype(ls.lavg.dtype), axis_name
+    ) / jnp.maximum(ne, 1).astype(ls.lavg.dtype)
+    return LengthStats(
+        nedge=ne,
+        lmin=jax.lax.pmin(ls.lmin, axis_name),
+        lmax=jax.lax.pmax(ls.lmax, axis_name),
+        lavg=lavg,
+        n_small=jax.lax.psum(ls.n_small, axis_name),
+        n_large=jax.lax.psum(ls.n_large, axis_name),
+        n_unit=jax.lax.psum(ls.n_unit, axis_name),
+        counts=jax.lax.psum(ls.counts, axis_name),
+    )
+
+
+def merge_stacked_length_stats(ls: LengthStats) -> LengthStats:
+    """Reduce a vmapped (leading-axis-stacked) LengthStats to one global
+    record — the out-of-shard_map companion of `reduce_length_stats`,
+    mirroring `merge_stacked_histograms`."""
+    ne = jnp.sum(ls.nedge)
+    return LengthStats(
+        nedge=ne,
+        lmin=jnp.min(ls.lmin),
+        lmax=jnp.max(ls.lmax),
+        lavg=jnp.sum(ls.lavg * ls.nedge.astype(ls.lavg.dtype))
+        / jnp.maximum(ne, 1).astype(ls.lavg.dtype),
+        n_small=jnp.sum(ls.n_small),
+        n_large=jnp.sum(ls.n_large),
+        n_unit=jnp.sum(ls.n_unit),
+        counts=jnp.sum(ls.counts, axis=0),
+    )
+
+
+def length_stats_doc(ls: LengthStats) -> dict:
+    """JSON-ready dict of a LengthStats (host transfer happens here) —
+    the payload the drivers attach to `health:length_histogram` tracer
+    events so `obs_report --health` can re-render post-mortem. Non-
+    finite summary scalars (empty edge set) become None — the trace
+    JSONL stays strict-JSON parseable."""
+    fin = lambda v: float(v) if math.isfinite(float(v)) else None
+    return dict(
+        nedge=int(ls.nedge),
+        lmin=fin(ls.lmin), lmax=fin(ls.lmax), lavg=fin(ls.lavg),
+        n_small=int(ls.n_small), n_large=int(ls.n_large),
+        n_unit=int(ls.n_unit),
+        in_band=round(in_band_fraction(ls), 6),
+        counts=[int(c) for c in jax.device_get(ls.counts)],
+        edges=[float(e) for e in jax.device_get(_LEN_EDGES)],
+    )
+
+
 def format_length_stats(ls: LengthStats) -> str:
     """Edge-length report with the reference's bins (`PMMG_prilen`
-    output shape, `src/quality_pmmg.c:591-719`)."""
+    output shape, `src/quality_pmmg.c:591-719`). Safe on empty edge
+    sets (nedge=0): min/max/avg render as dashes instead of inf/nan."""
     edges = [float(e) for e in jax.device_get(_LEN_EDGES)]
     counts = [int(c) for c in jax.device_get(ls.counts)]
     ne = max(int(ls.nedge), 1)
     lines = [
         f"  -- RESULTING EDGE LENGTHS  {int(ls.nedge)} edges",
-        f"     AVERAGE LENGTH {float(ls.lavg):12.4f}",
-        f"     SMALLEST EDGE  {float(ls.lmin):12.4f}",
-        f"     LARGEST  EDGE  {float(ls.lmax):12.4f}",
+        f"     AVERAGE LENGTH {_finite_or_dash(ls.lavg, '12.4f')}",
+        f"     SMALLEST EDGE  {_finite_or_dash(ls.lmin, '12.4f')}",
+        f"     LARGEST  EDGE  {_finite_or_dash(ls.lmax, '12.4f')}",
         f"     unit [1/sqrt2, sqrt2]: {int(ls.n_unit)} "
         f"({100.0 * int(ls.n_unit) / ne:.2f} %)",
     ]

@@ -101,3 +101,35 @@ def sharded_quality_histogram(stacked: Mesh, dmesh: DeviceMesh):
     reduction (reference `PMMG_qualhisto`, `src/quality_pmmg.c:156` — the
     custom MPI_Op becomes `reduce_histograms`' pmin/psum)."""
     return _sharded_hist_fn(dmesh)(stacked)
+
+
+@lru_cache(maxsize=8)
+def _sharded_len_fn(dmesh: DeviceMesh, ecap: int):
+    """Jitted per-device-mesh edge-length reducer — the `PMMG_prilen`
+    world totals as a psum reduction. Memoized like `_sharded_hist_fn`
+    (fresh jit(shard_map) per call retraces, parmmg-lint PML004);
+    `ecap` is a static shape so it keys the cache too."""
+    from ..ops import quality
+
+    def body(blk: Mesh):
+        m = _squeeze(blk)
+        ls = quality.mesh_length_stats(m, ecap)
+        return quality.reduce_length_stats(ls, AXIS)
+
+    # check_rep=False for the same reason as the histogram body: the
+    # outputs are psum/pmin-replicated by construction
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=dmesh, in_specs=(P(AXIS),), out_specs=P(),
+            check_rep=False,
+        )
+    )
+
+
+def sharded_length_stats(stacked: Mesh, dmesh: DeviceMesh):
+    """Distributed edge-length histogram: per-shard unique-edge tables +
+    metric lengths, world-merged like `sharded_quality_histogram`.
+    Interface edges count once per owning shard (thin-band
+    approximation, documented in `reduce_length_stats`)."""
+    ecap = int(stacked.tet.shape[1] * 1.7) + 64
+    return _sharded_len_fn(dmesh, ecap)(stacked)

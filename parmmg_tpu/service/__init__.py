@@ -42,4 +42,9 @@ from .jobs import (  # noqa: F401
 )
 from .journal import JobJournal, JournalStateError  # noqa: F401
 from .server import JobServer, default_options, mesh_digest  # noqa: F401
-from .status import StatusServer, status_text  # noqa: F401
+from .status import (  # noqa: F401
+    StatusServer,
+    run_status_text,
+    serve_run_from_env,
+    status_text,
+)

@@ -49,7 +49,11 @@ import time
 from typing import Iterable, List, Optional, Tuple
 
 from ..failsafe import AdaptError, PreemptionError, WorldReformError
-from ..obs import metrics as obs_metrics, trace as obs_trace
+from ..obs import (
+    health as obs_health,
+    metrics as obs_metrics,
+    trace as obs_trace,
+)
 from . import jobs as J
 from .admission import (
     AdmissionQueue,
@@ -367,16 +371,28 @@ class JobServer:
             digest = mesh_digest(out)
             if spec.outmesh:
                 self._save_mesh(out, spec.outmesh)
+            # run-health quality stamp (round 12): the final unit-band
+            # edge fraction and the obs.health verdict ride the result
+            # + terminal event, so `obs_report --serve` gets its
+            # per-job quality column without re-running anything
+            in_band = obs_health.history_in_band(
+                info.get("history", [])
+            )
+            verdict = (info.get("health") or {}).get("verdict")
             result = dict(
                 digest=digest, ne=int(out.ntet), npoin=int(out.npoin),
                 status=int(info.get("status", 0)), wall_s=wall,
             )
+            if in_band is not None:
+                result["in_band"] = in_band
+            if verdict is not None:
+                result["verdict"] = verdict
             self.journal.terminal(spec.job_id, J.DONE, result=result)
             reg.counter("serve/done").inc()
             obs_trace.emit_event(
                 "job_terminal", job_id=spec.job_id, tenant=spec.tenant,
                 state=J.DONE, code="ok", wall_s=wall, digest=digest,
-                attempt=attempt,
+                attempt=attempt, in_band=in_band, verdict=verdict,
             )
             return J.DONE
         except JobDeadlineError as e:

@@ -1,4 +1,4 @@
-"""Prometheus status endpoint for the job server (``--status PORT``).
+"""Prometheus status endpoints: job server AND live adaptation runs.
 
 The serving loop already counts everything that matters into the
 always-on metrics registry (``serve/*`` counters: submitted, done,
@@ -8,20 +8,36 @@ plus the live queue picture (depth, per-size-class occupancy from
 :meth:`~parmmg_tpu.service.admission.AdmissionQueue.occupancy`, the
 draining flag) in Prometheus text exposition format 0.0.4, and
 :class:`StatusServer` is a daemon-threaded stdlib ``http.server``
-exposing it at ``/metrics`` (plus a trivial ``/healthz``) so
-``tools/serve.py --status <port>`` can be scraped without touching
-the serving loop. Pure stdlib — no client library, no new deps.
+exposing it at ``/metrics`` (plus a trivial ``/healthz``). Pure
+stdlib — no client library, no new deps.
+
+Round 12 generalized the server over a *render callable*, so the same
+endpoint also serves a bare ``adapt`` / ``adapt_distributed`` run:
+:func:`run_status_text` renders the run-health picture (current
+iteration/phase, per-operator acceptance counters, in-band fraction,
+per-rank heartbeat age, drain-curve ETA) from the metrics registry +
+`obs.health.run_state`, and :func:`serve_run_from_env` is the
+``PMMGTPU_STATUS_PORT`` contract the drivers honor: set the env var
+and any traced-or-not run serves ``/healthz`` + ``/metrics`` on that
+port for its duration (multi-process runs bind ``port + rank`` so
+every rank is scrapable; ``0`` picks an ephemeral port and prints it).
 """
 
 from __future__ import annotations
 
 import http.server
+import os
 import re
 import threading
+from typing import Callable, Optional
 
+from ..obs import health as obs_health
 from ..obs import metrics as obs_metrics
 
-__all__ = ["status_text", "StatusServer"]
+__all__ = [
+    "status_text", "run_status_text", "StatusServer",
+    "serve_run_from_env",
+]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -60,17 +76,84 @@ def status_text(server) -> str:
     return "\n".join(lines) + "\n"
 
 
+# run-state scalars exported as gauges, with their endpoint names
+_RUN_GAUGES = (
+    ("iteration", "run/iteration"),
+    ("sweep", "run/sweep"),
+    ("in_band", "len/in_band"),
+    ("active_fraction", "run/active_fraction"),
+    ("drain_eta_sweeps", "run/drain_eta_sweeps"),
+    ("heartbeat_age_s", "run/heartbeat_age_s"),
+)
+
+# registry counters a run scrape exports (operator acceptance + sweep
+# progress — the live half of what obs_report renders post-mortem)
+_RUN_COUNTER_PREFIXES = ("ops/", "sweeps", "recompiles/", "failsafe/")
+
+
+def run_status_text() -> str:
+    """Prometheus text-format snapshot of the CURRENT adaptation run in
+    this process: operator-acceptance counters from the always-on
+    metrics registry, plus the `obs.health.run_state` live picture
+    (phase, iteration, in-band fraction, heartbeat age, drain ETA).
+    The phase is a labeled info-style gauge; the rank label rides every
+    line implicitly via the per-rank port (PMMGTPU_STATUS_PORT + rank)."""
+    doc = obs_metrics.registry().to_doc()
+    st = obs_health.run_state().snapshot()
+    lines = []
+    for key in sorted(doc.get("counters", {})):
+        if not any(key == p or key.startswith(p)
+                   for p in _RUN_COUNTER_PREFIXES):
+            continue
+        name = _prom_name(key)
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {doc['counters'][key]}")
+    for key in ("sweep_active_fraction", "len/in_band",
+                "work/imbalance"):
+        if key not in doc.get("gauges", {}):
+            continue
+        if key == "len/in_band" and st.get("in_band") is not None:
+            # the run state carries the fresher value (final length
+            # stats at "done") — emitting both would duplicate the
+            # metric name in one exposition
+            continue
+        name = _prom_name(key)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {doc['gauges'][key]}")
+    phase = st.get("phase")
+    pname = _prom_name("run/phase")
+    lines.append(f"# TYPE {pname} gauge")
+    lines.append(f'{pname}{{phase="{phase or "idle"}"}} 1')
+    for key, gname in _RUN_GAUGES:
+        v = st.get(key)
+        if v is None:
+            continue
+        name = _prom_name(gname)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {v}")
+    return "\n".join(lines) + "\n"
+
+
 class StatusServer:
-    """Daemon-threaded HTTP scrape endpoint for one job server.
+    """Daemon-threaded HTTP scrape endpoint over a render callable.
 
-    Binds immediately (``port=0`` picks an ephemeral port — read
-    ``.port`` after construction), serves on a daemon thread after
-    :meth:`start`, and never blocks the serving loop: every request
-    renders a fresh :func:`status_text` snapshot."""
+    ``StatusServer(job_server)`` keeps the original job-server scrape
+    (renders :func:`status_text`); ``StatusServer(render=fn)`` serves
+    whatever ``fn() -> str`` returns — the run endpoint passes
+    :func:`run_status_text`. Binds immediately (``port=0`` picks an
+    ephemeral port — read ``.port`` after construction), serves on a
+    daemon thread after :meth:`start`, and never blocks the instrumented
+    loop: every request renders a fresh snapshot."""
 
-    def __init__(self, server, port: int = 0,
-                 host: str = "127.0.0.1"):
-        job_server = server
+    def __init__(self, server=None, port: int = 0,
+                 host: str = "127.0.0.1",
+                 render: Optional[Callable[[], str]] = None):
+        if render is None:
+            if server is None:
+                render = run_status_text
+            else:
+                job_server = server
+                render = lambda: status_text(job_server)
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
@@ -78,7 +161,7 @@ class StatusServer:
                     body = b"ok\n"
                     ctype = "text/plain"
                 else:
-                    body = status_text(job_server).encode()
+                    body = render().encode()
                     ctype = "text/plain; version=0.0.4"
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -110,3 +193,38 @@ class StatusServer:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+
+def serve_run_from_env() -> Optional[StatusServer]:
+    """The ``PMMGTPU_STATUS_PORT`` contract: when the env var is set,
+    return a STARTED run-status server for this process (else None).
+    Multi-process runs offset the port by the jax process index so all
+    ranks are scrapable side by side; a nonzero base port that is
+    already taken (two concurrent runs on one host) degrades to an
+    ephemeral port rather than failing the run. The bound port is
+    printed once — with ``PMMGTPU_STATUS_PORT=0`` that line is the only
+    way to find the endpoint."""
+    raw = os.environ.get("PMMGTPU_STATUS_PORT", "").strip()
+    if not raw:
+        return None
+    try:
+        base = int(raw)
+    except ValueError:
+        return None
+    rank = 0
+    try:
+        import jax
+
+        rank = int(jax.process_index())
+    except Exception:
+        pass
+    port = base + rank if base else 0
+    try:
+        srv = StatusServer(render=run_status_text, port=port)
+    except OSError:
+        srv = StatusServer(render=run_status_text, port=0)
+    srv.start()
+    obs_health.run_state().update(rank=rank, status_port=srv.port)
+    print(f"  ## run status endpoint: http://{srv.host}:{srv.port}"
+          "/metrics", flush=True)
+    return srv

@@ -1,0 +1,405 @@
+"""M23: the run-health observatory — unit-length edge telemetry,
+termination verdicts, live status endpoint.
+
+Coverage of round 12 (`obs/health.py`, the `quality` length-stats
+additions, `service/status.py` run endpoint):
+
+- edge-length histogram exactness: device-side `mesh_length_stats`
+  against an independent numpy reference on the tiny fixture (same
+  metric-length formula, the reference's exact `bd[9]` bins);
+- sharded-vs-central parity: the jit(shard_map)+psum world reduction
+  equals the vmapped host merge bit-for-bit;
+- the verdict matrix: converged / stalled (forced ``max_sweeps=1``) /
+  oscillating (seeded split<->collapse churn) / budget_exhausted;
+- NaN / empty-set formatter safety (the divide-by-ne=0 family);
+- the live run endpoint: ``run_status_text`` over HTTP per the m21
+  scrape pattern, run-state gauges included.
+"""
+
+import math
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parmmg_tpu.core import adjacency, metric as metric_mod
+from parmmg_tpu.models.adapt import AdaptOptions, adapt
+from parmmg_tpu.obs import health, metrics as obs_metrics
+from parmmg_tpu.ops import quality
+from parmmg_tpu.utils.gen import unit_cube_mesh
+
+
+def _prepared_mesh(n=2, perturb=0.12):
+    """Tiny fixture with a nontrivial iso metric so lengths spread
+    across bins."""
+    mesh = unit_cube_mesh(n, perturb=perturb, seed=3)
+    # graded sizes: h in [0.18, 0.55] by x-coordinate
+    h = 0.18 + 0.37 * mesh.vert[:, 0:1]
+    return mesh.replace(met=jnp.asarray(h, mesh.vert.dtype))
+
+
+# ---------------------------------------------------------------------------
+# edge-length histogram exactness vs numpy
+# ---------------------------------------------------------------------------
+
+
+def test_length_stats_match_numpy_reference():
+    mesh = _prepared_mesh()
+    ls = quality.mesh_length_stats(mesh)
+
+    ecap = int(mesh.tcap * 1.7) + 64
+    edges, emask, _, _ = adjacency.unique_edges(mesh, ecap)
+    e = np.asarray(jax.device_get(edges))
+    m = np.asarray(jax.device_get(emask))
+    vert = np.asarray(jax.device_get(mesh.vert))
+    met = np.asarray(jax.device_get(mesh.met))
+
+    p0, p1 = vert[e[:, 0]], vert[e[:, 1]]
+    h0, h1 = met[e[:, 0], 0], met[e[:, 1], 0]
+    d = np.linalg.norm(p1 - p0, axis=-1)
+    # the iso metric length formula (metric.edge_length_iso)
+    ln = d * 0.5 * (1.0 / h0 + 1.0 / h1)
+    ln = ln[m]
+    assert ln.size == int(ls.nedge) > 0
+
+    assert np.isclose(float(ls.lmin), ln.min())
+    assert np.isclose(float(ls.lmax), ln.max())
+    assert np.isclose(float(ls.lavg), ln.mean())
+    lshrt, llong = metric_mod.LSHRT, metric_mod.LLONG
+    assert int(ls.n_small) == int((ln < lshrt).sum())
+    assert int(ls.n_large) == int((ln > llong).sum())
+    assert int(ls.n_unit) == int(
+        ((ln >= lshrt) & (ln <= llong)).sum()
+    )
+    assert np.isclose(
+        quality.in_band_fraction(ls),
+        ((ln >= lshrt) & (ln <= llong)).mean(),
+    )
+    # the reference's exact bd[9] bin bounds
+    bd = np.array([0.0, 0.3, 0.6, lshrt, 0.9, 1.3, llong, 2.0, 5.0])
+    want = np.zeros(bd.size + 1, int)
+    for k, c in zip(np.searchsorted(bd, ln), np.ones_like(ln, int)):
+        want[k] += c
+    got = np.asarray(jax.device_get(ls.counts))
+    assert got.tolist() == want.tolist()
+    assert got.sum() == ln.size
+
+
+def test_length_stats_doc_json_safe_and_consistent():
+    mesh = _prepared_mesh()
+    ls = quality.mesh_length_stats(mesh)
+    doc = quality.length_stats_doc(ls)
+    import json
+
+    json.dumps(doc)  # strictly serializable
+    assert doc["nedge"] == int(ls.nedge)
+    assert doc["n_small"] + doc["n_unit"] + doc["n_large"] \
+        == doc["nedge"]
+    assert sum(doc["counts"]) == doc["nedge"]
+    assert doc["in_band"] == round(quality.in_band_fraction(ls), 6)
+
+
+def test_empty_length_stats_formats_without_nan_or_div0():
+    mesh = _prepared_mesh()
+    ecap = int(mesh.tcap * 1.7) + 64
+    edges, emask, _, _ = adjacency.unique_edges(mesh, ecap)
+    ls = quality.length_stats(mesh, edges, jnp.zeros_like(emask))
+    assert int(ls.nedge) == 0
+    text = quality.format_length_stats(ls)
+    assert "--" in text and "nan" not in text and "inf" not in text
+    doc = quality.length_stats_doc(ls)
+    assert doc["lmin"] is None and doc["lmax"] is None
+    assert doc["lavg"] == 0.0  # sum over max(nedge, 1): finite
+    assert doc["in_band"] == 0.0
+    # the post-mortem renderer is None-safe too
+    assert "--" in health.render_length_doc(doc)
+
+
+def test_format_histogram_safe_on_empty_and_nonfinite():
+    h = quality.QualityHisto(
+        ne=jnp.int32(0), qmin=jnp.inf, qmax=-jnp.inf,
+        qavg=jnp.nan, worst_elt=jnp.int32(-1), nbad=jnp.int32(0),
+        ninverted=jnp.int32(0), counts=jnp.zeros(5, jnp.int32),
+        worst_shard=jnp.int32(-1),
+    )
+    text = quality.format_histogram(h)
+    assert "nan" not in text and "inf" not in text
+    assert "--" in text
+    assert "0.00 %" in text  # percentages divide by max(ne, 1)
+
+
+# ---------------------------------------------------------------------------
+# sharded vs central merge parity
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_length_stats_match_stacked_merge():
+    from parmmg_tpu.parallel import shard as shard_mod
+    from parmmg_tpu.parallel.distribute import split_mesh
+    from parmmg_tpu.parallel.partition import sfc_partition
+
+    mesh = _prepared_mesh(n=3)
+    nparts = 4
+    part = np.asarray(jax.device_get(sfc_partition(mesh, nparts)))
+    stacked, _comm = split_mesh(mesh, part, nparts)
+
+    dmesh = shard_mod.device_mesh(nparts)
+    world = shard_mod.sharded_length_stats(stacked, dmesh)
+
+    ecap = int(stacked.tet.shape[1] * 1.7) + 64
+    per_shard = jax.vmap(
+        lambda m: quality.mesh_length_stats(m, ecap)
+    )(stacked)
+    merged = quality.merge_stacked_length_stats(per_shard)
+
+    assert int(world.nedge) == int(merged.nedge) > 0
+    assert float(world.lmin) == pytest.approx(float(merged.lmin))
+    assert float(world.lmax) == pytest.approx(float(merged.lmax))
+    assert float(world.lavg) == pytest.approx(float(merged.lavg))
+    for f in ("n_small", "n_large", "n_unit"):
+        assert int(getattr(world, f)) == int(getattr(merged, f))
+    assert jax.device_get(world.counts).tolist() \
+        == jax.device_get(merged.counts).tolist()
+
+
+# ---------------------------------------------------------------------------
+# the verdict matrix
+# ---------------------------------------------------------------------------
+
+
+def _rec(it, sw, nsplit=0, ncollapse=0, nswap=0, ne=1000,
+         n_unique=500, n_active=100, capped=False, **kw):
+    r = dict(iter=it, sweep=sw, nsplit=nsplit, ncollapse=ncollapse,
+             nswap=nswap, nmoved=0, ne=ne, np=300, n_unique=n_unique,
+             n_active=n_active, capped=capped)
+    r.update(kw)
+    return r
+
+
+def test_verdict_converged_by_driver_rule():
+    hist = [_rec(0, 0, nsplit=200), _rec(0, 1, nsplit=2)]
+    v = health.assess(hist, converge_frac=0.005)
+    assert v["verdict"] == "converged"
+    assert v["sweeps"] == 2 and v["iterations"] == 1
+
+
+def test_verdict_converged_by_drained_frontier():
+    hist = [_rec(0, 0, nsplit=200),
+            _rec(0, 1, nsplit=50, n_active=0, skipped=True)]
+    v = health.assess(hist)
+    assert v["verdict"] == "converged"
+    assert "drained" in v["reason"]
+
+
+def test_verdict_stalled_on_forced_single_sweep():
+    # one capped sweep with real work: no convergence, no decay
+    # evidence — must be stalled, never converged
+    hist = [_rec(0, 0, nsplit=300, capped=True)]
+    v = health.assess(hist, max_sweeps=1)
+    assert v["verdict"] == "stalled"
+
+
+def test_verdict_oscillating_on_seeded_churn():
+    # seeded split<->collapse thrash: sweep k's splits undone by sweep
+    # k+1's collapses, sustained across the window
+    hist = [
+        _rec(0, 0, nsplit=100, ncollapse=5),
+        _rec(0, 1, nsplit=8, ncollapse=95),
+        _rec(0, 2, nsplit=90, ncollapse=10),
+        _rec(0, 3, nsplit=12, ncollapse=88),
+        _rec(0, 4, nsplit=85, ncollapse=9, capped=True),
+    ]
+    v = health.assess(hist, max_sweeps=5)
+    assert v["verdict"] == "oscillating"
+    assert v["churn"]["sustained"] is True
+    assert v["churn"]["max_score"] > health.CHURN_MIN_FRACTION
+
+
+def test_verdict_budget_exhausted_on_decay():
+    hist = [
+        _rec(0, 0, nsplit=400),
+        _rec(0, 1, nsplit=250),
+        _rec(0, 2, nsplit=120, capped=True),
+    ]
+    v = health.assess(hist, max_sweeps=3)
+    assert v["verdict"] == "budget_exhausted"
+
+
+def test_verdict_empty_history_is_stalled():
+    v = health.assess([])
+    assert v["verdict"] == "stalled"
+    assert v["sweeps"] == 0
+
+
+def test_forced_stall_end_to_end_not_converged():
+    # the acceptance criterion: a real max_sweeps=1 run must be judged
+    # stalled by the driver's own exit emit
+    obs_metrics.registry().reset()
+    health.run_state().reset()
+    _out, info = adapt(
+        unit_cube_mesh(2),
+        AdaptOptions(hsiz=0.35, niter=1, max_sweeps=1, hgrad=None,
+                     polish_sweeps=0),
+    )
+    assert info["health"]["verdict"] == "stalled"
+    assert info["health"]["verdict"] in health.VERDICTS
+    # and every sweep record carried the unit-band fraction
+    recs = [r for r in info["history"] if "nsplit" in r]
+    assert recs and all("in_band" in r for r in recs)
+    assert health.history_in_band(info["history"]) is not None
+
+
+def test_drain_curve_eta():
+    recs = [_rec(0, k, n_active=400 - 100 * k) for k in range(4)]
+    d = health.drain_curve(recs)
+    assert d["series"] == [0.8, 0.6, 0.4, 0.2]
+    assert d["eta_sweeps"] == pytest.approx(1.0)
+    # flat series: not draining
+    flat = health.drain_curve([_rec(0, k) for k in range(3)])
+    assert flat["eta_sweeps"] is None
+
+
+def test_churn_scores_pairwise():
+    recs = [
+        _rec(0, 0, nsplit=100, ncollapse=0),
+        _rec(0, 1, nsplit=0, ncollapse=100),
+        _rec(1, 0, nsplit=50),  # new iteration: pair not scored
+    ]
+    s = health.churn_scores(recs)
+    assert len(s) == 1 and s[0] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# live run endpoint (m21 scrape pattern over run_status_text)
+# ---------------------------------------------------------------------------
+
+
+def test_run_status_http_endpoint_scrapes():
+    from parmmg_tpu.service import StatusServer, run_status_text
+
+    obs_metrics.registry().reset()
+    health.run_state().reset()
+    obs_metrics.record_sweep(dict(
+        nsplit=7, ncollapse=3, nswap=1, nmoved=2, n_active=40,
+        n_unique=100, in_band=0.625, iter=0, sweep=0, ne=100, np=30,
+    ))
+    health.run_state().update(phase="sweeps", iteration=0,
+                              driver="centralized")
+    status = StatusServer(render=run_status_text, port=0).start()
+    try:
+        base = f"http://{status.host}:{status.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert "parmmg_ops_split_accepted 7" in body
+        assert "parmmg_sweeps 1" in body
+        assert 'parmmg_run_phase{phase="sweeps"} 1' in body
+        assert "parmmg_len_in_band 0.625" in body
+        # len/in_band must appear exactly once per exposition (one
+        # sample line; the other match is its # TYPE header)
+        samples = [ln for ln in body.splitlines()
+                   if ln.startswith("parmmg_len_in_band ")]
+        assert len(samples) == 1
+        assert "parmmg_run_heartbeat_age_s" in body
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert r.read() == b"ok\n"
+    finally:
+        status.close()
+        obs_metrics.registry().reset()
+        health.run_state().reset()
+
+
+def test_serve_run_from_env_contract(monkeypatch):
+    from parmmg_tpu.service import serve_run_from_env
+
+    monkeypatch.delenv("PMMGTPU_STATUS_PORT", raising=False)
+    assert serve_run_from_env() is None
+    monkeypatch.setenv("PMMGTPU_STATUS_PORT", "0")
+    health.run_state().reset()
+    srv = serve_run_from_env()
+    try:
+        assert srv is not None and srv.port > 0
+        st = health.run_state().snapshot()
+        assert st["status_port"] == srv.port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+        assert "parmmg_run_phase" in body
+    finally:
+        if srv is not None:
+            srv.close()
+        health.run_state().reset()
+
+
+def test_run_state_note_sweep_tracks_drain():
+    rs = health.RunState()
+    for k in range(4):
+        rs.note_sweep(dict(sweep=k, in_band=0.5 + 0.1 * k,
+                           n_active=400 - 100 * k, n_unique=500))
+    snap = rs.snapshot()
+    assert snap["sweep"] == 3
+    assert snap["in_band"] == pytest.approx(0.8)
+    assert snap["active_fraction"] == pytest.approx(0.2)
+    assert snap["drain_eta_sweeps"] == pytest.approx(1.0)
+    assert snap["heartbeat_age_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# emit + post-mortem reconstruction round trip
+# ---------------------------------------------------------------------------
+
+
+def test_emit_and_health_summary_round_trip(tmp_path):
+    from parmmg_tpu.obs import report as obs_report
+    from parmmg_tpu.obs import trace as obs_trace
+
+    d = str(tmp_path)
+    tr = obs_trace.Tracer(d)
+    hist = [
+        _rec(0, 0, nsplit=400, in_band=0.41),
+        _rec(0, 1, nsplit=2, in_band=0.83),
+    ]
+    mesh = _prepared_mesh()
+    doc = quality.length_stats_doc(quality.mesh_length_stats(mesh))
+    verdict = health.assess(hist)
+    health.emit_run_health(hist, length_doc=doc, verdict=verdict,
+                           tracer=tr)
+    tr.flush()
+
+    s = obs_report.health_summary(d)
+    assert s["verdict"]["verdict"] == "converged"
+    assert s["length"]["nedge"] == doc["nedge"]
+    assert s["in_band"] == pytest.approx(0.83)
+    assert len(s["history"]) == 2
+    text = obs_report.render_health(d)
+    for want in ("verdict: converged", "UNIT EDGE LENGTHS",
+                 "sweep history", "drain curve"):
+        assert want in text, (want, text)
+    # reassessment path: a dir whose verdict event is missing
+    d2 = str(tmp_path / "partial")
+    tr2 = obs_trace.Tracer(d2)
+    health.emit_run_health(hist, tracer=tr2)
+    tr2.flush()
+    s2 = obs_report.health_summary(d2)
+    assert s2["verdict"]["verdict"] == "converged"
+    assert s2["verdict"]["reassessed"] is True
+
+
+def test_format_history_rows_single_formatter():
+    hist = [_rec(0, 0, nsplit=12, in_band=0.5, capped=True)]
+    text = health.format_history_rows(hist)
+    assert "split=    12" in text
+    assert "band=" in text and "CAP" in text
+
+
+def test_history_event_cap_bounds_rows():
+    hist = [_rec(0, k, nsplit=1) for k in range(
+        health.HISTORY_EVENT_CAP + 40)]
+    rows = health._compact_rows(health.sweep_records(hist))
+    assert len(rows) == health.HISTORY_EVENT_CAP + 40
+    # the emit path truncates (covered via the event payload shape)
+    dropped = max(len(rows) - health.HISTORY_EVENT_CAP, 0)
+    assert dropped == 40

@@ -113,6 +113,19 @@ rc=$?
 echo "## obs-smoke rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
+# run-health smoke: a traced tiny run must carry the unit-band edge
+# fraction on every sweep record, serve a live /healthz + /metrics
+# scrape MID-RUN (PMMGTPU_STATUS_PORT contract), render the
+# edge-length histogram + termination verdict + drain curve via
+# obs_report --health, envelope len/in_band for the perf gate
+# (higher-is-better honored), judge a forced max_sweeps=1 run
+# `stalled`, and reconstruct the world histogram from a 2-process run
+timeout -k 10 900 env JAX_PLATFORMS=cpu PARMMG_STAGE_BUDGET_S=750 \
+    python tools/health_smoke.py
+rc=$?
+echo "## health-smoke rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
 # adaptation-service smoke: the mixed poisoned batch through the real
 # tools/serve.py process — typed too-large refusal, nan + deadline
 # members contained to their own typed terminals, SIGKILL mid-batch +

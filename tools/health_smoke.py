@@ -1,0 +1,305 @@
+"""Run-health smoke for the CI gate (tools/check.sh health stage).
+
+The round-12 acceptance, end to end on the hermetic CPU harness:
+
+1. **centralized leg** — a traced tiny adapt run under
+   ``PMMGTPU_STATUS_PORT=0`` must (a) carry the unit-band edge
+   fraction (`in_band`) on every sweep record, (b) serve a live
+   ``/healthz`` + Prometheus ``/metrics`` scrape MID-RUN (scraped from
+   the driver's own phase hook — the run is provably still going), and
+   (c) emit the `health:*` trace events from which
+   ``obs_report --health`` renders the edge-length histogram, the
+   termination verdict and the drain curve;
+2. **gate leg** — the final in-band fraction rides a BENCH/PERF_DB
+   envelope under the gate key ``len/in_band`` and the noise-aware
+   gate actually regresses a quality drop (higher-is-better honored);
+3. **forced-stall leg** — a ``max_sweeps=1`` run must be judged
+   ``stalled``, never ``converged``;
+4. **2-process leg** — a traced 2-rank ``adapt_stacked_input`` run
+   leaves a trace directory from which ``--health`` renders the world
+   histogram + verdict (``--worker`` is the child mode).
+
+Exit 0 = the run-health observatory is live. Budget knob:
+PARMMG_STAGE_BUDGET_S bounds the 2-process wait.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def worker() -> int:
+    """Child mode: one rank of the traced 2-process adapt run."""
+    from parmmg_tpu.parallel import multihost
+
+    multi = multihost.init_from_env()
+
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _accel in ("axon", "tpu", "cuda", "rocm"):
+        _xb._backend_factories.pop(_accel, None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from parmmg_tpu import failsafe
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_stacked_input,
+    )
+    from parmmg_tpu.parallel.distribute import split_mesh
+    from parmmg_tpu.parallel.partition import sfc_partition
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    assert multi and jax.process_count() == 2, "2-process env required"
+    watchdog = float(os.environ.get("PMMGTPU_WATCHDOG", "120"))
+
+    mesh = unit_cube_mesh(3)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, 8)))
+    st, comm = split_mesh(mesh, part, 8)
+    opts = DistOptions(
+        hsiz=0.32, niter=1, max_sweeps=3, nparts=8, min_shard_elts=8,
+        hgrad=None, polish_sweeps=0, watchdog_timeout=watchdog,
+    )
+    try:
+        _out, _comm2, info = adapt_stacked_input(st, comm, opts)
+    except failsafe.PeerLostError as e:
+        print(f"PEER_LOST rank={jax.process_index()}: {e}", flush=True)
+        os._exit(failsafe.PEER_LOST_EXIT_CODE)
+    bands = [r["in_band"] for r in info["history"] if "in_band" in r]
+    print(f"HEALTH_BANDS {json.dumps(bands)}", flush=True)
+    print(f"HEALTH_OK rank={jax.process_index()} "
+          f"verdict={info['health']['verdict']} "
+          f"status={int(info['status'])}", flush=True)
+    return 0
+
+
+def _spawn_pair(tmp: str, obs: str, timeout: float):
+    """dist_obs_smoke's 2-process launch idiom."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs, logs = [], []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PMMGTPU_STATUS_PORT", None)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PYTHONPATH=ROOT,
+            PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
+            PMMGTPU_NUM_PROCS="2",
+            PMMGTPU_PROC_ID=str(pid),
+            PMMGTPU_TRACE=obs,
+            PMMGTPU_WATCHDOG="120",
+            PYTHONFAULTHANDLER="1",
+        )
+        lp = os.path.join(tmp, f"rank{pid}.log")
+        logs.append(lp)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env, stdout=open(lp, "w"),
+            stderr=subprocess.STDOUT, cwd=ROOT,
+        ))
+    try:
+        rcs = [p.wait(timeout=timeout) for p in procs]
+    finally:
+        for p in procs:
+            p.kill()
+    return rcs, [open(lp).read() for lp in logs]
+
+
+def main() -> int:
+    budget = float(os.environ.get("PARMMG_STAGE_BUDGET_S", "600"))
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _accel in ("axon", "tpu", "cuda", "rocm"):
+        _xb._backend_factories.pop(_accel, None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.obs import health as obs_health
+    from parmmg_tpu.obs import history as obs_history
+    from parmmg_tpu.obs import metrics as obs_metrics
+    from parmmg_tpu.obs import report as obs_report
+    from parmmg_tpu.obs import trace as obs_trace
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    tmp = tempfile.mkdtemp(prefix="parmmg_health_smoke_")
+    obs_dir = os.path.join(tmp, "obs")
+    try:
+        # 1. centralized leg: traced run, live scrape mid-run --------
+        obs_metrics.registry().reset()
+        obs_health.run_state().reset()
+        os.environ["PMMGTPU_STATUS_PORT"] = "0"
+        tr = obs_trace.Tracer(obs_dir)
+        healthz = []
+
+        def hook(phase):
+            # the run is between driver phases here — a successful
+            # probe is BY CONSTRUCTION a mid-run probe
+            port = obs_health.run_state().snapshot().get("status_port")
+            if port and phase == "sweeps":
+                hz = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz",
+                    timeout=5).read()
+                assert hz == b"ok\n", hz
+                healthz.append(hz)
+
+        # a Prometheus-style poller on its own thread: latches the
+        # first /metrics body that carries sweep counters — scraped
+        # while the driver loop is still executing (the endpoint only
+        # listens for the run's duration)
+        import threading
+        import time as _time
+
+        latched = []
+        stop = threading.Event()
+
+        def poll():
+            while not stop.is_set():
+                port = obs_health.run_state().snapshot()\
+                    .get("status_port")
+                if port:
+                    try:
+                        body = urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/metrics",
+                            timeout=5).read().decode()
+                    except OSError:
+                        body = ""
+                    if ("parmmg_sweeps" in body
+                            and "parmmg_run_phase" in body):
+                        latched.append(body)
+                        return
+                _time.sleep(0.05)
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        out, info = adapt(
+            unit_cube_mesh(2),
+            AdaptOptions(hsiz=0.5, niter=1, max_sweeps=3, hgrad=None,
+                         polish_sweeps=0),
+            tracer=tr, phase_hook=hook,
+        )
+        stop.set()
+        poller.join(timeout=10)
+        os.environ.pop("PMMGTPU_STATUS_PORT", None)
+        tr.flush()
+        hist = [r for r in info["history"] if "nsplit" in r]
+        assert hist and all("in_band" in r for r in hist), \
+            "sweep records missing in_band"
+        assert healthz, "no mid-run /healthz probe succeeded"
+        assert latched, "no mid-run /metrics scrape saw sweep counters"
+        body = latched[-1]
+        for want in ("parmmg_run_phase", "parmmg_sweeps",
+                     "parmmg_ops_split_accepted",
+                     "parmmg_run_heartbeat_age_s"):
+            assert want in body, (want, body)
+        print(f"[health-smoke] mid-run scrape OK "
+              f"({len(body.splitlines())} metric lines)")
+
+        assert info["health"]["verdict"] in obs_health.VERDICTS
+        text = obs_report.render_health(obs_dir)
+        for want in ("verdict:", "UNIT EDGE LENGTHS",
+                     "drain curve", "sweep history"):
+            assert want in text, (want, text)
+        in_band = obs_health.history_in_band(info["history"])
+        assert in_band is not None and 0.0 <= in_band <= 1.0
+        print(f"[health-smoke] --health renders verdict="
+              f"{info['health']['verdict']} in_band={in_band:.3f}")
+
+        # 2. gate leg: len/in_band rides the envelope + regresses ----
+        import bench
+
+        bands = [r["in_band"] for r in hist]
+        payload = {"metric": "tets_per_sec", "value": 1000.0,
+                   "len/in_band": bands[-1], "in_band_series": bands}
+        rec = bench._envelope(payload, dict(n=2, hsiz=0.5,
+                                            kernels="off"))
+        assert rec["len/in_band"] == bands[-1]
+        assert "len/in_band" in obs_history.GATE_KEYS, \
+            "perf gate cannot ratchet mesh quality"
+        assert obs_history.GATE_KEYS["len/in_band"] == "higher"
+        base = [dict(rec, **{"len/in_band": 0.95, "run_id": f"b{i}"})
+                for i in range(4)]
+        bad = dict(rec, **{"len/in_band": 0.05})
+        res = obs_history.gate(base, bad)
+        assert "len/in_band" in res.regressions, \
+            [r for r in res.rows]
+        good = dict(rec, **{"len/in_band": 0.96})
+        assert "len/in_band" not in obs_history.gate(base, good)\
+            .regressions
+        print("[health-smoke] len/in_band enveloped + gate honors "
+              "higher-is-better")
+
+        # 3. forced-stall leg: max_sweeps=1 must NOT read converged ---
+        obs_metrics.registry().reset()
+        obs_health.run_state().reset()
+        out2, info2 = adapt(
+            unit_cube_mesh(2),
+            AdaptOptions(hsiz=0.35, niter=1, max_sweeps=1, hgrad=None,
+                         polish_sweeps=0),
+        )
+        v2 = info2["health"]
+        assert v2["verdict"] == "stalled", v2
+        print(f"[health-smoke] forced stall judged {v2['verdict']!r} "
+              f"({v2['reason']})")
+
+        # 4. 2-process leg: world histogram + verdict post-mortem ----
+        obs2 = os.path.join(tmp, "obs2")
+        rcs, logs = _spawn_pair(tmp, obs2, timeout=budget)
+        if rcs != [0, 0]:
+            for i, log in enumerate(logs):
+                print(f"---- rank{i} log ----\n{log[-4000:]}",
+                      file=sys.stderr)
+            print(f"[health-smoke] worker exits {rcs}",
+                  file=sys.stderr)
+            return 1
+        assert all("HEALTH_OK" in log for log in logs), "no HEALTH_OK"
+        s = obs_report.health_summary(obs2)
+        assert sorted(s["ranks"]) == [0, 1], s["ranks"]
+        assert s["verdict"] and \
+            s["verdict"]["verdict"] in obs_health.VERDICTS
+        assert s["length"] and s["length"]["nedge"] > 0, s["length"]
+        assert s["in_band"] is not None and 0.0 < s["in_band"] <= 1.0
+        text2 = obs_report.render_health(obs2)
+        for want in ("verdict:", "UNIT EDGE LENGTHS", "sweep history"):
+            assert want in text2, (want, text2)
+        band_line = next(ln for ln in logs[0].splitlines()
+                         if ln.startswith("HEALTH_BANDS "))
+        bands2 = json.loads(band_line[len("HEALTH_BANDS "):])
+        assert bands2, "2-process run carried no in_band series"
+        print(f"[health-smoke] 2-process --health: verdict="
+              f"{s['verdict']['verdict']} "
+              f"in_band={s['in_band']:.3f} over "
+              f"{s['length']['nedge']} world edges")
+        print("[health-smoke] live endpoint, verdicts, histogram and "
+              "gate key all verified")
+        return 0
+    finally:
+        os.environ.pop("PMMGTPU_STATUS_PORT", None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(worker() if "--worker" in sys.argv else main())

@@ -14,6 +14,13 @@ Usage:
                                   # -> typed terminal timeline per job
                                   # (file-ordered, spans server
                                   # restarts), tenant/refusal rollups
+  python tools/obs_report.py <trace-dir> --health 1 # run-health view:
+                                  # unit-length edge histogram (the
+                                  # reference's -prilen picture),
+                                  # termination verdict (converged /
+                                  # stalled / oscillating /
+                                  # budget_exhausted) with reasons,
+                                  # drain curve + ETA, sweep history
   python tools/obs_report.py <trace-dir> --dist 1   # cross-rank view:
                                   # clock-aligned per-rank timelines,
                                   # per-phase collective decomposition
@@ -78,6 +85,13 @@ def main():
                              indent=1, default=str))
             return 0
         print(obs_report.render_dist(trace_dir))
+        return 0
+    if flags.get("health", "") not in ("", "0"):
+        if flags.get("json", "") not in ("", "0"):
+            print(json.dumps(obs_report.health_summary(trace_dir),
+                             indent=1, default=str))
+            return 0
+        print(obs_report.render_health(trace_dir))
         return 0
     if flags.get("serve", "") not in ("", "0"):
         if flags.get("json", "") not in ("", "0"):

@@ -1,6 +1,8 @@
 """Print the per-sweep history of a bench workload (CPU or TPU) — which
 sweeps are split-dominant vs quality-dominant, to guide phase-aware
-scheduling of the sweep body."""
+scheduling of the sweep body. Rendering is `obs.health`'s single
+sweep-history formatter (round 12), so this tool, `obs_report
+--health` and the health smoke all print the same rows."""
 
 import os
 import sys
@@ -16,6 +18,7 @@ def main():
 
     bench._enable_compile_cache()
     from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.obs import health
 
     mesh = bench._workload(n, hsiz)
     opts = AdaptOptions(niter=1, hsiz=hsiz, max_sweeps=12, hgrad=None)
@@ -23,12 +26,7 @@ def main():
     out, info = adapt(mesh, opts)
     wall = time.perf_counter() - t0
     print(f"wall={wall:.1f}s ne={int(out.ntet)}")
-    for r in info["history"]:
-        print(
-            f"it{r['iter']} sw{r['sweep']:2d}: split={r['nsplit']:6d} "
-            f"coll={r['ncollapse']:6d} swap={r['nswap']:6d} "
-            f"moved={r['nmoved']:6d} ne={r['ne']:7d} capped={r['capped']}"
-        )
+    print(health.format_history_rows(info["history"]))
 
 
 if __name__ == "__main__":
