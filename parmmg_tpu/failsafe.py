@@ -107,13 +107,20 @@ PEER_LOST_EXIT_CODE = 87
 MISMATCH_EXIT_CODE = 88
 # exit code a worker uses when checkpoint I/O failed past its bounded
 # retries (io.ckpt_store.CheckpointIOError) — the chaos harness and
-# smoke stages assert the family {0, 86, 87, 88, 89} and nothing else
+# smoke stages assert the typed family {0, 86, 87, 88, 89, 92} and
+# nothing else
 CKPT_IO_EXIT_CODE = 89
 # exit code a SURVIVOR uses after a world-agreed elastic reformation
 # (`parallel.elastic`): the checkpoint is committed and the fleet
 # supervisor (tools/fleet.py) relaunches this rank in the reformed
 # world — exit 90 means "relaunch me", not "I failed"
 REFORM_EXIT_CODE = 90
+# exit code a worker uses after the collective-lockstep ledger
+# (`lint.contracts.verify_ledger`, armed under validate="full") proved
+# the world's collective schedules diverged — distinct from the generic
+# peer-loss 87 so the chaos harness can tell "a rank desynced and every
+# rank agreed on that" from "a rank silently vanished"
+DIVERGENCE_EXIT_CODE = 92
 
 CHECKPOINT_FORMAT = 1
 
@@ -187,6 +194,18 @@ class PeerLostError(AdaptError):
     `parallel.multihost.run_with_watchdog` when
     ``watchdog_timeout`` is configured, instead of hanging forever the
     way a bare collective on a lost TCP peer does."""
+
+
+class CollectiveDivergenceError(PeerLostError):
+    """The collective-lockstep ledger proved the world's collective
+    schedules diverged (`lint.contracts.verify_ledger`, armed under
+    ``validate="full"``): a subset of ranks skipped or injected a
+    collective — the runtime realization of the static PML012 finding.
+    Subclasses :class:`PeerLostError` because the consequence is the
+    same (the SPMD world is broken, no in-process recovery), but it is
+    raised on EVERY rank at the SAME boundary, so workers can exit with
+    the distinct :data:`DIVERGENCE_EXIT_CODE` instead of riding a
+    one-sided watchdog timeout."""
 
 
 class PreemptionError(BaseException):
@@ -442,15 +461,21 @@ class PhaseValidator:
 
 FAULT_PHASES = (
     "analysis", "metric", "remesh", "interp", "migrate", "post", "ckpt",
+    "comm",
 )
 FAULT_KINDS = (
     "nan", "overflow", "retrace", "kill", "sigterm", "ioerror", "slowio",
-    "preempt-notice", "peer-lost",
+    "preempt-notice", "peer-lost", "desync",
 )
 # kinds that live at the ``ckpt`` phase: they fire inside the
 # checkpoint STORE (consumed per store operation via
 # `FaultPlan.io_fault`, not at a driver phase boundary)
 _IO_FAULT_KINDS = ("ioerror", "slowio")
+# the ``comm`` phase hosts exactly one kind: ``desync`` poisons the
+# targeted rank's collective-lockstep ledger (as if it had dispatched
+# a collective its peers never will), exercised by the chaos harness's
+# --desync rung — detected by `verify_collectives`, not a watchdog
+_COMM_FAULT_KINDS = ("desync",)
 # everything the ckpt phase accepts: the store-op pair above plus
 # ``kill``, which at this phase means "die at the next manifest
 # PUBLISH at/after store op k" — i.e. INSIDE the two-barrier commit
@@ -503,6 +528,12 @@ class FaultPlan:
       barrier/heartbeat raises the typed :class:`PeerLostError`
       instead of hanging, exercising the survivor-side detection path
       without actually killing a peer;
+    - ``desync`` (``comm`` phase only): poisons the targeted rank's
+      collective-lockstep ledger — as if it had dispatched a
+      collective its peers never will — so the next
+      ``verify_collectives`` boundary (``validate="full"``) raises
+      :class:`CollectiveDivergenceError` on EVERY rank simultaneously
+      instead of a one-sided watchdog timeout;
     - ``ioerror`` / ``slowio`` (``ckpt`` phase only): checkpoint-store
       I/O faults, consumed per STORE OPERATION via :meth:`io_fault` —
       for these the ``it<k>`` field indexes store ops (0-based, per
@@ -561,6 +592,13 @@ class FaultPlan:
                     f"kinds {_CKPT_FAULT_KINDS} (store-operation "
                     "faults; 'kill' = die at the next manifest "
                     "publish), other kinds fire at driver phases"
+                )
+            if (kind in _COMM_FAULT_KINDS) != (phase == "comm"):
+                raise ValueError(
+                    f"fault token {tok!r}: kind 'desync' pairs "
+                    "exclusively with the 'comm' phase (it poisons the "
+                    "collective-lockstep ledger at an iteration "
+                    "boundary)"
                 )
             faults.append(Fault(it, phase, kind, rank=rank))
         return cls(faults, kill_mode=kill_mode)
@@ -726,6 +764,25 @@ class FaultPlan:
                 multihost.simulate_peer_loss(
                     f"injected at {where} (fault plan)"
                 )
+            elif f.kind == "desync":
+                # poison THIS rank's collective-lockstep ledger: one
+                # phantom record is indistinguishable from having
+                # dispatched a collective the peers never will, without
+                # actually wedging a real collective (which could only
+                # end in a watchdog timeout — the exact failure mode
+                # the ledger exists to replace with a typed error)
+                from .lint import contracts as lint_contracts
+
+                led = lint_contracts.ledger()
+                armed = ("armed" if led is not None else
+                         "NOT armed — undetectable without validate=full")
+                print(
+                    f"[failsafe] injected collective desync at {where} "
+                    f"(fault plan; ledger {armed})",
+                    flush=True,
+                )
+                if led is not None:
+                    led.record("desync-fault", -1, where)
             elif f.kind == "sigterm":
                 # real preemption notice: the platform's SIGTERM, aimed
                 # at ourselves — exercises the harness's checkpoint-
@@ -1106,6 +1163,11 @@ class Checkpointer:
                     )
                     for r in range(self.world)
                 }
+                # the publish runs inside the store's own _op
+                # retry/timeout envelope (PMMGTPU_CKPT_TIMEOUT), and
+                # peers' ckpt-commit barrier is watchdog-bounded: a
+                # wedge ends typed, not hung
+                # parmmg-lint: disable=PML015 -- bounded by the store's _op timeout envelope; peers' barrier has the watchdog
                 self.store.publish(base + ".json", manifest_bytes())
             # no rank proceeds (and possibly dies mid-next-iteration)
             # until the manifest is published: old and new are both
@@ -1344,6 +1406,15 @@ class FailsafeHarness:
             level=getattr(opts, "validate", "basic") or "off",
             every=int(getattr(opts, "validate_every", 1) or 1),
         )
+        # collective-lockstep ledger: validate="full" arms schedule
+        # recording in `parallel.multihost._coll_span`; any other level
+        # leaves the hook a single None-check (zero steady overhead)
+        self._ledger_armed = False
+        if self.validator.level == "full":
+            from .lint import contracts as lint_contracts
+
+            lint_contracts.install_ledger()
+            self._ledger_armed = True
         self.faults = FaultPlan.resolve(opts)
         self.attempts = int(getattr(opts, "recovery_attempts", 0) or 0)
         self.watchdog = getattr(opts, "watchdog_timeout", None)
@@ -1449,6 +1520,24 @@ class FailsafeHarness:
         (the SPMD sweep path) — see `PhaseValidator.check_sharded`."""
         self.validator.check_sharded(state, dmesh, it, phase=phase)
 
+    def verify_collectives(self, it: int,
+                           phase: str = "iteration") -> None:
+        """Collective-lockstep check at a phase boundary (the runtime
+        half of the static PML012 rule): under ``validate="full"`` and
+        at the validator's cadence, world-compare the per-rank ledger
+        digests and raise :class:`CollectiveDivergenceError` on every
+        rank when the schedules diverged. Contains a collective when it
+        runs, so the drivers call it only at boundaries every rank
+        reaches unconditionally (right next to `elastic_poll`). No-op
+        at any other validate level, single-process, or off-cadence."""
+        if not self._ledger_armed or not self.validator.due(it):
+            return
+        from .lint import contracts as lint_contracts
+
+        lint_contracts.verify_ledger(
+            it, phase=phase, timeout=self.watchdog
+        )
+
     def fire(self, it: int, phase: str, state):
         """Fire pending faults at this boundary; when one poisoned the
         state (``nan``), validate IMMEDIATELY (out of cadence) so the
@@ -1530,6 +1619,11 @@ class FailsafeHarness:
         exits with checkpoint state still in flight."""
         if self.ckpt is not None:
             self.ckpt.drain()
+        if self._ledger_armed:
+            from .lint import contracts as lint_contracts
+
+            lint_contracts.uninstall_ledger()
+            self._ledger_armed = False
 
     @property
     def ckpt_overlap_s(self) -> float:

@@ -62,6 +62,25 @@ HOST_META_CALLS = frozenset({
     "numpy.result_type", "numpy.promote_types",
 })
 
+# rank-identity sources (the SPMD divergence pass): calls whose result
+# names *this process* inside the world. per_rank=True sources differ
+# across ranks (branching on them diverges the collective schedule);
+# per_rank=False sources (world size) are world-uniform — tracked for
+# taint chains, but a uniform predicate takes the same arm on every
+# rank and is the sanctioned `is_multiprocess()` guard pattern.
+RANK_SOURCE_CALLS = {
+    "jax.process_index": True,
+    "jax.process_count": False,
+    "jax.distributed.initialize": False,
+}
+
+# per-rank environment keys (the launch contract of parallel.multihost)
+RANK_ENV_KEYS = {"PMMGTPU_PROC_ID": True, "PMMGTPU_NUM_PROCS": False}
+
+# attribute leaves that carry rank identity by convention (the elastic
+# coordinator and launch configs store process_index under these names)
+RANK_ATTR_NAMES = frozenset({"rank", "proc_id"})
+
 _SUPPRESS_RE = re.compile(
     r"#\s*parmmg-lint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s+--.*)?$"
 )
@@ -78,6 +97,8 @@ class Finding:
     col: int
     message: str
     func: str = ""
+    # taint provenance (rank-taint rules): source -> ... -> sink steps
+    chain: List[str] = dataclasses.field(default_factory=list)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -85,7 +106,10 @@ class Finding:
     def format(self) -> str:
         loc = f"{self.path}:{self.line}:{self.col}"
         fn = f" [{self.func}]" if self.func else ""
-        return f"{loc}: {self.rule}{fn}: {self.message}"
+        tail = ""
+        if self.chain:
+            tail = "  {" + " -> ".join(self.chain) + "}"
+        return f"{loc}: {self.rule}{fn}: {self.message}{tail}"
 
 
 @dataclasses.dataclass
@@ -111,6 +135,14 @@ class FuncInfo:
     # whether the function may return traced values (computed in the
     # interprocedural fixpoint; monotone False -> True)
     returns_tainted: bool = False
+    # rank-taint domain (SPMD divergence pass): param -> (origin
+    # description, per_rank). Unlike tracer taint this runs over EVERY
+    # function — host coordination code is exactly what it targets.
+    rank_tainted_params: Dict[str, Tuple[str, bool]] = dataclasses.field(
+        default_factory=dict
+    )
+    returns_rank_tainted: bool = False
+    rank_return_origin: Tuple[str, bool] = ("", False)
     # resolved project callees: (callee FuncInfo, call node)
     calls: List[Tuple["FuncInfo", ast.Call]] = dataclasses.field(
         default_factory=list
@@ -178,6 +210,7 @@ class Project:
         self._resolve_calls()
         self._mark_reachable()
         self._propagate_taint()
+        self._propagate_rank_taint()
 
     # -- name resolution ---------------------------------------------------
 
@@ -204,6 +237,16 @@ class Project:
         if isinstance(node, ast.Attribute) and isinstance(
             node.value, ast.Name
         ):
+            # self.method()/cls.method(): sibling methods of the scope's
+            # enclosing class (qualnames are "Class.method[.nested]")
+            if node.value.id in ("self", "cls") and scope is not None:
+                parts = scope.qualname.split(".")
+                for i in range(len(parts) - 1, 0, -1):
+                    cand = mi.funcs.get(
+                        ".".join(parts[:i]) + "." + node.attr
+                    )
+                    if cand is not None:
+                        return cand
             mod = mi.mod_aliases.get(node.value.id)
             if mod is not None and mod in self.modules:
                 return self.modules[mod].funcs.get(node.attr)
@@ -306,6 +349,36 @@ class Project:
                             and is_tainted(fi, expr, taint)
                         ):
                             callee.tainted_params.add(pname)
+                            changed = True
+            if not changed:
+                break
+
+    def _propagate_rank_taint(self) -> None:
+        """Interprocedural fixpoint of the rank-taint domain over ALL
+        functions (reachability does not gate it: the divergence rules
+        target host coordination code, not jitted bodies)."""
+        for _ in range(20):
+            changed = False
+            for fi in self.funcs.values():
+                rtaint = local_rank_taint(fi)
+                ret = _returns_rank(fi, rtaint)
+                if ret is not None and not fi.returns_rank_tainted:
+                    fi.returns_rank_tainted = True
+                    fi.rank_return_origin = ret
+                    changed = True
+                for callee, call in fi.calls:
+                    for pname, expr in map_call_args(callee, call):
+                        if expr is None:
+                            continue
+                        o = rank_origin(fi, expr, rtaint)
+                        if o is None:
+                            continue
+                        prev = callee.rank_tainted_params.get(pname)
+                        if prev is None or (o[1] and not prev[1]):
+                            callee.rank_tainted_params[pname] = (
+                                f"{o[0]} via {fi.key}:{call.lineno}",
+                                o[1],
+                            )
                             changed = True
             if not changed:
                 break
@@ -518,6 +591,211 @@ def local_taint(fi: FuncInfo) -> Set[str]:
         if not visit_stmts(fi.node.body):
             break
     return taint
+
+
+# ---------------------------------------------------------------------------
+# rank taint (SPMD divergence pass)
+# ---------------------------------------------------------------------------
+
+RankOrigin = Tuple[str, bool]  # (human-readable source, per_rank)
+
+
+def _best(a: Optional[RankOrigin],
+          b: Optional[RankOrigin]) -> Optional[RankOrigin]:
+    """Merge two origins: a per-rank source dominates a world-uniform
+    one (a predicate mixing both still diverges per rank)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a if a[1] or not b[1] else b
+
+
+def rank_origin(
+    fi: FuncInfo, node: ast.AST, rtaint: Dict[str, RankOrigin]
+) -> Optional[RankOrigin]:
+    """Origin of rank identity in an expression, or None.
+
+    Semantics deliberately differ from tracer taint: Compare nodes DO
+    propagate (``rank == 0`` is the canonical divergent predicate),
+    STATIC_ATTRS do not stop the flow (these are host ints, not
+    tracers), and unresolved calls are NOT conservatively tainted —
+    rank identity enters only through the known sources."""
+    if isinstance(node, ast.Name):
+        return rtaint.get(node.id)
+    if isinstance(node, ast.Attribute):
+        if node.attr in RANK_ATTR_NAMES:
+            return (f".{node.attr} attribute", True)
+        return rank_origin(fi, node.value, rtaint)
+    if isinstance(node, ast.Call):
+        return call_rank_origin(fi, node, rtaint)
+    if isinstance(node, ast.Subscript):
+        dotted = _dotted_root(fi.module, node.value)
+        if dotted == "os.environ" and isinstance(
+            node.slice, ast.Constant
+        ) and node.slice.value in RANK_ENV_KEYS:
+            return (f"os.environ[{node.slice.value!r}]",
+                    RANK_ENV_KEYS[node.slice.value])
+        return _best(rank_origin(fi, node.value, rtaint),
+                     rank_origin(fi, node.slice, rtaint))
+    if isinstance(node, (ast.Constant, ast.Lambda)):
+        return None
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        out = None
+        for e in node.elts:
+            out = _best(out, rank_origin(fi, e, rtaint))
+        return out
+    if isinstance(node, ast.Starred):
+        return rank_origin(fi, node.value, rtaint)
+    if isinstance(node, ast.BinOp):
+        return _best(rank_origin(fi, node.left, rtaint),
+                     rank_origin(fi, node.right, rtaint))
+    if isinstance(node, ast.UnaryOp):
+        return rank_origin(fi, node.operand, rtaint)
+    if isinstance(node, ast.BoolOp):
+        out = None
+        for v in node.values:
+            out = _best(out, rank_origin(fi, v, rtaint))
+        return out
+    if isinstance(node, ast.Compare):
+        out = rank_origin(fi, node.left, rtaint)
+        for c in node.comparators:
+            out = _best(out, rank_origin(fi, c, rtaint))
+        return out
+    if isinstance(node, ast.IfExp):
+        out = rank_origin(fi, node.test, rtaint)
+        out = _best(out, rank_origin(fi, node.body, rtaint))
+        return _best(out, rank_origin(fi, node.orelse, rtaint))
+    if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        return rank_origin(fi, node.elt, rtaint)
+    if isinstance(node, ast.JoinedStr):
+        out = None
+        for v in node.values:
+            out = _best(out, rank_origin(fi, v, rtaint))
+        return out
+    if isinstance(node, ast.FormattedValue):
+        return rank_origin(fi, node.value, rtaint)
+    return None
+
+
+def call_rank_origin(
+    fi: FuncInfo, call: ast.Call, rtaint: Dict[str, RankOrigin]
+) -> Optional[RankOrigin]:
+    mi = fi.module
+    fn = call.func
+    dotted = _dotted_root(mi, fn)
+    if dotted in RANK_SOURCE_CALLS:
+        return (f"{dotted}()", RANK_SOURCE_CALLS[dotted])
+    if dotted in ("os.environ.get", "os.getenv") and call.args:
+        key = call.args[0]
+        if isinstance(key, ast.Constant) and key.value in RANK_ENV_KEYS:
+            return (f"os.environ[{key.value!r}]",
+                    RANK_ENV_KEYS[key.value])
+    # project callee whose return is rank-derived
+    project = getattr(mi, "project", None)
+    if project is not None:
+        callee = project.resolve_callable(mi, fi, fn)
+        if callee is not None and callee.returns_rank_tainted:
+            org = callee.rank_return_origin
+            return (f"{org[0]} via {callee.key}()", org[1])
+    # method on a rank-derived value (rank_str.strip(), ...)
+    out = None
+    if isinstance(fn, ast.Attribute):
+        out = rank_origin(fi, fn.value, rtaint)
+    # argument pass-through (int(env), min(rank, n), f(rank), ...) —
+    # NOT conservative on unresolved calls: rank identity only enters
+    # through the known sources
+    for a in call.args:
+        out = _best(out, rank_origin(fi, a, rtaint))
+    for kw in call.keywords:
+        out = _best(out, rank_origin(fi, kw.value, rtaint))
+    return out
+
+
+def local_rank_taint(fi: FuncInfo) -> Dict[str, RankOrigin]:
+    """Fixpoint map of rank-derived local names -> origin."""
+    rtaint: Dict[str, RankOrigin] = dict(fi.rank_tainted_params)
+
+    own_nested = {
+        sub.node for sub in fi.module.funcs.values() if sub.parent is fi
+    }
+
+    def bind(tgt, origin: Optional[RankOrigin]) -> bool:
+        if origin is None:
+            return False
+        changed = False
+        if isinstance(tgt, ast.Name):
+            prev = rtaint.get(tgt.id)
+            if prev is None or (origin[1] and not prev[1]):
+                rtaint[tgt.id] = origin
+                changed = True
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                changed |= bind(e, origin)
+        elif isinstance(tgt, ast.Starred):
+            changed |= bind(tgt.value, origin)
+        return changed
+
+    def visit(node) -> bool:
+        changed = False
+        if isinstance(node, ast.FunctionDef) and node in own_nested:
+            return False
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                o = rank_origin(fi, node.value, rtaint)
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    changed |= bind(tgt, o)
+            return changed
+        if isinstance(node, ast.For):
+            changed |= bind(
+                node.target, rank_origin(fi, node.iter, rtaint)
+            )
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    changed |= bind(
+                        item.optional_vars,
+                        rank_origin(fi, item.context_expr, rtaint),
+                    )
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef) and child in own_nested:
+                continue
+            changed |= visit(child)
+        return changed
+
+    for _ in range(10):
+        changed = False
+        for st in fi.node.body:
+            changed |= visit(st)
+        if not changed:
+            break
+    return rtaint
+
+
+def _returns_rank(
+    fi: FuncInfo, rtaint: Dict[str, RankOrigin]
+) -> Optional[RankOrigin]:
+    own_nested = {
+        sub.node for sub in fi.module.funcs.values() if sub.parent is fi
+    }
+    out: Optional[RankOrigin] = None
+
+    def walk(node) -> None:
+        nonlocal out
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef) and child in own_nested:
+                continue
+            if isinstance(child, ast.Return) and child.value is not None:
+                out = _best(out, rank_origin(fi, child.value, rtaint))
+            walk(child)
+
+    walk(fi.node)
+    return out
 
 
 # ---------------------------------------------------------------------------

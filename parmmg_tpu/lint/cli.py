@@ -1,7 +1,13 @@
 """Command line for the JAX-invariant linter.
 
-    python -m parmmg_tpu.lint <paths...> [--json] [--select PML001,...]
+    python -m parmmg_tpu.lint <paths...> [--json [out.json]]
+                              [--select PML001,...]
                               [--list-rules] [--root DIR]
+
+``--json`` prints the machine-readable findings document (rule,
+file:line, message, taint chain); when followed by a path ending in
+``.json`` the document is ALSO written there — the artifact
+tools/check.sh's lint stage archives and asserts on.
 
 Exit codes: 0 clean, 1 findings, 2 usage error.  Pure stdlib — linting
 never initializes jax or touches an accelerator.
@@ -20,6 +26,7 @@ from .rules import RULES, run_lint
 def main(argv: List[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     as_json = False
+    json_out = None
     select = None
     root = None
     paths: List[str] = []
@@ -28,6 +35,12 @@ def main(argv: List[str] | None = None) -> int:
         a = argv[i]
         if a == "--json":
             as_json = True
+            # optional artifact path: only a ".json"-suffixed token is
+            # consumed, so `--json parmmg_tpu tools` keeps meaning
+            # "json to stdout over these paths"
+            if i + 1 < len(argv) and argv[i + 1].endswith(".json"):
+                i += 1
+                json_out = argv[i]
         elif a == "--list-rules":
             for rid, desc in sorted(RULES.items()):
                 print(f"{rid}  {desc}")
@@ -60,14 +73,18 @@ def main(argv: List[str] | None = None) -> int:
     project = analyze_paths(paths, root=root)
     findings = run_lint(paths, root=root, select=select, project=project)
     if as_json:
-        print(json.dumps(
+        doc = json.dumps(
             dict(
                 findings=[f.as_dict() for f in findings],
                 count=len(findings),
                 rules=RULES,
             ),
             indent=2,
-        ))
+        )
+        print(doc)
+        if json_out:
+            with open(json_out, "w") as f:
+                f.write(doc + "\n")
     else:
         for f in findings:
             print(f.format())

@@ -22,10 +22,21 @@ The second half is the retrace counter: a context manager that counts
 jit cache misses (via jax's compile logging) per named phase, with
 optional budgets — the guard against the warm-cache/compile-budget
 failures documented in ADVICE.md.
+
+The third half (sic) is the collective-lockstep ledger: the runtime
+backstop for the static SPMD divergence rules (PML012–PML016). Every
+host-coordination collective dispatch rolls (name, seq, tag) into a
+per-rank hash; `verify_ledger` psum-compares the digests at phase
+boundaries under ``validate="full"``, so a desynced collective
+schedule — the failure the static rules can only flag in SOURCE —
+becomes a typed :class:`~parmmg_tpu.failsafe.CollectiveDivergenceError`
+at the next boundary instead of a watchdog timeout deep inside some
+later collective.
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 from contextlib import contextmanager
 from typing import Callable, Dict, Optional
@@ -388,3 +399,131 @@ def run_adapt_with_budget(
     counter.check(budgets or {})
     info["recompiles"] = dict(counter.counts)
     return out, info
+
+
+# ---------------------------------------------------------------------------
+# collective-lockstep ledger (runtime half of PML012–PML016)
+# ---------------------------------------------------------------------------
+
+# agree_flags psums int32: the sum-of-squares round needs
+# world * (2^DIGEST_BITS - 1)^2 < 2^31, which 12 bits satisfies for
+# worlds up to 128 processes — far beyond anything this repo runs
+_DIGEST_BITS = 12
+
+
+class CollectiveLedger:
+    """Per-rank rolling hash of the host-collective dispatch schedule.
+
+    The whole coordination layer (`parallel.multihost`) depends on every
+    process dispatching the same collectives in the same order; the
+    static rules PML012–PML016 reject the source patterns that break
+    that, and this ledger is the runtime check of the same contract:
+    each `_coll_span` rolls ``(name, seq, tag)`` into a sha256, and
+    `verify_ledger` compares the truncated digests across the world.
+    A rank that skipped (or injected) a collective carries a different
+    digest, and EVERY rank sees the mismatch at the same boundary —
+    the desync becomes a simultaneous typed error, not one rank hanging
+    in a collective its peers never dispatch.
+    """
+
+    def __init__(self) -> None:
+        self._hash = hashlib.sha256()
+        self.count = 0
+        self.last = ""
+
+    def record(self, name: str, seq: int, sig: str = "") -> None:
+        self._hash.update(f"{name}:{seq}:{sig}\n".encode())
+        self.count += 1
+        self.last = f"{name}#{seq}"
+
+    @property
+    def digest(self) -> int:
+        """Truncated schedule digest, small enough that the world sum
+        AND the world sum of squares both fit the int32 psum lane."""
+        return int(self._hash.hexdigest()[:8], 16) & (
+            (1 << _DIGEST_BITS) - 1
+        )
+
+
+# the ledger currently recording (one at a time, like the retrace
+# counter's _ACTIVE): None keeps `record_collective` a single attribute
+# load + comparison, so validate="basic"/"off" runs pay nothing
+_LEDGER: Optional[CollectiveLedger] = None
+
+
+def install_ledger() -> CollectiveLedger:
+    """Arm collective-schedule recording (idempotent: an already
+    installed ledger keeps accumulating — nested harnesses must share
+    one schedule, a reset mid-run would desync the comparison)."""
+    global _LEDGER
+    if _LEDGER is None:
+        _LEDGER = CollectiveLedger()
+    return _LEDGER
+
+
+def uninstall_ledger() -> None:
+    global _LEDGER
+    _LEDGER = None
+
+
+def ledger() -> Optional[CollectiveLedger]:
+    return _LEDGER
+
+
+def record_collective(name: str, seq: int, sig: str = "") -> None:
+    """Hook for `parallel.multihost._coll_span`: one None-check when no
+    ledger is installed (the steady-state path)."""
+    if _LEDGER is not None:
+        _LEDGER.record(name, seq, sig)
+
+
+def verify_ledger(it: int, phase: str = "iteration",
+                  timeout: Optional[float] = None) -> None:
+    """World-compare the collective schedule digests; raise the typed
+    :class:`~parmmg_tpu.failsafe.CollectiveDivergenceError` on EVERY
+    rank when they disagree.
+
+    Two `agree_flags` rounds carry the digest sum and the digest
+    sum-of-squares; by Cauchy–Schwarz ``world * sum(d^2) == sum(d)^2``
+    iff all digests are equal, and both sums are psum-replicated, so
+    every rank computes the SAME verdict — the whole world raises
+    together instead of a subset raising while the rest wedge in the
+    next collective. No-op single-process or with no ledger installed.
+    """
+    led = _LEDGER
+    if led is None:
+        return
+    from ..parallel import multihost
+
+    if not multihost.is_multiprocess():
+        return
+    mine = led.digest
+    count = led.count
+    world = jax.process_count()
+    # the verification rounds are themselves collectives every rank
+    # dispatches here, so they extend the ledger identically everywhere
+    s1 = multihost.agree_flags(
+        mine, tag=f"ledger:{phase}:{it}", timeout=timeout
+    )
+    s2 = multihost.agree_flags(
+        mine * mine, tag=f"ledger2:{phase}:{it}", timeout=timeout
+    )
+    if world * s2 == s1 * s1:
+        return
+    from ..obs import trace as obs_trace
+
+    obs_trace.emit_event(
+        "collective_divergence", it=int(it), phase=phase,
+        rank=int(jax.process_index()), digest=int(mine),
+        count=int(count), last=led.last,
+    )
+    from .. import failsafe
+
+    raise failsafe.CollectiveDivergenceError(
+        f"collective schedule diverged at {phase} boundary (it {it}): "
+        f"rank {jax.process_index()} digest {mine:#05x} after {count} "
+        f"collectives (last {led.last!r}) disagrees with the world "
+        f"(sum {s1}, sum-of-squares {s2}, world {world}) — a subset of "
+        "ranks skipped or injected a collective; resume from the last "
+        "committed checkpoint"
+    )

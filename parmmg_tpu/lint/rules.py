@@ -49,11 +49,12 @@ PML010 host-clock-trace    ``time.time()``/``time.perf_counter()``/
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Optional
 
 from .analyzer import (
     Finding, FuncInfo, ModuleInfo, Project, analyze_paths, is_tainted,
-    local_taint, _dotted_root,
+    local_taint, local_rank_taint, rank_origin, _dotted_root,
 )
 
 RULES: Dict[str, str] = {
@@ -72,7 +73,62 @@ RULES: Dict[str, str] = {
     "PML011": "Pallas kernel registration hygiene (paired lax "
               "reference + equivalence test; f32/i32-only kernel "
               "bodies, no host numpy)",
+    "PML012": "collective call dominated by a rank-tainted branch "
+              "(a subset of ranks issues it: the canonical SPMD "
+              "deadlock)",
+    "PML013": "nondeterministic iteration order (set iteration, "
+              "unsorted listdir/glob) feeding traced code or "
+              "collective payload construction",
+    "PML014": "unseeded randomness or wall-clock flowing into retry "
+              "jitter, cache keys or seeds (per-rank divergence)",
+    "PML015": "blocking host I/O inside a collective window without "
+              "a run_with_watchdog wrapper",
+    "PML016": "typed raise between paired collectives (one rank "
+              "raising while peers wait = silent hang)",
 }
+
+# -- the repo's collective surface (PML012/015/016) -----------------------
+# classified by LEAF name: call targets like `fs.heartbeat` /
+# `self.barrier` / `multihost.agree_flags` are method or module calls
+# whose base cannot always be resolved statically, but the leaf names
+# are reserved vocabulary across the codebase.
+COLLECTIVE_HOST_LEAVES = frozenset({
+    "barrier", "_barrier", "agree_flags", "gather_stacked",
+    "estimate_clock_offset", "sync_tracer_clock",
+    "_exchange_timestamps", "heartbeat", "elastic_poll",
+    "verify_collectives", "put_sharded_global",
+})
+COLLECTIVE_TRACED_LEAVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "psum_scatter",
+})
+COLLECTIVE_LEAVES = COLLECTIVE_HOST_LEAVES | COLLECTIVE_TRACED_LEAVES
+
+# checkpoint-store operations (the repo-wide durable-I/O surface) and
+# direct file I/O: the blocking-host-I/O vocabulary of PML015
+STORE_OP_LEAVES = frozenset({
+    "put", "put_json", "publish", "publish_json", "get", "get_json",
+    "delete", "list",
+})
+# directory listings whose order is filesystem-defined (PML013)
+LISTING_FNS = frozenset({
+    "os.listdir", "glob.glob", "glob.iglob", "os.scandir",
+})
+# wall-clock reads (PML014 sink analysis; superset lives in
+# HOST_CLOCK_CALLS for the under-trace rule PML010)
+WALL_CLOCK_CALLS = frozenset({
+    "time.time", "time.time_ns", "time.monotonic",
+    "time.monotonic_ns", "time.perf_counter", "time.perf_counter_ns",
+})
+# sanctioned seeded-RNG constructors (utils.retry's
+# `random.Random(seed)` jitter pattern): exempt from PML014
+SEEDED_RNG_CALLS = frozenset({
+    "random.Random", "random.SystemRandom", "random.getstate",
+    "random.setstate",
+    "numpy.random.default_rng", "numpy.random.Generator",
+    "numpy.random.RandomState", "numpy.random.SeedSequence",
+})
+_NONDET_SINK_RE = re.compile(r"seed|jitter|key|salt", re.IGNORECASE)
 
 # host-clock reads that are meaningless under trace (PML010): they
 # execute once at trace time and bake a constant into the program
@@ -524,6 +580,327 @@ def _check_donation(fi: FuncInfo, findings: List[Finding]) -> None:
             ))
 
 
+def _own_nested(fi: FuncInfo) -> set:
+    return {
+        sub.node for sub in fi.module.funcs.values() if sub.parent is fi
+    }
+
+
+def _iter_sans_nested(root: ast.AST, skip: set):
+    """Yield every descendant of `root` except nested-def subtrees
+    (those get their own per-function pass)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef) and child in skip:
+                continue
+            yield child
+            stack.append(child)
+
+
+def _is_store_io(call: ast.Call) -> bool:
+    """A blocking durable-I/O call: a CheckpointStore-protocol op on a
+    `*store*` base, or a direct `open(...)`."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "open"
+    if isinstance(fn, ast.Attribute) and fn.attr in STORE_OP_LEAVES:
+        return "store" in _leaf_name(fn.value)
+    return False
+
+
+def _watchdogged_ids(root: ast.AST, skip: set) -> set:
+    """ids of every node inside a run_with_watchdog(...) call's
+    arguments — the sanctioned bounded-I/O pattern."""
+    out: set = set()
+    for c in _iter_sans_nested(root, skip):
+        if not (isinstance(c, ast.Call)
+                and _leaf_name(c.func) == "run_with_watchdog"):
+            continue
+        for a in list(c.args) + [kw.value for kw in c.keywords]:
+            out.add(id(a))
+            for n in _iter_sans_nested(a, set()):
+                out.add(id(n))
+    return out
+
+
+def _fn_does_host_io(fi: FuncInfo) -> bool:
+    """Whether a function's body performs store/file I/O directly
+    (outside any run_with_watchdog call). Cached on the FuncInfo."""
+    cached = getattr(fi, "_does_host_io", None)
+    if cached is not None:
+        return cached
+    skip = _own_nested(fi)
+    wd = _watchdogged_ids(fi.node, skip)
+    out = False
+    for node in _iter_sans_nested(fi.node, skip):
+        if isinstance(node, ast.Call) and _is_store_io(node) and (
+            id(node) not in wd
+        ):
+            out = True
+            break
+    fi._does_host_io = out  # type: ignore[attr-defined]
+    return out
+
+
+def _fn_has_host_collective(fi: FuncInfo) -> bool:
+    cached = getattr(fi, "_has_host_coll", None)
+    if cached is not None:
+        return cached
+    skip = _own_nested(fi)
+    out = any(
+        isinstance(n, ast.Call)
+        and _leaf_name(n.func) in COLLECTIVE_HOST_LEAVES
+        for n in _iter_sans_nested(fi.node, skip)
+    )
+    fi._has_host_coll = out  # type: ignore[attr-defined]
+    return out
+
+
+def _check_spmd(fi: FuncInfo, findings: List[Finding],
+                project: Project) -> None:
+    """PML012-016: the SPMD divergence pass over one function."""
+    mi = fi.module
+    skip = _own_nested(fi)
+    rtaint = local_rank_taint(fi)
+
+    def emit(rule, node, msg, chain=()):
+        findings.append(Finding(
+            rule, mi.path, node.lineno, node.col_offset, msg,
+            func=fi.key, chain=list(chain),
+        ))
+
+    calls = [n for n in _iter_sans_nested(fi.node, skip)
+             if isinstance(n, ast.Call)]
+    host_colls = [c for c in calls
+                  if _leaf_name(c.func) in COLLECTIVE_HOST_LEAVES]
+    coll_bearing = bool(host_colls) or any(
+        _leaf_name(c.func) in COLLECTIVE_TRACED_LEAVES for c in calls
+    )
+
+    # -- PML012: collective dominated by a rank-tainted branch ---------
+    def fire_dominated(stmts, origin, guard_line):
+        for st in stmts:
+            if isinstance(st, ast.FunctionDef) and st in skip:
+                continue
+            for n in [st] + list(_iter_sans_nested(st, skip)):
+                if isinstance(n, ast.Call) and (
+                    _leaf_name(n.func) in COLLECTIVE_LEAVES
+                ):
+                    emit(
+                        "PML012", n,
+                        f"collective `{_leaf_name(n.func)}` is only "
+                        "issued by a subset of ranks — the branch "
+                        f"guarding it is rank-derived; every rank must "
+                        "run the same collective schedule (agree the "
+                        "predicate first: multihost.agree_flags)",
+                        chain=[origin,
+                               f"rank-tainted guard at line {guard_line}"],
+                    )
+
+    def branch_escapes(stmts) -> bool:
+        for st in stmts:
+            if isinstance(st, ast.FunctionDef) and st in skip:
+                continue
+            for n in [st] + list(_iter_sans_nested(st, skip)):
+                if isinstance(n, (ast.Return, ast.Raise)):
+                    return True
+        return False
+
+    def walk_stmts(stmts):
+        dom = None  # (origin, guard line) after a rank-guarded escape
+        for st in stmts:
+            if isinstance(st, ast.FunctionDef) and st in skip:
+                continue
+            if dom is not None:
+                fire_dominated([st], dom[0], dom[1])
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                o = rank_origin(fi, st.test, rtaint)
+                if o is not None and o[1]:
+                    fire_dominated(st.body, o[0], st.lineno)
+                    fire_dominated(getattr(st, "orelse", []) or [],
+                                   o[0], st.lineno)
+                    # `if rank != 0: return` fall-through: the ranks
+                    # that escaped never reach the statements below
+                    if isinstance(st, ast.If) and (
+                        branch_escapes(st.body)
+                        != branch_escapes(st.orelse)
+                    ):
+                        dom = (o[0], st.lineno)
+                    continue
+                walk_stmts(st.body)
+                walk_stmts(getattr(st, "orelse", []) or [])
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.With,
+                                 ast.AsyncWith)):
+                walk_stmts(st.body)
+                walk_stmts(getattr(st, "orelse", []) or [])
+            elif isinstance(st, ast.Try):
+                walk_stmts(st.body)
+                for h in st.handlers:
+                    walk_stmts(h.body)
+                walk_stmts(st.orelse)
+                walk_stmts(st.finalbody)
+
+    walk_stmts(fi.node.body)
+
+    # -- PML013: nondeterministic iteration order ----------------------
+    sorted_wrapped = {
+        id(a) for c in calls if _leaf_name(c.func) == "sorted"
+        for a in c.args
+    }
+    for c in calls:
+        dotted = _dotted_root(mi, c.func)
+        if dotted in LISTING_FNS and id(c) not in sorted_wrapped:
+            emit(
+                "PML013", c,
+                f"{dotted}() order is filesystem-defined and differs "
+                "across ranks — wrap in sorted(...) before iterating",
+            )
+    if fi.reachable or coll_bearing:
+        def is_set_expr(e) -> bool:
+            return isinstance(e, (ast.Set, ast.SetComp)) or (
+                isinstance(e, ast.Call)
+                and isinstance(e.func, ast.Name)
+                and e.func.id in ("set", "frozenset")
+            )
+
+        set_names = {
+            t.id
+            for n in _iter_sans_nested(fi.node, skip)
+            if isinstance(n, ast.Assign) and is_set_expr(n.value)
+            for t in n.targets if isinstance(t, ast.Name)
+        }
+        iters = [n.iter for n in _iter_sans_nested(fi.node, skip)
+                 if isinstance(n, (ast.For, ast.AsyncFor))]
+        for n in _iter_sans_nested(fi.node, skip):
+            if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp)):
+                iters.extend(g.iter for g in n.generators)
+        for it in iters:
+            is_set = is_set_expr(it) or (
+                isinstance(it, ast.Name) and it.id in set_names
+            )
+            if is_set:
+                emit(
+                    "PML013", it,
+                    "iteration over a set is PYTHONHASHSEED-ordered — "
+                    "per-rank order divergence feeding traced code or "
+                    "collective payloads; iterate sorted(...) instead",
+                )
+
+    # -- PML014: unseeded randomness / wall-clock into seeds -----------
+    for c in calls:
+        dotted = _dotted_root(mi, c.func)
+        if dotted is None:
+            continue
+        if (dotted.startswith("random.")
+                or dotted.startswith("numpy.random.")) and (
+                dotted not in SEEDED_RNG_CALLS):
+            emit(
+                "PML014", c,
+                f"{dotted}() draws from the process-global RNG — "
+                "per-rank divergence in jitter/ordering; use the "
+                "seeded pattern (random.Random(seed), see "
+                "utils.retry)",
+            )
+
+    def has_clock(node) -> Optional[str]:
+        nodes = [node] + list(_iter_sans_nested(node, skip))
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                d = _dotted_root(mi, n.func)
+                if d in WALL_CLOCK_CALLS:
+                    return d
+        return None
+
+    for n in _iter_sans_nested(fi.node, skip):
+        if isinstance(n, ast.Assign):
+            names = [t.id for t in n.targets if isinstance(t, ast.Name)]
+            if any(_NONDET_SINK_RE.search(x) for x in names):
+                clk = has_clock(n.value)
+                if clk:
+                    emit(
+                        "PML014", n,
+                        f"{clk}() flows into `{names[0]}` — a "
+                        "wall-clock-derived seed/jitter/key differs "
+                        "per rank; derive it from the schedule "
+                        "(iteration, attempt index) instead",
+                    )
+        elif isinstance(n, ast.Call):
+            for kw in n.keywords:
+                if kw.arg and _NONDET_SINK_RE.search(kw.arg):
+                    clk = has_clock(kw.value)
+                    if clk:
+                        emit(
+                            "PML014", n,
+                            f"{clk}() passed as `{kw.arg}=` — a "
+                            "wall-clock seed/jitter/key diverges per "
+                            "rank; derive it from the schedule "
+                            "instead",
+                        )
+
+    # -- PML015/016: the paired-collective window ----------------------
+    if not host_colls:
+        return
+    last_coll = max(c.lineno for c in host_colls)
+    first_coll = min(c.lineno for c in host_colls)
+
+    # I/O calls inside run_with_watchdog(...) arguments are the
+    # sanctioned bounded pattern
+    watchdogged = _watchdogged_ids(fi.node, skip)
+
+    for c in calls:
+        if id(c) in watchdogged or c.lineno > last_coll:
+            continue
+        if _is_store_io(c):
+            emit(
+                "PML015", c,
+                "blocking host I/O before the window's last "
+                "collective — a wedged store strands peers inside "
+                f"the collective at line {last_coll}; wrap in "
+                "multihost.run_with_watchdog (or bound it with the "
+                "store's timeout envelope)",
+            )
+            continue
+        leaf = _leaf_name(c.func)
+        if leaf in COLLECTIVE_LEAVES or leaf == "run_with_watchdog":
+            continue
+        callee = project.resolve_callable(mi, fi, c.func)
+        if (callee is not None and callee is not fi
+                and _fn_does_host_io(callee)
+                and not _fn_has_host_collective(callee)):
+            emit(
+                "PML015", c,
+                f"`{leaf}` performs blocking host I/O and is called "
+                "before the window's last collective (line "
+                f"{last_coll}) — a wedge there strands peers; wrap "
+                "the I/O in multihost.run_with_watchdog",
+                chain=[f"{callee.key} does store/file I/O"],
+            )
+
+    if len(host_colls) >= 2 and first_coll < last_coll:
+        for n in _iter_sans_nested(fi.node, skip):
+            if not isinstance(n, ast.Raise) or n.exc is None:
+                continue
+            if not (first_coll < n.lineno < last_coll):
+                continue
+            exc = n.exc
+            leaf = _leaf_name(exc.func if isinstance(exc, ast.Call)
+                              else exc)
+            if "PeerLost" in leaf or "Divergence" in leaf:
+                continue  # the typed watchdog-conversion pattern
+            emit(
+                "PML016", n,
+                f"`raise {leaf}` between paired collectives (lines "
+                f"{first_coll}..{last_coll}): one rank raising while "
+                "peers sit in the next collective is a silent hang — "
+                "agree the error first (multihost.agree_flags) or "
+                "raise the PeerLost/Divergence watchdog class",
+            )
+
+
 def _suppressed(mi: ModuleInfo, f: Finding) -> bool:
     if f.rule in mi.suppress_file or "all" in mi.suppress_file:
         return True
@@ -573,6 +950,7 @@ def run_lint(
             seen_nodes.add(id(fi.node))
             _FuncChecker(fi, findings).visit(fi.node)
             _check_donation(fi, findings)
+            _check_spmd(fi, findings, project)
     out = []
     for f in findings:
         if select and f.rule not in select:

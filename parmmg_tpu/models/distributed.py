@@ -929,6 +929,10 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
     try:
         while it < opts.niter:
             if fs.preempt_requested:
+                # every rank sees the broadcast SIGTERM and raises here
+                # together; a lone receiver's peers are bounded by the
+                # next heartbeat barrier's watchdog
+                # parmmg-lint: disable=PML016 -- peers are watchdog-bounded at the next heartbeat barrier (typed PeerLostError, not a hang)
                 raise failsafe.PreemptionError(
                     f"SIGTERM received before iteration {it} — the "
                     "last committed checkpoint stands; resume to "
@@ -1037,6 +1041,16 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
             last_good = fs.snapshot(stacked)
             if tr.enabled:
                 obs_metrics.registry().snapshot(it)
+            # collective-lockstep boundary: fire any scheduled comm
+            # fault (the chaos desync poisons THIS rank's ledger), then
+            # world-compare the collective-schedule digests under
+            # validate="full" — a desynced rank becomes a typed
+            # CollectiveDivergenceError on EVERY rank here, instead of
+            # a one-sided watchdog timeout in some later collective.
+            # Same placement contract as elastic_poll below: every rank
+            # reaches this boundary unconditionally
+            stacked = fs.fire(it, "comm", stacked)
+            fs.verify_collectives(it)
             # elastic reform vote (world-agreed; a collective when
             # armed multi-process, so it sits at the SAME boundary on
             # every rank): a standing preemption notice becomes a

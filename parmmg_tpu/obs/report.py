@@ -153,7 +153,7 @@ def rank_timelines(dirpath: str) -> Dict[int, List[dict]]:
 CHAOS_FAULT_EVENTS = ("fault_injected",)
 CHAOS_DETECT_EVENTS = ("sigterm_received", "peer_lost",
                        "preempt_notice", "preempt_notice_cleared",
-                       "capacity_restored")
+                       "capacity_restored", "collective_divergence")
 CHAOS_RECOVER_EVENTS = ("rollback", "checkpoint_commit", "resume")
 CHAOS_WORLD_EVENTS = ("world_reform", "world_shrink", "world_grow")
 _CHAOS_ROLES = (

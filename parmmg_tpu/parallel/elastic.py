@@ -256,12 +256,20 @@ class ElasticCoordinator:
         return name
 
     # -- the boundary poll ------------------------------------------------
-    def _publish_reform(self, kind: str, reason: str) -> None:
-        self.store.put_json(
-            REFORM_FMT.format(self.epoch, self.rank),
-            dict(format=ELASTIC_FORMAT, epoch=self.epoch,
-                 rank=self.rank, kind=kind, reason=reason,
-                 ts=time.time()),
+    def _publish_reform(self, kind: str, reason: str,
+                        timeout: Optional[float] = None) -> None:
+        # the publish happens BEFORE the vote collective: peers may
+        # already be waiting in agree_flags, so a wedged store must
+        # become a typed PeerLostError within the watchdog window, not
+        # an open-ended stall that strands the whole world (PML015)
+        multihost.run_with_watchdog(
+            lambda: self.store.put_json(
+                REFORM_FMT.format(self.epoch, self.rank),
+                dict(format=ELASTIC_FORMAT, epoch=self.epoch,
+                     rank=self.rank, kind=kind, reason=reason,
+                     ts=time.time()),
+            ),
+            f"elastic-publish:{kind}", timeout,
         )
 
     def poll(self, it: int,
@@ -285,6 +293,7 @@ class ElasticCoordinator:
                 self._publish_reform(
                     "shrink",
                     f"preemption notice on rank {self.rank} at it {it}",
+                    timeout=timeout,
                 )
                 self._published = True
             flag = 1
@@ -295,6 +304,7 @@ class ElasticCoordinator:
                     "grow",
                     f"capacity restored, world {self.world} below "
                     f"target {self.target_world} (it {it})",
+                    timeout=timeout,
                 )
                 self._published = True
             flag = 1

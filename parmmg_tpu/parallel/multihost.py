@@ -43,6 +43,7 @@ from contextlib import contextmanager
 import jax
 import numpy as np
 
+from ..lint import contracts as lint_contracts
 from ..obs import metrics as obs_metrics, trace as obs_trace
 
 
@@ -472,6 +473,11 @@ def _coll_span(name: str, tag: str):
     clocks here never sit under a jitted region."""
     seq = _COLL_SEQ.get(name, 0)
     _COLL_SEQ[name] = seq + 1
+    # collective-lockstep ledger (validate="full"): every dispatch
+    # rolls into the per-rank schedule hash that
+    # `lint.contracts.verify_ledger` world-compares at phase
+    # boundaries — a single None-check when the ledger is not armed
+    lint_contracts.record_collective(name, seq, tag)
     tr = obs_trace.get_tracer()
     t0 = time.perf_counter()
     try:

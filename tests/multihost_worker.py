@@ -115,9 +115,20 @@ def failsafe_main():
         hsiz=0.32, niter=2, max_sweeps=4, nparts=8, min_shard_elts=8,
         hgrad=None, polish_sweeps=0, checkpoint_dir=ckdir,
         watchdog_timeout=watchdog if multi else None,
+        # PMMGTPU_VALIDATE=full arms the collective-lockstep ledger
+        # (the chaos --desync rung); default stays the cheap device
+        # checks
+        validate=os.environ.get("PMMGTPU_VALIDATE") or "basic",
     )
     try:
         out, comm2, info = adapt_stacked_input(st, comm, opts)
+    except failsafe.CollectiveDivergenceError as e:
+        # the ledger proved a desynced collective schedule — EVERY rank
+        # raises this at the same boundary (before PeerLostError: it is
+        # a subclass, and the distinct exit code is the point)
+        print(f"COLL_DIVERGENCE rank={jax.process_index()}: {e}",
+              flush=True)
+        os._exit(failsafe.DIVERGENCE_EXIT_CODE)
     except failsafe.PreemptionError as e:
         # graceful SIGTERM path: the harness committed a checkpoint at
         # the iteration boundary before raising — exit through the
@@ -205,12 +216,16 @@ def elastic_main():
         min_shard_elts=8, hgrad=None, polish_sweeps=0,
         checkpoint_dir=ckdir,
         watchdog_timeout=watchdog if multi else None,
+        validate=os.environ.get("PMMGTPU_VALIDATE") or "basic",
     )
     try:
         out, comm2, info = adapt_stacked_input(st, comm, opts)
     except failsafe.WorldReformError as e:
         print(f"WORLD_REFORM rank={rank}: {e}", flush=True)
         os._exit(failsafe.REFORM_EXIT_CODE)
+    except failsafe.CollectiveDivergenceError as e:
+        print(f"COLL_DIVERGENCE rank={rank}: {e}", flush=True)
+        os._exit(failsafe.DIVERGENCE_EXIT_CODE)
     except failsafe.PreemptionError as e:
         # elastic departure / SIGTERM: checkpoint committed first
         print(f"PREEMPTED rank={rank}: {e}", flush=True)

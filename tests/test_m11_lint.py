@@ -574,3 +574,317 @@ def test_pml011_kernel_body_clean_jnp(tmp_path):
         o_ref[...] = jnp.sum(x_ref[...] * 2.0)
     """)
     assert "PML011" not in rule_ids(out)
+
+
+# --- PML012–016 SPMD divergence rules ------------------------------------
+
+
+SPMD_HEADER = HEADER + """
+    import os
+    from parmmg_tpu.parallel import multihost
+"""
+
+
+def test_spmd_rules_in_catalog():
+    for rid in ("PML012", "PML013", "PML014", "PML015", "PML016"):
+        assert rid in RULES, rid
+    assert len(RULES) >= 16
+
+
+def test_pml012_rank_guarded_collective_fires(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def publish():
+        if jax.process_index() == 0:
+            multihost.barrier("manifest")
+    """)
+    assert "PML012" in rule_ids(out)
+
+
+def test_pml012_interprocedural_rank_taint(tmp_path):
+    # the taint crosses the helper's return; the early return makes
+    # the barrier fall-through-dominated by the rank branch
+    out = lint(tmp_path, SPMD_HEADER + """
+    def rank_of():
+        return jax.process_index()
+
+    def publish():
+        r = rank_of()
+        if r != 0:
+            return
+        multihost.barrier("manifest")
+    """)
+    fs = [f for f in out if f.rule == "PML012"]
+    assert fs, rule_ids(out)
+    # the finding carries its taint chain (origin -> guard)
+    assert fs[0].chain and "process_index" in fs[0].chain[0]
+
+
+def test_pml012_env_rank_fires(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def vote():
+        if os.environ.get("PMMGTPU_PROC_ID") == "0":
+            multihost.agree_flags(1, tag="vote")
+    """)
+    assert "PML012" in rule_ids(out)
+
+
+def test_pml012_world_uniform_guard_clean(tmp_path):
+    # process_count is world-UNIFORM: every rank takes the same branch,
+    # so the canonical is_multiprocess() guard must not fire
+    out = lint(tmp_path, SPMD_HEADER + """
+    def maybe_sync():
+        if jax.process_count() > 1:
+            multihost.barrier("sync")
+    """)
+    assert "PML012" not in rule_ids(out)
+
+
+def test_pml012_suppressible(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def publish():
+        if jax.process_index() == 0:
+            # parmmg-lint: disable=PML012 -- peers wait at the commit barrier
+            multihost.barrier("manifest")
+    """)
+    assert "PML012" not in rule_ids(out)
+
+
+def test_pml013_set_iteration_into_collective_fires(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def exchange():
+        tags = {"a", "b"}
+        for t in tags:
+            multihost.barrier(t)
+    """)
+    assert "PML013" in rule_ids(out)
+
+
+def test_pml013_unsorted_listdir_fires(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def replay(d):
+        return [os.path.join(d, n) for n in os.listdir(d)]
+    """)
+    assert "PML013" in rule_ids(out)
+
+
+def test_pml013_sorted_listdir_clean(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def replay(d):
+        return [os.path.join(d, n) for n in sorted(os.listdir(d))]
+    """)
+    assert "PML013" not in rule_ids(out)
+
+
+def test_pml014_module_rng_fires(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    import random
+
+    def backoff(attempt):
+        return 0.1 * attempt * (1 + random.random())
+    """)
+    assert "PML014" in rule_ids(out)
+
+
+def test_pml014_wall_clock_seed_fires(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    import time
+
+    def make_seed():
+        seed = int(time.time())
+        return seed
+    """)
+    assert "PML014" in rule_ids(out)
+
+
+def test_pml014_seeded_rng_clean(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    import random
+
+    def backoff(attempt):
+        rng = random.Random(7)
+        return 0.1 * attempt * (1 + rng.random())
+    """)
+    assert "PML014" not in rule_ids(out)
+
+
+def test_pml015_blocking_io_in_window_fires(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def commit(store, path):
+        multihost.barrier("data")
+        store.put(path, b"x")
+        multihost.barrier("commit")
+    """)
+    assert "PML015" in rule_ids(out)
+
+
+def test_pml015_watchdogged_io_clean(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def commit(store, path):
+        multihost.barrier("data")
+        multihost.run_with_watchdog(
+            lambda: store.put(path, b"x"), "publish", 5.0)
+        multihost.barrier("commit")
+    """)
+    assert "PML015" not in rule_ids(out)
+
+
+def test_pml015_interprocedural_io_fires(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def write_side(path):
+        with open(path, "w") as f:
+            f.write("x")
+
+    def commit(path):
+        multihost.barrier("data")
+        write_side(path)
+        multihost.barrier("commit")
+    """)
+    fs = [f for f in out if f.rule == "PML015"]
+    assert fs and fs[0].chain, rule_ids(out)
+
+
+def test_pml016_typed_raise_between_collectives_fires(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def commit(ok):
+        multihost.barrier("data")
+        if not ok:
+            raise ValueError("bad manifest")
+        multihost.barrier("commit")
+    """)
+    assert "PML016" in rule_ids(out)
+
+
+def test_pml016_divergence_taxonomy_exempt(tmp_path):
+    # raising the peer-loss/divergence family IS the typed conversion
+    # the rule wants — exempt by name
+    out = lint(tmp_path, SPMD_HEADER + """
+    from parmmg_tpu.failsafe import CollectiveDivergenceError
+
+    def commit(ok):
+        multihost.barrier("data")
+        if not ok:
+            raise CollectiveDivergenceError("schedules diverged")
+        multihost.barrier("commit")
+    """)
+    assert "PML016" not in rule_ids(out)
+
+
+def test_pml016_suppressible(tmp_path):
+    out = lint(tmp_path, SPMD_HEADER + """
+    def commit(ok):
+        multihost.barrier("data")
+        if not ok:
+            # parmmg-lint: disable=PML016 -- peers are watchdog-bounded
+            raise ValueError("bad manifest")
+        multihost.barrier("commit")
+    """)
+    assert "PML016" not in rule_ids(out)
+
+
+def test_cli_json_artifact(tmp_path):
+    import json
+
+    from parmmg_tpu.lint.cli import main as lint_main
+
+    (tmp_path / "clean.py").write_text("def f():\n    return 1\n")
+    art = tmp_path / "findings.json"
+    rc = lint_main(["--json", str(art), "--root", str(tmp_path),
+                    str(tmp_path)])
+    assert rc == 0
+    doc = json.loads(art.read_text())
+    assert doc["count"] == 0 and doc["findings"] == []
+    assert "PML016" in doc["rules"]
+
+
+# --- collective-lockstep ledger ------------------------------------------
+
+
+def test_ledger_hash_determinism_and_divergence():
+    from parmmg_tpu.lint import contracts as c
+
+    a, b = c.CollectiveLedger(), c.CollectiveLedger()
+    for led in (a, b):
+        led.record("barrier", 0, "hb:iteration:0")
+        led.record("agree_flags", 0, "reform:0")
+    # identical schedules -> identical digests on every rank
+    assert a.digest == b.digest and a.count == b.count == 2
+    # one phantom collective -> the digests part ways
+    b.record("desync-fault", -1, "it1:comm@rank1")
+    assert a.digest != b.digest
+    # the digest fits the int32 psum lane with room for sum-of-squares
+    assert 0 <= a.digest < (1 << 12)
+
+
+def test_ledger_record_hook_unarmed_is_noop():
+    from parmmg_tpu.lint import contracts as c
+
+    c.uninstall_ledger()
+    assert c.ledger() is None
+    c.record_collective("barrier", 0, "t")   # validate="basic" path
+    assert c.ledger() is None
+    # verify is equally inert with no ledger installed
+    c.verify_ledger(0)
+
+
+def test_ledger_install_uninstall_cycle():
+    from parmmg_tpu.lint import contracts as c
+
+    led = c.install_ledger()
+    try:
+        assert c.install_ledger() is led     # idempotent, no reset
+        c.record_collective("barrier", 0, "t")
+        assert led.count == 1 and led.last == "barrier#0"
+        # single-process verify is a no-op (no collective to compare)
+        c.verify_ledger(0)
+    finally:
+        c.uninstall_ledger()
+    assert c.ledger() is None
+
+
+def test_harness_arms_ledger_only_under_full_validation():
+    from types import SimpleNamespace
+
+    from parmmg_tpu import failsafe
+    from parmmg_tpu.lint import contracts as c
+
+    basic = failsafe.harness(
+        SimpleNamespace(validate="basic", validate_every=1), "test")
+    try:
+        assert c.ledger() is None            # zero-overhead contract
+    finally:
+        basic.finish()
+
+    full = failsafe.harness(
+        SimpleNamespace(validate="full", validate_every=1), "test")
+    try:
+        assert c.ledger() is not None
+        full.verify_collectives(0)           # single-process: no raise
+    finally:
+        full.finish()
+    assert c.ledger() is None                # finish() disarms
+
+
+def test_desync_fault_poisons_ledger():
+    from parmmg_tpu import failsafe
+    from parmmg_tpu.lint import contracts as c
+
+    led = c.install_ledger()
+    try:
+        before = led.digest
+        plan = failsafe.FaultPlan.parse("it1:comm:desync")
+        assert plan.fire(1, "comm", None) is None   # state untouched
+        assert led.count == 1 and led.digest != before
+        assert plan.faults[0].fired
+    finally:
+        c.uninstall_ledger()
+
+
+def test_desync_fault_pairing_is_exclusive():
+    from parmmg_tpu import failsafe
+
+    for bad in ("it1:comm:kill", "it1:remesh:desync", "it0:ckpt:desync"):
+        with pytest.raises(ValueError):
+            failsafe.FaultPlan.parse(bad)
+    plan = failsafe.FaultPlan.parse("it1:comm:desync@rank1")
+    f = plan.faults[0]
+    assert (f.it, f.phase, f.kind, f.rank) == (1, "comm", "desync", 1)

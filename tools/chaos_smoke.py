@@ -82,7 +82,8 @@ KILL = 86
 PEER_LOST = 87
 MISMATCH = 88
 CKPT_IO = 89
-TYPED_RCS = {0, KILL, PEER_LOST, MISMATCH, CKPT_IO}
+DIVERGENCE = 92
+TYPED_RCS = {0, KILL, PEER_LOST, MISMATCH, CKPT_IO, DIVERGENCE}
 
 OPTS = dict(hsiz=0.45, niter=3, max_sweeps=3, hgrad=None,
             polish_sweeps=0)
@@ -235,7 +236,7 @@ def _timeline_kinds(obs_dir: str):
     import glob
     import json as _json
 
-    paths = glob.glob(os.path.join(obs_dir, "events_rank*.jsonl"))
+    paths = sorted(glob.glob(os.path.join(obs_dir, "events_rank*.jsonl")))
     kinds = []
     n_lines = 0
     for p in paths:
@@ -340,7 +341,7 @@ def main(args) -> int:
                       f"seed(s) — skipping seeds {seed}.."
                       f"{args.seed_base + args.seeds - 1}")
                 break
-            t_seed = time.monotonic()
+            t_start = time.monotonic()
             rng = random.Random(seed)
             spec, terminal, trajectory, use_async, flip = \
                 gen_schedule(rng)
@@ -358,7 +359,7 @@ def main(args) -> int:
                 continue
             finally:
                 done += 1
-                budget.note(time.monotonic() - t_seed)
+                budget.note(time.monotonic() - t_start)
             text = open(log).read()
             if rc not in TYPED_RCS:
                 failures.append(
@@ -632,7 +633,7 @@ def main_world(args) -> int:
                       f"{done} seed(s) — skipping seeds {seed}.."
                       f"{args.seed_base + args.seeds - 1}")
                 break
-            t_seed = time.monotonic()
+            t_start = time.monotonic()
             rng = random.Random(10_000 + seed)
             spec, terminal, expected = gen_world_schedule(rng, world)
             ck = os.path.join(tmp, f"ck_{seed}")
@@ -653,7 +654,7 @@ def main_world(args) -> int:
                 done += 1
                 continue
             finally:
-                budget.note(time.monotonic() - t_seed)
+                budget.note(time.monotonic() - t_start)
             done += 1
             bad = [
                 (r, rc) for r, rc in enumerate(rcs)
@@ -788,7 +789,8 @@ def _world_events(obs_dir: str):
     import json as _json
 
     out = {"world_shrink": [], "world_grow": []}
-    for p in glob.glob(os.path.join(obs_dir, "events_rank*.jsonl")):
+    for p in sorted(glob.glob(os.path.join(obs_dir,
+                                           "events_rank*.jsonl"))):
         with open(p) as f:
             for line in f:
                 line = line.strip()
@@ -955,6 +957,116 @@ def main_elastic(args) -> int:
     return 1
 
 
+# ---------------------------------------------------------------------------
+# collective-desync rung (--desync)
+# ---------------------------------------------------------------------------
+
+
+def main_desync(args) -> int:
+    """The collective-lockstep acceptance scenario: a 2-rank world with
+    the ledger armed (``PMMGTPU_VALIDATE=full``) absorbs an injected
+    ``it1:comm:desync@rank1`` — one rank's collective schedule is
+    poisoned as if it had dispatched a collective its peers never will.
+    The contract under test: EVERY rank exits with the typed
+    :data:`DIVERGENCE` code at the same boundary (zero hangs — the
+    watchdog never has to fire), and the post-mortem renders the
+    ``collective_divergence`` detection in the fault → detection chain.
+    A fault-free control run under the same validate level proves the
+    ledger itself never false-positives on a lockstep schedule."""
+    tmp = tempfile.mkdtemp(prefix="parmmg_chaos_ds_")
+    failures = []
+    budget = StageBudget()
+    try:
+        # --- control: ledger armed, no fault → clean lockstep finish --
+        t0 = time.monotonic()
+        try:
+            rcs, logs = _run_world(tmp, "ctl_", 2, {
+                "PMMGTPU_WATCHDOG": "120",
+                "PMMGTPU_VALIDATE": "full",
+            })
+        except subprocess.TimeoutExpired:
+            failures.append("desync control: HANG (watchdog)")
+            raise SystemExit
+        budget.note(time.monotonic() - t0)
+        if rcs != [0, 0]:
+            failures.append(
+                f"desync control: ledger-armed fault-free world "
+                f"exited {rcs}: …{logs[0][-1500:]}"
+            )
+            raise SystemExit
+        ref = _digest_lines(logs[0])
+        if not ref or any(_digest_lines(t) != ref for t in logs):
+            failures.append(
+                "desync control: ranks disagree on the clean digest"
+            )
+            raise SystemExit
+        print("[chaos-desync] control: ledger armed, 2 ranks, "
+              "fault-free — clean lockstep finish")
+
+        # --- the desync seed: rank 1's schedule poisoned at it1 -------
+        spec = "it1:comm:desync@rank1"
+        ck = os.path.join(tmp, "ck_desync")
+        obs = ck + "_obs"
+        label = f"desync seed: faults={spec}"
+        t0 = time.monotonic()
+        try:
+            rcs, logs = _run_world(tmp, "desync_", 2, {
+                "PARMMG_FAULTS": spec,
+                "PMMGTPU_CKPT_DIR": ck,
+                "PMMGTPU_WATCHDOG": "120",
+                "PMMGTPU_TRACE": obs,
+                "PMMGTPU_VALIDATE": "full",
+            })
+        except subprocess.TimeoutExpired:
+            failures.append(f"{label}: HANG (watchdog) — the ledger "
+                            "must convert a desync into a typed exit")
+            raise SystemExit
+        budget.note(time.monotonic() - t0)
+        # the whole point of the ledger: BOTH ranks take the typed
+        # divergence exit at the same boundary — not one rank typed
+        # and the other riding a watchdog timeout
+        if rcs != [DIVERGENCE, DIVERGENCE]:
+            failures.append(
+                f"{label}: exits {rcs}, want "
+                f"[{DIVERGENCE}, {DIVERGENCE}] on every rank: "
+                f"…{logs[0][-1500:]}\n…{logs[1][-1500:]}"
+            )
+            raise SystemExit
+        missing = [r for r, t in enumerate(logs)
+                   if "COLL_DIVERGENCE" not in t]
+        if missing:
+            failures.append(
+                f"{label}: rank {missing[0]} exited {DIVERGENCE} "
+                f"without the typed COLL_DIVERGENCE line: "
+                f"…{logs[missing[0]][-1500:]}"
+            )
+            raise SystemExit
+        try:
+            text = _assert_postmortem(obs, label, kinds=["desync"])
+            assert "collective_divergence" in text, (
+                f"{label}: post-mortem does not render the "
+                f"collective_divergence detection:\n{text}"
+            )
+        except AssertionError as e:
+            failures.append(str(e))
+            raise SystemExit
+        print(f"[chaos-desync] {label} -> both ranks exited typed "
+              f"{DIVERGENCE} at the same boundary, post-mortem "
+              "renders fault -> collective_divergence")
+        print("[chaos-desync] desynced collective schedule became a "
+              "simultaneous typed error — zero hangs, zero watchdog "
+              "timeouts")
+        return 0
+    except SystemExit:
+        pass
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("\n[chaos-desync] FAILURES:")
+    for f in failures:
+        print(" -", f)
+    return 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2])
@@ -968,7 +1080,14 @@ if __name__ == "__main__":
                     help="elastic autoscaling rung: notice-driven "
                          "shrink + capacity-restored grow through "
                          "tools/fleet.py")
+    ap.add_argument("--desync", action="store_true",
+                    help="collective-desync rung: an injected "
+                         "it1:comm:desync@rank1 must end in the typed "
+                         "divergence exit on EVERY rank (the "
+                         "collective-lockstep ledger), never a hang")
     args = ap.parse_args()
     if args.elastic:
         sys.exit(main_elastic(args))
+    if args.desync:
+        sys.exit(main_desync(args))
     sys.exit(main(args) if args.world == 1 else main_world(args))

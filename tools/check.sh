@@ -13,9 +13,23 @@
 set -u
 cd "$(dirname "$0")/.." || exit 1
 
-python -m parmmg_tpu.lint parmmg_tpu tools
+# the machine-readable findings artifact rides along: the JSON document
+# must parse and carry count=0 — a gate on the artifact contract itself
+# (tooling downstream consumes it), not just on the human rendering
+LINT_JSON="${LINT_JSON:-/tmp/parmmg_lint.json}"
+python -m parmmg_tpu.lint --json "$LINT_JSON" parmmg_tpu tools >/dev/null
 rc=$?
 echo "## lint rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+python - "$LINT_JSON" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["count"] == 0 and doc["findings"] == [], doc
+assert any(r.startswith("PML016") or r == "PML016" for r in doc["rules"]), \
+    sorted(doc["rules"])
+EOF
+rc=$?
+echo "## lint-json rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 [ "${1:-}" = "--lint-only" ] && exit 0
 
@@ -57,6 +71,17 @@ timeout -k 10 2700 env JAX_PLATFORMS=cpu PARMMG_STAGE_BUDGET_S=2400 \
     python tools/chaos_smoke.py --world 2 --seeds 3
 rc=$?
 echo "## chaos-world2 rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
+# collective-desync rung: with the lockstep ledger armed
+# (PMMGTPU_VALIDATE=full), an injected it1:comm:desync@rank1 must end
+# in the typed divergence exit (92) on EVERY rank at the SAME boundary
+# — never a hang, never a one-sided watchdog timeout — and the chaos
+# post-mortem must render the collective_divergence detection
+timeout -k 10 2700 env JAX_PLATFORMS=cpu PARMMG_STAGE_BUDGET_S=2400 \
+    python tools/chaos_smoke.py --desync
+rc=$?
+echo "## chaos-desync rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
 # elastic autoscaling rung: the operator-free acceptance scenario —

@@ -145,7 +145,7 @@ def main() -> int:
         assert p.returncode == failsafe.KILL_EXIT_CODE, (
             p.returncode, p.stdout[-2000:], p.stderr[-2000:],
         )
-        assert not [f for f in os.listdir(ckdir) if ".tmp." in f], (
+        assert not [f for f in sorted(os.listdir(ckdir)) if ".tmp." in f], (
             "atomic write left temp files behind"
         )
         # the per-line JSONL flush must survive the worker's os._exit:

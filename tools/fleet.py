@@ -103,7 +103,7 @@ def reform_kinds(ckdir: str, epoch: int):
     prefix = f"elastic_reform_e{epoch:05d}_"
     kinds = set()
     try:
-        names = os.listdir(ckdir)
+        names = sorted(os.listdir(ckdir))
     except FileNotFoundError:
         return kinds
     for name in names:

@@ -78,7 +78,7 @@ def journal_docs(journal_dir):
     docs = {}
     if not os.path.isdir(journal_dir):
         return docs
-    for name in os.listdir(journal_dir):
+    for name in sorted(os.listdir(journal_dir)):
         if not (name.startswith("job_") and name.endswith(".json")):
             continue
         try:
