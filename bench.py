@@ -463,9 +463,31 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
     _note_phase("dist-warmup")
     adapt_distributed(_workload(n, hsiz), opts)
     _note_phase("dist-steady")
+    # migration / balance cost, first-class: cells + payload crossing
+    # shards and the balancing-block wall during the TIMED run only
+    # (the registry is process-global, so diff across the warmup)
+    from parmmg_tpu.obs import metrics as obs_metrics
+
+    _reg = obs_metrics.registry()
+    _mig0 = (
+        _reg.counter("migrate/cells_moved").value,
+        _reg.counter("migrate/payload_bytes").value,
+        _reg.counter("migrate/rebalances").value,
+        _reg.histogram("migrate/wall_s").sum,
+    )
     t0 = time.perf_counter()
     st, comm, info = adapt_distributed(_workload(n, hsiz), opts)
     wall = time.perf_counter() - t0
+    migrate_cost = {
+        "cells": _reg.counter("migrate/cells_moved").value - _mig0[0],
+        "payload_bytes":
+            _reg.counter("migrate/payload_bytes").value - _mig0[1],
+        "rebalances":
+            _reg.counter("migrate/rebalances").value - _mig0[2],
+        "wall_s": round(
+            _reg.histogram("migrate/wall_s").sum - _mig0[3], 4
+        ),
+    }
     merged = merge_adapted(st, comm)
     ne = int(merged.ntet)
     h = quality.quality_histogram(merged)
@@ -522,6 +544,7 @@ def run_dist(n=8, hsiz=0.08, nparts=2, niter=2, max_sweeps=12,
         "sweep_active_fraction": [round(x, 4) for x in saf],
         "imbalance": round(max(imb), 4) if imb else 0.0,
         "imbalance_series": [round(x, 4) for x in imb],
+        "migrate_cost": migrate_cost,
         "len/in_band": band[-1] if band else 0.0,
         "in_band_series": band,
         # AOT lower+compile seconds this process paid (0.0 on untraced

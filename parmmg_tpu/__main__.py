@@ -87,6 +87,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="number of shards (devices); 1 = single-chip")
     p.add_argument("-nobalance", dest="nobalancing", action="store_true",
                    help="disable interface displacement between iterations")
+    p.add_argument("-balance", dest="balance_band", type=float,
+                   default=None,
+                   help="closed-loop balance band: measured work "
+                        "imbalance (max/mean) above this forces a full "
+                        "re-cut, with hysteresis (default 1.5, env "
+                        "PMMGTPU_BALANCE_BAND; <= 0 disables)")
     p.add_argument("-nlayers", dest="ifc_layers", type=int, default=2,
                    help="interface-displacement advancing-front depth")
     p.add_argument("-groups-ratio", dest="grps_ratio", type=float,
@@ -133,6 +139,9 @@ def print_default_values() -> None:
     print(f"nparts (-nparts)        : {d.nparts}")
     print(f"ifc layers (-nlayers)   : {d.ifc_layers}")
     print(f"groups ratio            : {d.grps_ratio}")
+    from .parallel.migrate import BALANCE_BAND_DEFAULT
+
+    print(f"balance band (-balance) : {d.balance_band or BALANCE_BAND_DEFAULT}")
     print(f"angle detection (-ar)   : {d.angle}")
     print(f"hgrad (-hgrad)          : {d.hgrad}")
     print(f"hgradreq (-hgradreq)    : {d.hgradreq or 'off'}")
@@ -200,6 +209,7 @@ def main(argv=None) -> int:
         mem_budget_mb=args.mem,
         nparts=args.nparts,
         nobalancing=args.nobalancing,
+        balance_band=args.balance_band,
         ifc_layers=args.ifc_layers,
         grps_ratio=args.grps_ratio,
         frontier=not args.nofrontier,

@@ -71,6 +71,9 @@ class Param(enum.IntEnum):
     DPARAM_hgrad = 37
     DPARAM_hgradreq = 38
     DPARAM_ls = 39
+    # TPU addition: closed-loop balance band (measured work max/mean
+    # above which the balancer forces a re-cut; <= 0 disables)
+    DPARAM_balanceBand = 40
 
 
 _SOL_SIZES = {"scalar": 1, "vector": 3, "tensor": 6}
@@ -382,6 +385,10 @@ class ParMesh:
             o.hgradreq = None if value <= 0 else float(value)
         elif param == Param.DPARAM_angleDetection:
             o.angle = float(value)
+        elif param == Param.DPARAM_balanceBand:
+            # <= 0 disables the closed-loop balancer (resolve_balance_band
+            # treats non-positive bands as off)
+            o.balance_band = float(value)
         self.dparam[param] = float(value)
         return ReturnStatus.SUCCESS
 

@@ -835,6 +835,10 @@ _FINGERPRINT_EXCLUDE = frozenset({
     # parallel/distribute when the counts differ), exactly like the
     # world size it used to travel with
     "nparts",
+    # balance_band tunes WHERE work lives (the closed-loop rebalance
+    # trigger), a resource-layout knob like nparts: a resume may widen
+    # or narrow the band without invalidating the checkpointed mesh
+    "balance_band",
 })
 
 _MESH_DATA_FIELDS = tuple(

@@ -108,6 +108,16 @@ class AdaptOptions:
     # the default EVERYWHERE (CLI -nofrontier / False = full-table
     # sweeps, the pre-frontier behavior kept as the A/B baseline).
     frontier: bool = True
+    # closed-loop load balancing (distributed driver): band on the
+    # MEASURED work imbalance (max/mean of per-shard active x live-tet
+    # demand) past which the BalancePolicy fires — displacement first,
+    # full re-cut on a repeat breach (parallel.migrate.BalancePolicy).
+    # None = PMMGTPU_BALANCE_BAND env, else the conservative default
+    # (1.5); <= 0 disables the policy (CLI -balance <band>, with
+    # -balance 0 as the policy-only escape hatch; -nobalance still
+    # switches off ALL between-iteration resharding). Excluded from the
+    # checkpoint fingerprint like other resource-layout knobs.
+    balance_band: Optional[float] = None
     # Pallas kernel subsystem selection (parmmg_tpu.kernels.registry):
     # None leaves the process mode alone (PMMGTPU_KERNELS env, default
     # "auto" = Pallas on TPU / lax elsewhere); "off" = lax references

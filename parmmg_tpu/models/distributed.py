@@ -24,6 +24,7 @@ Re-design notes (vs the reference's per-rank group loop):
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import lru_cache, partial
 from typing import List, Optional
 
@@ -380,6 +381,161 @@ def _frontier_stale(fr: Frontier, s: Mesh, ecap: int) -> bool:
     )
 
 
+def _pad_changed1(changed, pcap: int):
+    """Single-shard analog of `pad_changed`: [PC_old] -> [PC] (growth
+    appends slots; ids are stable, the new tail is inactive)."""
+    changed = jnp.asarray(changed, bool)
+    pad = pcap - changed.shape[0]
+    if pad > 0:
+        changed = jnp.pad(changed, (0, pad))
+    return changed
+
+
+def _frontier_stale_shard(fr: Frontier, m: Mesh, ecap: int) -> bool:
+    """Per-shard (unstacked) `_frontier_stale`: capacity growth or an
+    edge-cap event changed this shard's table shapes."""
+    return (
+        fr.changed.shape[0] != m.vert.shape[0]
+        or fr.tables[0].shape[0] != ecap
+        or fr.tables[2].shape[0] != m.tet.shape[0]
+    )
+
+
+def _remesh_phase_shardlocal(
+    st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
+    it: int, hausd, fs=None, fr0=None,
+):
+    """Above-UNFUSED_TCAP remesh phase with SHARD-LOCAL unfused
+    dispatch: each process runs the per-op `_sweep_body` (fused=False —
+    every constituent op its own compiled program, host-branched skips)
+    only over the shards it OWNS under the global device mesh, then the
+    world view is reassembled from local rows
+    (`multihost.put_sharded_local_rows`) and replicated through the ONE
+    `gather_stacked` collective per sweep that the SPMD path already
+    pays. This replaces the former fallback where every process
+    computed ALL shards through the replicated vmapped engine — compute
+    that scaled with nparts exactly in the large-mesh regime sharding
+    exists for.
+
+    Owner/comm discipline is unchanged: host control flow stays
+    replicated-deterministic because every decision (capacity growth,
+    convergence, staleness RESETS) reads the gathered world state; the
+    per-shard frontier staleness scalars stay shard-local concrete
+    values as on the SPMD path (`_host_int` branches instead of
+    `shard_map` conds — a converged shard skips its rebuilds without
+    its neighbors paying). Per-sweep host records are world aggregates
+    of the reassembled stats, so the sweep-loop exit is bit-identical
+    on every process (the collective ledger stays in lockstep — one
+    gather per sweep on every rank).
+
+    Bit-equivalence to the replicated vmapped engine is digest-asserted
+    by tests/test_m24_balance.py: a stricter staleness level is always
+    exact and batched-vs-unbatched op parity holds (PR 7 property
+    tests), so per-shard staleness may only ever run MORE exact
+    rebuilds than the host-shared conservative max. Returns
+    (stacked, changed | None) like `_remesh_phase_local`."""
+    from ..parallel import multihost
+    from ..parallel.shard import device_mesh, owned_shards
+    from .adapt import _sweep_body, empty_frontier
+
+    D = st.tet.shape[0]
+    dmesh = device_mesh(D)
+    multi = multihost.is_multiprocess()
+    if multi:
+        procs = {d.process_index for d in dmesh.devices.ravel().tolist()}
+        if len(procs) != jax.process_count():
+            # a process owning no shard of the D-device mesh cannot
+            # contribute local rows (nor skip the gathers without
+            # desyncing the ledger): fall back to the replicated
+            # engine. Deterministic: dmesh is identical on every rank.
+            return _remesh_phase_local(st, opts, emult, history, it,
+                                       hausd, fr0=fr0)
+    owned = owned_shards(dmesh)
+    use_fr = bool(opts.frontier)
+    frs: dict = {}
+    wd = fs.watchdog if fs is not None else None
+    tr = obs_trace.get_tracer()
+    kw = dict(
+        noinsert=opts.noinsert, noswap=opts.noswap, nomove=opts.nomove,
+        nosurf=opts.nosurf, hausd=hausd,
+        # phase skip disabled for result-equivalence across the
+        # distributed dispatches (see _vsweep)
+        phase_skip=False,
+    )
+
+    def sweep_fn(s, ecap):
+        outs, stat_rows = [], []
+        for i in owned:
+            m = jax.tree_util.tree_map(lambda a, _i=i: a[_i], s)
+            if use_fr:
+                fr = frs.get(i)
+                if fr is None or _frontier_stale_shard(fr, m, ecap):
+                    if fr is not None:
+                        # mid-loop growth: keep the changed mask,
+                        # restart the tables stale (same discipline as
+                        # the vmapped/SPMD engines)
+                        chg = _pad_changed1(fr.changed, m.vert.shape[0])
+                    elif fr0 is not None:
+                        chg = _pad_changed1(
+                            jnp.asarray(fr0, bool)[i], m.vert.shape[0]
+                        )
+                    else:
+                        chg = None  # full frontier: exact full sweep
+                    fr = empty_frontier(m, ecap)
+                    if chg is not None:
+                        fr = fr._replace(changed=chg)
+                with tr.span("sweep_shard", it=it, shard=int(i)):
+                    m, stats, fro = _sweep_body(
+                        m, ecap, fused=False, frontier=fr, **kw
+                    )
+                frs[i] = fro
+            else:
+                with tr.span("sweep_shard", it=it, shard=int(i)):
+                    m, stats = _sweep_body(m, ecap, fused=False, **kw)
+            outs.append(m)
+            stat_rows.append(stats)
+        local = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *outs)
+        stats_l = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *stat_rows
+        )
+        if multi:
+            sg = multihost.put_sharded_local_rows(local, dmesh)
+            stg = multihost.put_sharded_local_rows(stats_l, dmesh)
+            if fs is not None:
+                # device-resident validation before the NaNs of a
+                # poisoned shard could ride the allgather (same
+                # discipline as the SPMD sweep path)
+                fs.validate_sharded(sg, dmesh, it, phase="sweep")
+            s2, stats_g = multihost.gather_stacked((sg, stg),
+                                                   timeout=wd)
+        else:
+            s2, stats_g = local, stats_l
+        return s2, _rec_from_stats(s2, stats_g)
+
+    st = run_sweep_loop(
+        st, opts, emult, history, it,
+        ensure_fn=lambda s: ensure_capacity_stacked(s, opts),
+        tcap_fn=lambda s: int(s.tet.shape[1]),
+        sweep_fn=sweep_fn,
+    )
+    if not use_fr:
+        return st, None
+    pcap = st.vert.shape[1]
+    if frs:
+        loc = jnp.stack([
+            _pad_changed1(frs[i].changed, pcap) for i in owned
+        ])
+        if multi:
+            chg = multihost.gather_stacked(
+                multihost.put_sharded_local_rows(loc, dmesh), timeout=wd
+            )
+        else:
+            chg = loc
+    else:
+        chg = fr0 if fr0 is not None else jnp.ones((D, pcap), bool)
+    return st, pad_changed(jnp.asarray(np.asarray(chg), bool), pcap)
+
+
 def _remesh_phase_global(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
     it: int, hausd, fs=None, fr0=None,
@@ -411,14 +567,13 @@ def _remesh_phase_global(
         # Above the compile-budget threshold the fused whole-sweep
         # program must not be built (whole-program XLA scheduling costs
         # hours at these shapes — PERF_NOTES r4); the per-op unfused
-        # path cannot run inside one shard_map program, so fall back to
-        # the replicated vmapped engine: every process computes all
-        # shards (correct, deterministic, compile-bounded) — the
-        # distribution of sweep COMPUTE across processes is then lost,
-        # which is the documented trade until a per-op shard_map
-        # dispatch exists.
-        return _remesh_phase_local(st, opts, emult, history, it, hausd,
-                                   fr0=fr0)
+        # path cannot run inside one shard_map program, so dispatch the
+        # shard-local unfused engine: each process sweeps only the
+        # shards it owns and the world view is reassembled through one
+        # gather per sweep (digest-identical to the replicated vmapped
+        # engine it replaced — tests/test_m24_balance.py).
+        return _remesh_phase_shardlocal(st, opts, emult, history, it,
+                                        hausd, fs=fs, fr0=fr0)
     dmesh = device_mesh(D)
     use_fr = bool(opts.frontier)
     fr_cell: list = [None]
@@ -861,6 +1016,22 @@ def _grow_stacked_for_recovery(st: Mesh, opts: DistOptions) -> Mesh:
     return grow_stacked(st, *want)
 
 
+def _publish_shard_gauges(st: Mesh) -> None:
+    """Publish `work/imbalance` + per-shard live-tet gauges from the
+    CURRENT stacked state. `record_sweep` only writes these when a
+    distributed sweep record lands, so an iteration whose balancing
+    moved cells AFTER the last sweep (or a drained early-converged
+    iteration that records no sweep at all) would otherwise leave the
+    gauges stale; the iteration boundary republishes them
+    (last-write-wins Gauge semantics — the freshest state wins)."""
+    ne = np.asarray(jax.device_get(jnp.sum(st.tmask, axis=1)))
+    reg = obs_metrics.registry()
+    imb = float(ne.max()) / max(float(ne.mean()), 1.0)
+    reg.gauge("work/imbalance").set(round(imb, 4))
+    for i, v in enumerate(ne.tolist()):
+        reg.gauge(f"work/live_tets/shard{i}").set(float(v))
+
+
 def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                     history: List[dict], icap0: int | None = None,
                     fs=None, start_it: int = 0, emult0: float | None = None,
@@ -908,6 +1079,18 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
     emult = [emult0 if emult0 is not None else 1.6]
     icap = icap0
     comm = None
+    # closed-loop balancer: band on the measured work imbalance with
+    # hysteresis + a min re-cut interval (parallel.migrate). One policy
+    # instance for the whole run — its state (strikes, last fire) IS
+    # the hysteresis. `balance_band`/PMMGTPU_BALANCE_BAND <= 0 (or
+    # -nobalance) disables it; the GRPS_RATIO count-based escape hatch
+    # stays active either way.
+    from ..parallel import migrate as migrate_mod
+
+    _band = migrate_mod.resolve_balance_band(opts)
+    policy = (migrate_mod.BalancePolicy(_band)
+              if _band is not None and not opts.nobalancing
+              and nparts > 1 else None)
     status = tags.ReturnStatus.SUCCESS
     last_good = fs.snapshot(stacked)
     it = start_it
@@ -950,7 +1133,7 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
             def _iteration(st, cm, ic, fr):
                 st, cm, ic, fr = _one_iteration(
                     st, opts, hausd, history, it, cm, ic, emult, nparts,
-                    fs=fs, fr=fr,
+                    fs=fs, fr=fr, policy=policy,
                 )
                 fs.validate(st, it, comm=cm, phase="iteration")
                 return st, cm, ic, fr
@@ -1039,6 +1222,11 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                 break
             attempts = 0
             last_good = fs.snapshot(stacked)
+            # boundary gauge publication BEFORE the snapshot row, so
+            # the per-iteration series reflects the post-balancing
+            # state, not the last sweep record (satellite fix: gauges
+            # were only written by record_sweep)
+            _publish_shard_gauges(stacked)
             if tr.enabled:
                 obs_metrics.registry().snapshot(it)
             # collective-lockstep boundary: fire any scheduled comm
@@ -1122,7 +1310,7 @@ def _compact_aux_stacked(st: Mesh, changed):
 
 
 def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
-                   nparts, fs=None, fr=None):
+                   nparts, fs=None, fr=None, policy=None):
     if fs is None:
         from .. import failsafe
 
@@ -1185,6 +1373,20 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
         from ..parallel import migrate as migrate_mod
         from ..utils.retry import jit_retry
 
+        # closed-loop balance decision (BalancePolicy): reads the
+        # MEASURED per-shard work from this iteration's sweep records
+        # (active-fraction-weighted live tets — what the sweeps
+        # actually paid), not element counts alone. Host-deterministic
+        # over the replicated history, so every rank computes the same
+        # action and the forced re-cut below cannot desync the
+        # collective ledger. The interface displacement itself stays
+        # unconditional — it doubles as the unfreezing machinery that
+        # makes frozen bands interior next iteration.
+        decision = (policy.evaluate(history, it)
+                    if policy is not None else None)
+        force_recut = bool(decision
+                           and decision.get("action") == "recut")
+        t_bal = time.monotonic()
         stacked = fs.fire(it, "migrate", stacked)
         stacked = assign_global_ids(stacked)
         comm = rebuild_comm(stacked, icap)
@@ -1244,16 +1446,31 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
             jax.device_get(jnp.sum(stacked.tmask, axis=1))
         )
         new_ne = shard_ne - cnts.sum(axis=1) + cnts.sum(axis=0)
+        # pre-balance imbalance for the tracer event: the policy's
+        # work-weighted measure when telemetry exists, raw live-tet
+        # skew otherwise
+        imb_pre = (decision or {}).get("imbalance")
+        if imb_pre is None:
+            imb_pre = round(
+                float(shard_ne.max()) / max(float(shard_ne.mean()), 1.0),
+                4,
+            )
+        trigger = "graph" if graph_mode else "displacement"
         # GRPS_RATIO discipline (reference src/parmmg.h:218-227): when
         # accumulated displacement skews shard sizes past the ratio,
         # rebalance with a fresh SFC cut (host fallback). Ratio is
         # max-vs-mean: wall-clock is governed by the LARGEST shard.
+        # The BalancePolicy forces the same escape hatch when the
+        # MEASURED imbalance has sat above its band (hysteresis +
+        # min-interval live in the policy, not here).
         if opts.verbose >= 2:
             print(f"  [balance] moved={int(cnts.sum())} "
                   f"new_ne={new_ne.tolist()}")
-        if new_ne.max() > opts.grps_ratio * max(new_ne.mean(), 1.0):
+        if force_recut or (
+                new_ne.max() > opts.grps_ratio * max(new_ne.mean(), 1.0)):
+            trigger = "balance-policy" if force_recut else "grps_ratio"
             if opts.verbose >= 2:
-                print("  [balance] GRPS_RATIO fallback (full re-cut)")
+                print(f"  [balance] full re-cut ({trigger})")
             stacked, comm = _rebalance_full(stacked, comm, nparts)
             icap = None
             stacked = _presize_for_target(stacked, opts)
@@ -1352,6 +1569,7 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
             if moved is None:
                 # capacity estimates kept falling short: full re-cut
                 # fallback (the pre-existing degradation)
+                trigger = "capacity-recut"
                 stacked, comm = _rebalance_full(stacked, comm, nparts)
                 icap = None
                 stacked = _presize_for_target(stacked, opts)
@@ -1371,6 +1589,31 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
                     fr = migrate_mod.frontier_from_gid_keys(
                         stacked, fr_keys
                     ) | par_post
+        # migration cost + decision telemetry, first-class: wall spent
+        # in the whole balancing block (color, contiguity repair,
+        # exchange OR re-cut) and one `rebalance` event per iteration
+        # that moved anything, carrying the before/after imbalance the
+        # report's "balance decisions" line renders
+        ne_post = np.asarray(
+            jax.device_get(jnp.sum(stacked.tmask, axis=1))
+        )
+        imb_post = round(
+            float(ne_post.max()) / max(float(ne_post.mean()), 1.0), 4
+        )
+        wall = time.monotonic() - t_bal
+        reg = obs_metrics.registry()
+        reg.histogram("migrate/wall_s").observe(wall)
+        recut = trigger in ("balance-policy", "grps_ratio",
+                            "capacity-recut")
+        if moved_cells or recut:
+            reg.counter("migrate/rebalances").inc()
+            obs_trace.emit_event(
+                "rebalance", it=int(it), trigger=trigger,
+                imbalance_pre=float(imb_pre),
+                imbalance_post=float(imb_post),
+                cells=int(moved_cells), wall_s=round(wall, 4),
+                reason=str((decision or {}).get("reason", "")),
+            )
         obs_costs.record_hbm("migrate")
 
     return stacked, comm, icap, fr

@@ -459,6 +459,19 @@ def dist_summary(dirpath: str) -> dict:
             }
         if "comm/wait_s" in g:
             work["comm_wait_s_gauge"] = g["comm/wait_s"]
+    # balance decisions: one `rebalance` event per iteration whose
+    # balancing block moved cells or re-cut (emitted by the distributed
+    # driver with trigger/pre/post imbalance/cells/wall). Rank 0's
+    # stream suffices — the decision is replicated-deterministic.
+    balance = []
+    for r in sorted(tls):
+        evs = [x for x in tls[r]
+               if x.get("type") == "event" and x.get("name") == "rebalance"]
+        if evs:
+            balance = [dict(x.get("args", {})) for x in evs]
+            break
+    if balance:
+        work["balance_decisions"] = balance
     return dict(
         dir=dirpath,
         world=len(tls),
@@ -538,6 +551,18 @@ def render_dist(dirpath: str) -> str:
             L.append("live tets per shard: " + ", ".join(
                 f"s{k}={int(v)}" for k, v in shards.items()
             ))
+        decisions = s["work"].get("balance_decisions")
+        if decisions:
+            L.append(f"balance decisions: {len(decisions)}")
+            for d in decisions:
+                L.append(
+                    f"  it {int(d.get('it', -1)):3d}  "
+                    f"{str(d.get('trigger', '?')):<14s} "
+                    f"imb {float(d.get('imbalance_pre', 0.0)):.4f}"
+                    f" -> {float(d.get('imbalance_post', 0.0)):.4f}  "
+                    f"cells {int(d.get('cells', 0)):6d}  "
+                    f"wall {float(d.get('wall_s', 0.0)):.4f}s"
+                )
     L.append("")
     L.append("-- critical path (which rank gated the world) --")
     cp = s["critical_path"]

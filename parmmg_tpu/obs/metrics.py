@@ -20,6 +20,11 @@ Naming convention (what `tools/obs_report.py` renders):
                          obs.health unit-mesh telemetry)
   migrate/cells_moved    tets exchanged between shards
   migrate/payload_bytes  estimated migration payload
+  migrate/wall_s         histogram: wall seconds per balancing block
+                         (coloring + contiguity repair + exchange or
+                         re-cut)
+  migrate/rebalances     balance decisions that moved cells or re-cut
+                         (each also emits a `rebalance` trace event)
   comm/barriers          coordination barriers entered
   comm/collectives       cross-process gathers dispatched
   comm/wait_s            gauge: seconds this rank spent blocked
@@ -225,7 +230,11 @@ def record_sweep(rec: dict) -> None:
         reg.gauge(f"sweep_active_fraction/shard{i}").set(frac)
     # load-imbalance accounting (round 11): live tets per shard and
     # the max/mean imbalance factor the distributed records carry —
-    # the gauges `obs_report --dist` and the BENCH envelope read
+    # the gauges `obs_report --dist` and the BENCH envelope read.
+    # NOT the only writer: the distributed driver republishes both at
+    # every iteration boundary (`_publish_shard_gauges`), so the gauges
+    # track post-migration state even when an iteration records no
+    # sweep (drained skip) or balances after its last sweep
     if "imbalance" in rec:
         reg.gauge("work/imbalance").set(rec["imbalance"])
     for i, ne in enumerate(rec.get("shard_ne", ())):

@@ -31,8 +31,9 @@ merge+re-split of the global mesh:
 
 from __future__ import annotations
 
+import os
 from functools import partial
-from typing import Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +75,126 @@ def stacked_halo_max(vals: jax.Array, comm: ShardComm) -> jax.Array:
         return v.at[tgt].max(r_s.reshape(-1), mode="drop")
 
     return jax.vmap(per_shard)(vals, ci, recv)
+
+
+# ---------------------------------------------------------------------------
+# closed-loop balance policy (host, telemetry-driven)
+# ---------------------------------------------------------------------------
+
+# conservative default band: fire only past 1.5x max/mean measured work
+# (the reference's PMMG_GRPS_RATIO=2.0 governs ELEMENT counts at group
+# granularity; live demand is spikier, so the band sits below the
+# grps_ratio escape hatch but far enough from 1.0 not to thrash)
+BALANCE_BAND_DEFAULT = 1.5
+
+
+def resolve_balance_band(opts) -> Optional[float]:
+    """Effective work-imbalance band: `opts.balance_band` when set,
+    else the PMMGTPU_BALANCE_BAND env contract, else the conservative
+    default. A band <= 0 (the `-nobalance`-style A/B escape hatch for
+    the policy alone) disables the closed loop — interface displacement
+    and the GRPS_RATIO guard are untouched either way."""
+    band = getattr(opts, "balance_band", None)
+    if band is None:
+        env = os.environ.get("PMMGTPU_BALANCE_BAND")
+        band = float(env) if env else BALANCE_BAND_DEFAULT
+    band = float(band)
+    return band if band > 0 else None
+
+
+def measured_shard_work(history: List[dict], it: int) -> Optional[list]:
+    """Per-shard MEASURED work of iteration `it`: sum over the
+    iteration's sweep records of active_fraction x live tets per shard
+    (`shard_active[i] * shard_ne[i]` — the candidates each shard
+    actually offered its operators, not element counts alone). Falls
+    back to the last record's raw `shard_ne` when every sweep was
+    drained (work 0 everywhere still means the ELEMENT skew is what
+    the next iteration will pay to hold in memory/compile). None when
+    the iteration left no distributed records."""
+    rows = [
+        r for r in history
+        if r.get("iter") == it and "shard_ne" in r and "failure" not in r
+    ]
+    if not rows:
+        return None
+    d = len(rows[-1]["shard_ne"])
+    work = [0.0] * d
+    for r in rows:
+        act = r.get("shard_active") or [1.0] * d
+        for i, (a, ne) in enumerate(zip(act, r["shard_ne"])):
+            work[i] += float(a) * float(ne)
+    if max(work) <= 0.0:
+        work = [float(x) for x in rows[-1]["shard_ne"]]
+    return work
+
+
+class BalancePolicy:
+    """Band-with-hysteresis controller over the measured work imbalance
+    (the closed loop on PR 14's `work/imbalance` telemetry).
+
+    Evaluated once per iteration at the `_one_iteration` balancing
+    boundary. Semantics (the unit-test matrix in
+    tests/test_m24_balance.py):
+
+      - imbalance < `low_water` re-arms the controller (strikes reset);
+      - `low_water` <= imbalance <= `band` holds (hysteresis: a reading
+        inside the dead band neither fires nor re-arms, so one noisy
+        sample cannot oscillate the trigger);
+      - imbalance > `band` fires — unless the last firing was fewer
+        than `min_interval` iterations ago (migration itself perturbs
+        the next reading; the throttle keeps the loop from chasing its
+        own wake). The FIRST firing is ``displace`` (credit the
+        standing interface displacement as the corrective action and
+        let it work); a repeat firing escalates to ``recut`` — the
+        GRPS_RATIO-style full SFC re-cut escape hatch — because a skew
+        displacement could not cure within the band needs a fresh cut.
+
+    Host-deterministic by construction: decisions read only the
+    replicated history records, so every process computes the same
+    action (no collective, no divergence surface)."""
+
+    def __init__(self, band: float, low_water: Optional[float] = None,
+                 min_interval: int = 2):
+        self.band = float(band)
+        # default re-arm threshold: halfway between even and the band
+        self.low_water = (
+            float(low_water) if low_water is not None
+            else 1.0 + 0.5 * (self.band - 1.0)
+        )
+        self.min_interval = int(min_interval)
+        self._last_fire: Optional[int] = None
+        self._strikes = 0
+
+    def evaluate(self, history: List[dict], it: int) -> dict:
+        """Decision for iteration `it`: dict(imbalance, work, action,
+        reason) with action in (None, "displace", "recut")."""
+        work = measured_shard_work(history, it)
+        if work is None:
+            return dict(imbalance=None, work=None, action=None,
+                        reason="no-telemetry")
+        imb = round(max(work) / max(sum(work) / len(work), 1e-9), 4)
+        out = dict(imbalance=imb, work=work, action=None, reason="")
+        if imb < self.low_water:
+            self._strikes = 0
+            out["reason"] = "in-band"
+            return out
+        if imb <= self.band:
+            out["reason"] = "hysteresis-hold"
+            return out
+        if (
+            self._last_fire is not None
+            and it - self._last_fire < self.min_interval
+        ):
+            out["reason"] = "throttled"
+            return out
+        self._strikes += 1
+        self._last_fire = it
+        if self._strikes >= 2:
+            self._strikes = 0
+            out.update(action="recut", reason="band-exceeded-again")
+        else:
+            out.update(action="displace", reason="band-exceeded")
+        return out
 
 
 # ---------------------------------------------------------------------------

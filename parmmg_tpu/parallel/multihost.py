@@ -819,6 +819,36 @@ def put_sharded_global(tree, dmesh):
     return jax.tree_util.tree_map(put, tree)
 
 
+def put_sharded_local_rows(tree, dmesh):
+    """Inverse orientation of `put_sharded_global`: build the globally
+    sharded stacked [D,...] pytree from THIS process's shard rows only.
+
+    Each leaf is an [n_owned, ...] stack of the rows this process
+    computed, in ascending shard order (`shard.owned_shards`) — exactly
+    the layout `jax.make_array_from_process_local_data` expects for a
+    1-D `P(AXIS)` sharding, whose addressable shards it walks in the
+    same device order. This is the assembly step of the shard-local
+    unfused dispatch (models/distributed._remesh_phase_shardlocal):
+    unlike `put_sharded_global`, no process ever materializes the other
+    processes' rows. Single-process the mesh is fully addressable and
+    the local rows ARE the global array."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .shard import AXIS
+
+    if not is_multiprocess():
+        return tree
+    sh = NamedSharding(dmesh, P(AXIS))
+    nshards = int(dmesh.devices.size)
+
+    def put(a):
+        a = np.asarray(a)
+        gshape = (nshards,) + a.shape[1:]
+        return jax.make_array_from_process_local_data(sh, a, gshape)
+
+    return jax.tree_util.tree_map(put, tree)
+
+
 # replicate-identity programs keyed by device assignment (jit caches
 # per leaf structure/shapes underneath); a dict, not lru_cache, because
 # device tuples are the key and there is realistically one entry

@@ -30,6 +30,20 @@ def device_mesh(n: int | None = None) -> DeviceMesh:
     return DeviceMesh(np.array(devs[:n]), (AXIS,))
 
 
+def owned_shards(dmesh: DeviceMesh) -> tuple:
+    """Shard indices THIS process owns under the 1-D device mesh
+    (shard i <-> device i, owner = `device.process_index`) — ascending,
+    which is also the order `NamedSharding.addressable_devices` walks
+    them, so a [n_owned, ...] local-row stack in this order feeds
+    `jax.make_array_from_process_local_data` directly (the shard-local
+    sweep dispatch in models/distributed)."""
+    pid = jax.process_index()
+    return tuple(
+        i for i, d in enumerate(dmesh.devices.ravel().tolist())
+        if d.process_index == pid
+    )
+
+
 def put_sharded(tree, dmesh: DeviceMesh):
     """Place a stacked [D,...] pytree with its leading axis split over the
     device mesh."""

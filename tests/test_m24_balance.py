@@ -1,0 +1,262 @@
+"""PR-17 shard-local dispatch + closed-loop load balancing tests.
+
+Three contracts:
+
+1. **Shard-local unfused bit-equivalence** — above `UNFUSED_TCAP` the
+   sharded path runs `_sweep_body` only over the shards each process
+   owns (`_remesh_phase_shardlocal`); the result must be DIGEST-IDENTICAL
+   to the replicated vmapped engine it replaced (`_remesh_phase_local`),
+   including the frontier carry and the per-sweep history records, and
+   `_remesh_phase_global` must route the above-cap case to it (forced
+   via a `UNFUSED_TCAP = 0` monkeypatch, the PARMMG_UNFUSED_TCAP=0
+   override's effect).
+
+2. **BalancePolicy unit matrix** — band trigger, hysteresis low-water
+   re-arm, displace-then-recut escalation, min-interval throttle and
+   the no-telemetry fallback, on synthetic history rows.
+
+3. **Skewed-demand driver** — a deliberately imbalanced initial cut
+   driven through `adapt_stacked_input` with the balancer on conserves
+   live tets and ends with the imbalance back inside the band.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import parmmg_tpu.models.adapt as adapt_mod
+from parmmg_tpu.core import adjacency
+from parmmg_tpu.models.adapt import AdaptOptions, prepare_metric
+from parmmg_tpu.models.distributed import (
+    DistOptions,
+    _remesh_phase_local,
+    _remesh_phase_shardlocal,
+    adapt_stacked_input,
+    merge_adapted,
+    remesh_phase,
+)
+from parmmg_tpu.ops import analysis
+from parmmg_tpu.parallel.distribute import (
+    assign_global_ids, rebuild_comm, split_mesh,
+)
+from parmmg_tpu.parallel.migrate import (
+    BalancePolicy, measured_shard_work, resolve_balance_band,
+)
+from parmmg_tpu.parallel.partition import sfc_partition
+from parmmg_tpu.utils.gen import unit_cube_mesh
+
+
+def _digest(tree) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _stacked_fixture(nparts=2, n=3, hsiz=0.25):
+    mesh = unit_cube_mesh(n)
+    mesh = adjacency.build_adjacency(mesh)
+    mesh = analysis.analyze(mesh)
+    # max_sweeps=3: the digest comparison only needs BOTH engines to
+    # run the same (unconverged) sweep schedule, and every unfused
+    # sweep pays per-op compiles — tier-1 time is compile-dominated
+    opts = AdaptOptions(hsiz=hsiz, hgrad=None, niter=1, max_sweeps=3,
+                        verbose=0)
+    mesh = prepare_metric(mesh, opts, int(mesh.tcap * 1.6) + 64)
+    part = np.asarray(jax.device_get(sfc_partition(mesh, nparts)))
+    st, _ = split_mesh(mesh, part, nparts)
+    st = assign_global_ids(st)
+    st = jax.vmap(adjacency.build_adjacency)(st)
+    return st, opts
+
+
+# ---------------------------------------------------------------------------
+# 1. shard-local unfused dispatch: bit-equivalence to the replicated engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("frontier", [True, False],
+                         ids=["frontier", "full-table"])
+def test_shardlocal_bit_equivalent_to_replicated(frontier):
+    """`_remesh_phase_shardlocal` (per-shard `_sweep_body`, per-shard
+    frontier staleness) must produce the BIT-IDENTICAL mesh, frontier
+    carry and sweep records as the replicated vmapped engine on the
+    same stacked input — the digest assertion the fallback swap rests
+    on."""
+    st, opts = _stacked_fixture()
+    opts.frontier = frontier
+
+    def run(fn):
+        hist = []
+        s, fr = fn(st, opts, [1.6], hist, 0, 0.01, fr0=None)
+        return s, fr, hist
+
+    sa, fra, ha = run(_remesh_phase_shardlocal)
+    sb, frb, hb = run(_remesh_phase_local)
+    assert _digest(sa) == _digest(sb), "mesh digests diverge"
+    if frontier:
+        np.testing.assert_array_equal(np.asarray(fra), np.asarray(frb))
+    else:
+        assert fra is None and frb is None
+    cols = ("sweep", "nsplit", "ncollapse", "nswap", "nmoved",
+            "imbalance", "shard_ne")
+    assert [{k: r.get(k) for k in cols} for r in ha] == \
+           [{k: r.get(k) for k in cols} for r in hb]
+
+
+@pytest.mark.slow
+def test_global_dispatch_routes_above_tcap_to_shardlocal(monkeypatch):
+    """With `UNFUSED_TCAP` forced to 0 (every mesh is "above cap") and
+    SPMD dispatch selected, `remesh_phase` must route through the
+    shard-local engine and still match the replicated result — the
+    integration seam of the fallback replacement."""
+    monkeypatch.setattr(adapt_mod, "UNFUSED_TCAP", 0)
+    monkeypatch.setenv("PMMGTPU_SPMD_SWEEPS", "1")
+    st, opts = _stacked_fixture(n=3)
+    hist = []
+    sa, fra = remesh_phase(st, opts, [1.6], hist, 0, 0.01, fr0=None)
+    sb, frb = _remesh_phase_local(st, opts, [1.6], [], 0, 0.01,
+                                  fr0=None)
+    assert _digest(sa) == _digest(sb)
+    np.testing.assert_array_equal(np.asarray(fra), np.asarray(frb))
+    assert hist, "no sweep records"
+
+
+# ---------------------------------------------------------------------------
+# 2. BalancePolicy unit matrix
+# ---------------------------------------------------------------------------
+
+
+def _rows(it, work, active=None):
+    d = len(work)
+    return dict(iter=it, shard_ne=list(work),
+                shard_active=list(active) if active is not None
+                else [1.0] * d)
+
+
+def test_policy_in_band_never_fires():
+    p = BalancePolicy(1.5)
+    for it in range(5):
+        out = p.evaluate([_rows(it, [100, 100, 100, 100])], it)
+        assert out["action"] is None
+        assert out["reason"] == "in-band"
+        assert out["imbalance"] == 1.0
+
+
+def test_policy_no_telemetry():
+    p = BalancePolicy(1.5)
+    out = p.evaluate([], 0)
+    assert out["action"] is None and out["reason"] == "no-telemetry"
+    # failure records for the iteration do not count as telemetry
+    out = p.evaluate([dict(iter=0, failure="boom", shard_ne=[1, 2])], 0)
+    assert out["reason"] == "no-telemetry"
+
+
+def test_policy_hysteresis_hold_between_low_water_and_band():
+    p = BalancePolicy(2.0)  # low_water = 1.5
+    out = p.evaluate([_rows(0, [170, 100, 100, 100])], 0)  # imb ~1.45
+    assert out["reason"] == "in-band"
+    out = p.evaluate([_rows(1, [180, 100, 100, 100])], 1)  # imb 1.5+
+    assert out["action"] is None
+    assert out["reason"] == "hysteresis-hold"
+
+
+def test_policy_displace_then_recut_escalation():
+    p = BalancePolicy(1.5, min_interval=2)
+    skew = [400, 100, 100, 100]  # imb 2.29
+    out0 = p.evaluate([_rows(0, skew)], 0)
+    assert out0["action"] == "displace"
+    # inside min_interval: throttled even though still out of band
+    out1 = p.evaluate([_rows(1, skew)], 1)
+    assert out1["action"] is None and out1["reason"] == "throttled"
+    # past the interval and still above band: escalate to the re-cut
+    out2 = p.evaluate([_rows(2, skew)], 2)
+    assert out2["action"] == "recut"
+    assert out2["reason"] == "band-exceeded-again"
+
+
+def test_policy_low_water_rearm_resets_escalation():
+    p = BalancePolicy(1.5, min_interval=1)
+    skew = [400, 100, 100, 100]
+    assert p.evaluate([_rows(0, skew)], 0)["action"] == "displace"
+    # back in band: strikes reset
+    assert p.evaluate([_rows(1, [100] * 4)], 1)["reason"] == "in-band"
+    # next excursion starts over at displace, not recut
+    assert p.evaluate([_rows(2, skew)], 2)["action"] == "displace"
+
+
+def test_measured_work_weights_by_active_fraction():
+    """The policy reads MEASURED work: a shard full of converged (zero
+    active fraction) cells contributes nothing even if its element
+    count dominates."""
+    rows = [dict(iter=3, shard_ne=[1000, 100],
+                 shard_active=[0.0, 0.5])]
+    work = measured_shard_work(rows, 3)
+    assert work == [0.0, 50.0]
+    # all-zero active: element counts are the fallback signal
+    rows = [dict(iter=3, shard_ne=[1000, 100],
+                 shard_active=[0.0, 0.0])]
+    assert measured_shard_work(rows, 3) == [1000.0, 100.0]
+    # multiple sweeps of one iteration accumulate
+    rows = [_rows(4, [10, 20]), _rows(4, [30, 40])]
+    assert measured_shard_work(rows, 4) == [40.0, 60.0]
+    assert measured_shard_work(rows, 5) is None
+
+
+def test_resolve_balance_band_knobs(monkeypatch):
+    monkeypatch.delenv("PMMGTPU_BALANCE_BAND", raising=False)
+    assert resolve_balance_band(DistOptions()) == 1.5  # default on
+    assert resolve_balance_band(DistOptions(balance_band=2.25)) == 2.25
+    assert resolve_balance_band(DistOptions(balance_band=0.0)) is None
+    monkeypatch.setenv("PMMGTPU_BALANCE_BAND", "1.8")
+    assert resolve_balance_band(DistOptions()) == 1.8
+    monkeypatch.setenv("PMMGTPU_BALANCE_BAND", "-1")
+    assert resolve_balance_band(DistOptions()) is None
+
+
+def test_balance_band_excluded_from_fingerprint():
+    """A resume may widen or narrow the band without invalidating the
+    checkpointed mesh — resource-layout knob discipline."""
+    from parmmg_tpu.failsafe import _FINGERPRINT_EXCLUDE
+
+    assert "balance_band" in _FINGERPRINT_EXCLUDE
+
+
+# ---------------------------------------------------------------------------
+# 3. skewed-demand driver: conservation + band re-entry
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_skewed_demand_rebalances_into_band():
+    """A deliberately skewed initial cut (one shard owning most of the
+    mesh) driven through the balancing loop must conserve live tets
+    across migrations and end with the live-tet imbalance back inside
+    the band."""
+    band = 1.5
+    nparts = 4
+    mesh = unit_cube_mesh(3)
+    chunks = np.asarray(jax.device_get(sfc_partition(mesh, 2 * nparts)))
+    part = np.where(chunks < nparts + 1, 0, chunks - nparts)
+    st, comm = split_mesh(mesh, part, nparts)
+    ne0 = np.asarray(jax.device_get(st.tmask.sum(axis=1)))
+    imb0 = float(ne0.max()) / max(float(ne0.mean()), 1.0)
+    assert imb0 > band, f"fixture not skewed ({imb0:.3f})"
+
+    opts = DistOptions(hsiz=0.32, niter=2, max_sweeps=3, nparts=nparts,
+                       min_shard_elts=8, hgrad=None, polish_sweeps=0,
+                       balance_band=band)
+    out, comm2, info = adapt_stacked_input(st, comm, opts)
+
+    ne = np.asarray(jax.device_get(out.tmask.sum(axis=1)))
+    merged = merge_adapted(out, comm2)
+    assert int(ne.sum()) == int(merged.ntet), "live tets not conserved"
+    imb_final = float(ne.max()) / max(float(ne.mean()), 1.0)
+    assert imb_final <= band, \
+        f"final imbalance {imb_final:.3f} outside band {band}"
+    assert int(info["status"]) == 0

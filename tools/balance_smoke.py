@@ -1,0 +1,212 @@
+"""Closed-loop load-balancing smoke for the CI gate (check.sh balance).
+
+The PR-17 acceptance, end to end on the 2-process CPU fixture: a
+deliberately SKEWED initial cut (one shard owning most of the mesh)
+driven through a traced 2-rank `adapt_stacked_input` run with the
+closed-loop balancer on must:
+
+1. finish typed-clean on both ranks (no watchdog, no peer loss);
+2. CONSERVE live tets — the final per-shard totals sum to the merged
+   mesh's tet count (migration moved work, it didn't mint or lose it);
+3. end back INSIDE the balance band — the final live-tets max/mean is
+   at or under the band the policy ran with;
+4. leave at least one `rebalance` trace event carrying the decision
+   telemetry (trigger, pre/post imbalance, cells, wall), and the
+   "balance decisions" line must render in `obs_report --dist`.
+
+Run hermetically on CPU: ``python tools/balance_smoke.py``; exit 0 =
+the loop closed. ``--worker`` is the child mode (do not run directly).
+Budget knob: PARMMG_STAGE_BUDGET_S bounds the worker wait.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+BAND = 1.5
+NPARTS = 4
+
+
+def skewed_partition(mesh, nparts: int):
+    """A deliberately imbalanced cut: chunk the SFC order 2x finer than
+    the shard count, then give shard 0 every chunk the others don't
+    take — most of the mesh lands on one shard while every shard stays
+    nonempty (uniform capacities need live cells everywhere)."""
+    import numpy as np
+    import jax
+
+    from parmmg_tpu.parallel.partition import sfc_partition
+
+    chunks = np.asarray(jax.device_get(sfc_partition(mesh, 2 * nparts)))
+    part = np.where(chunks < nparts + 1, 0, chunks - nparts)
+    return part
+
+
+def worker() -> int:
+    """Child mode: one rank of the traced skewed 2-process run. Prints
+    BAL_TOT (final per-shard live tets + merged tet count) and BAL_IMB
+    (per-iteration imbalance series + final) for the parent asserts."""
+    from parmmg_tpu.parallel import multihost
+
+    multi = multihost.init_from_env()
+
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _accel in ("axon", "tpu", "cuda", "rocm"):
+        _xb._backend_factories.pop(_accel, None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from parmmg_tpu import failsafe
+    from parmmg_tpu.models.distributed import (
+        DistOptions, adapt_stacked_input, merge_adapted,
+    )
+    from parmmg_tpu.parallel.distribute import split_mesh
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    assert multi and jax.process_count() == 2, "2-process env required"
+    watchdog = float(os.environ.get("PMMGTPU_WATCHDOG", "120"))
+
+    mesh = unit_cube_mesh(3)
+    part = skewed_partition(mesh, NPARTS)
+    st, comm = split_mesh(mesh, part, NPARTS)
+    ne0 = np.asarray(jax.device_get(st.tmask.sum(axis=1)))
+    imb0 = float(ne0.max()) / max(float(ne0.mean()), 1.0)
+    assert imb0 > BAND, f"fixture not skewed: {imb0:.3f} <= {BAND}"
+    # niter=2, max_sweeps=3: the re-cut the skew forces changes the
+    # stacked shapes, so every extra iteration pays a fresh SPMD
+    # compile wave — this is the smallest config that still drives the
+    # full loop (skew -> decision -> migration/re-cut -> in-band)
+    opts = DistOptions(
+        hsiz=0.32, niter=2, max_sweeps=3, nparts=NPARTS,
+        min_shard_elts=8, hgrad=None, polish_sweeps=0,
+        watchdog_timeout=watchdog, balance_band=BAND,
+    )
+    try:
+        out, comm2, info = adapt_stacked_input(st, comm, opts)
+    except failsafe.PeerLostError as e:
+        print(f"PEER_LOST rank={jax.process_index()}: {e}", flush=True)
+        os._exit(failsafe.PEER_LOST_EXIT_CODE)
+    ne = np.asarray(jax.device_get(out.tmask.sum(axis=1)))
+    imb_final = float(ne.max()) / max(float(ne.mean()), 1.0)
+    merged = merge_adapted(out, comm2)
+    imb = [r["imbalance"] for r in info["history"] if "imbalance" in r]
+    print(f"BAL_TOT {json.dumps(dict(shard_ne=ne.tolist(), merged=int(merged.ntet)))}",
+          flush=True)
+    print(f"BAL_IMB {json.dumps(dict(series=imb, initial=round(imb0, 4), final=round(imb_final, 4)))}",
+          flush=True)
+    print(f"BAL_OK rank={jax.process_index()} "
+          f"status={int(info['status'])}", flush=True)
+    return 0
+
+
+def _spawn_pair(tmp: str, obs: str, timeout: float):
+    """dist_obs_smoke's 2-process launch idiom (2 CPU devices each)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs, logs = [], []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            PYTHONPATH=ROOT,
+            PMMGTPU_COORDINATOR=f"127.0.0.1:{port}",
+            PMMGTPU_NUM_PROCS="2",
+            PMMGTPU_PROC_ID=str(pid),
+            PMMGTPU_TRACE=obs,
+            PMMGTPU_WATCHDOG="120",
+            PYTHONFAULTHANDLER="1",
+        )
+        lp = os.path.join(tmp, f"rank{pid}.log")
+        logs.append(lp)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env, stdout=open(lp, "w"),
+            stderr=subprocess.STDOUT, cwd=ROOT,
+        ))
+    try:
+        rcs = [p.wait(timeout=timeout) for p in procs]
+    finally:
+        for p in procs:
+            p.kill()
+    return rcs, [open(lp).read() for lp in logs]
+
+
+def main() -> int:
+    budget = float(os.environ.get("PARMMG_STAGE_BUDGET_S", "600"))
+    tmp = tempfile.mkdtemp(prefix="parmmg_balance_")
+    obs = os.path.join(tmp, "obs")
+    try:
+        rcs, logs = _spawn_pair(tmp, obs, timeout=budget)
+        if rcs != [0, 0]:
+            for i, log in enumerate(logs):
+                print(f"---- rank{i} log ----\n{log[-4000:]}",
+                      file=sys.stderr)
+            print(f"[balance] worker exits {rcs}", file=sys.stderr)
+            return 1
+        assert all("BAL_OK" in log for log in logs), "no BAL_OK"
+
+        def tagged(tag):
+            line = next(ln for ln in logs[0].splitlines()
+                        if ln.startswith(tag + " "))
+            return json.loads(line[len(tag) + 1:])
+
+        # 2. conservation: migration moved work, it didn't mint any --
+        tot = tagged("BAL_TOT")
+        assert sum(tot["shard_ne"]) == tot["merged"], tot
+
+        # 3. the skewed run ends back inside the band ----------------
+        imb = tagged("BAL_IMB")
+        assert imb["initial"] > BAND, imb
+        assert imb["final"] <= BAND, \
+            f"final imbalance {imb['final']} outside band {BAND}"
+
+        # 4. the decision telemetry landed ---------------------------
+        from parmmg_tpu.obs import dist as obs_dist
+        from parmmg_tpu.obs import report as obs_report
+
+        summary = obs_dist.dist_summary(obs)
+        decisions = summary["work"].get("balance_decisions", [])
+        assert decisions, "no rebalance event in the trace"
+        moved = sum(int(d.get("cells", 0)) for d in decisions)
+        recuts = [d for d in decisions
+                  if d.get("trigger") in ("balance-policy", "grps_ratio",
+                                          "capacity-recut", "graph")]
+        assert moved > 0 or recuts, decisions
+        text = obs_report.render_dist(obs)
+        assert "balance decisions:" in text, "report line missing"
+
+        print(f"[balance] imbalance {imb['initial']:.3f} -> "
+              f"{imb['final']:.3f} (band {BAND}); "
+              f"{len(decisions)} decision(s), {moved} cell(s) moved; "
+              f"tets conserved at {tot['merged']}")
+        print("[balance] skewed-demand loop closed: conservation, "
+              "band re-entry and decision telemetry all verified")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(worker() if "--worker" in sys.argv else main())

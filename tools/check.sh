@@ -120,6 +120,17 @@ rc=$?
 echo "## dist-obs rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
+# load-balancing smoke: a 2-process run seeded with a deliberately
+# SKEWED cut must conserve live tets through the closed-loop
+# balancer's migrations, end with the measured imbalance back inside
+# the band, and leave `rebalance` decision events that render as the
+# "balance decisions" line in obs_report --dist
+timeout -k 10 900 env JAX_PLATFORMS=cpu PARMMG_STAGE_BUDGET_S=750 \
+    python tools/balance_smoke.py
+rc=$?
+echo "## balance rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
 # Pallas-kernel smoke: interpret-mode run of every registered kernel
 # on the tiny fixture with equivalence vs its lax reference, vmap +
 # shard_map dispatch parity, and the PMMGTPU_KERNELS=off driver A/B

@@ -74,14 +74,38 @@ STAGE_JSON=BENCH_xl_run.json run_stage run 15300 \
         --bench-json BENCH_xl_run.json
 run_rc=$?
 
+# multi-process distributed rung (PR 17): the sharded driver with the
+# closed-loop balancer on, through bench.py's deadline-armed worker so
+# a budget death still commits a partial record. The run_dist record
+# carries the converged-sweep parity triple AND the first-class
+# migration/balance cost fields (migrate_cost.cells / payload_bytes /
+# rebalances / wall_s) that the perf gate tracks alongside imbalance.
+STAGE_JSON=BENCH_dist_run.json run_stage dist 5400 \
+    python -c "$(cat <<'PYEOF'
+import json
+import bench
+rec = bench._attempt(
+    dict(dist=True, n=8, hsiz=0.08, nparts=2), 4800
+)
+with open("BENCH_dist_run.json", "w") as f:
+    json.dump(rec, f)
+print(json.dumps(rec))
+raise SystemExit(1 if rec.get("partial") else 0)
+PYEOF
+)"
+dist_rc=$?
+[ "$run_rc" -eq 0 ] && run_rc=$dist_rc
+
 # perf-history gate (PR 8): every rung's committed record — full or
 # partial — is appended to the PERF_DB trajectory and gated against its
 # rolling (platform, rung) baseline; the verdict line per rung is part
 # of the ladder log. A regression does not retro-fail the measurement
 # (the record IS the result) but the typed rc is surfaced.
-if [ -f BENCH_xl_run.json ]; then
-    python tools/perf_gate.py --db PERF_DB.jsonl BENCH_xl_run.json \
-        --update-baseline 1
-    echo "## stage run perf-gate rc=$? (record appended to PERF_DB.jsonl)"
-fi
+for bj in BENCH_xl_run.json BENCH_dist_run.json; do
+    if [ -f "$bj" ]; then
+        python tools/perf_gate.py --db PERF_DB.jsonl "$bj" \
+            --update-baseline 1
+        echo "## stage ${bj%.json} perf-gate rc=$? (record appended to PERF_DB.jsonl)"
+    fi
+done
 exit $run_rc
