@@ -1,0 +1,32 @@
+"""Closed-loop run control: spend the telemetry, don't just report it.
+
+PRs 14-15 built post-mortem explainability — typed verdicts, drain
+curves with ETA-to-empty, churn scores, `len/in_band` on every sweep
+record. This package promotes that telemetry from reporting to
+*control*: a :class:`RunGovernor` rides both drivers' sweep and
+iteration boundaries, early-terminates a run the moment the rolling
+`health.assess` would call it oscillating or stalled (refunding the
+remaining sweep budget instead of burning it), caps the sweep loop at
+the drain-curve ETA, and shortens `niter` when the frontier projects
+drained. Every decision is a `control_decision` tracer event rendered
+by ``obs_report --control`` — control never acts silently.
+
+Off by default: arm with ``PMMGTPU_GOVERN=1`` or
+``AdaptOptions(govern=True)``. The default stays off because an early
+stop legitimately changes the result trajectory, and the tree's
+equivalence gates (frontier on/off, chaos resume bit-identity, kernel
+A/B) compare governor-free arms.
+"""
+
+from .governor import (  # noqa: F401
+    GOVERN_ENV,
+    IN_BAND_SLOPE_MIN,
+    MIN_EVIDENCE_SWEEPS,
+    RunGovernor,
+    resolve_governor,
+)
+
+__all__ = [
+    "GOVERN_ENV", "IN_BAND_SLOPE_MIN", "MIN_EVIDENCE_SWEEPS",
+    "RunGovernor", "resolve_governor",
+]

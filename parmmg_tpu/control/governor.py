@@ -1,0 +1,231 @@
+"""The run governor: verdict-driven early termination and self-tuning
+budgets at sweep/iteration boundaries.
+
+Both drivers call :meth:`RunGovernor.check_sweep` after every operator
+sweep and :meth:`RunGovernor.check_iteration` after every outer
+iteration. The governor judges the SAME rolling-window
+:func:`obs.health.assess` that post-mortem re-assessment uses
+(``GOVERN_WINDOW``), so an in-run stop and a killed-run post-mortem
+can never disagree on identical history rows. Three decision kinds:
+
+- ``early_stop`` — the rolling verdict is ``oscillating`` or
+  ``stalled`` with at least ``MIN_EVIDENCE_SWEEPS`` sweeps of evidence
+  this iteration: the phase stops, the remaining sweep budget is
+  refunded (counter ``control/refunded_sweeps``), and the final
+  ``info["health"]`` carries the typed early-stop verdict. The stop is
+  REFUSED (a ``hold`` decision) while ``len/in_band`` is still
+  improving faster than ``IN_BAND_SLOPE_MIN`` per sweep — control
+  never trades quality it can still see accruing.
+- ``tune_budget`` — the frontier drain curve projects empty in fewer
+  sweeps than the remaining budget: the sweep loop is capped at
+  ETA + ``ETA_MARGIN`` and the difference refunded.
+- ``shorten_niter`` — the frontier projects drained across iterations
+  (a fully-skipped drained phase, or an iteration that performed zero
+  operator work): the remaining outer iterations are dropped. An
+  ``early_stop`` also ends the outer loop — the same metric would
+  re-oscillate next iteration.
+
+Every decision is emitted as a ``control_decision`` tracer event
+(rendered by ``obs_report --control``); nothing here acts silently.
+The governor holds NO device state and reads only the replicated host
+history, so its decisions are identical on every rank of a
+distributed world.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import List, Optional, Sequence
+
+from ..obs import health as obs_health
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+__all__ = [
+    "GOVERN_ENV", "IN_BAND_SLOPE_MIN", "MIN_EVIDENCE_SWEEPS",
+    "ETA_MARGIN", "RunGovernor", "resolve_governor",
+]
+
+# master switch (AdaptOptions.govern=None defers here): "1"/"on" arms
+# the governor, anything else leaves the drivers exactly as before
+GOVERN_ENV = "PMMGTPU_GOVERN"
+
+# an oscillating/stalled verdict is only acted on after this many
+# sweeps of the current iteration — one flat sweep is not evidence
+MIN_EVIDENCE_SWEEPS = 4
+
+# refuse an early stop while in_band improves faster than this per
+# sweep (PMMGTPU_GOVERN_SLOPE overrides): the run is still buying
+# unit-length conformity with its budget
+IN_BAND_SLOPE_MIN = 1e-3
+
+# sweeps kept above the drain ETA when capping the budget — the linear
+# extrapolation is optimistic on convex tails
+ETA_MARGIN = 2
+
+
+def _truthy(val: str) -> bool:
+    return val.strip().lower() not in ("", "0", "off", "false", "no")
+
+
+def resolve_governor(opts) -> Optional["RunGovernor"]:
+    """The driver-side constructor: ``opts.govern`` when set, else the
+    ``PMMGTPU_GOVERN`` env; returns None (no control) when unarmed."""
+    armed = getattr(opts, "govern", None)
+    if armed is None:
+        armed = _truthy(os.environ.get(GOVERN_ENV, ""))
+    if not armed:
+        return None
+    return RunGovernor(
+        converge_frac=float(getattr(opts, "converge_frac", 0.005)),
+    )
+
+
+class RunGovernor:
+    """Closed-loop controller over one driver run. Stateful: it
+    accumulates decisions and refunds so :meth:`finalize` can fold
+    them into the run's final health verdict."""
+
+    def __init__(
+        self,
+        converge_frac: float = 0.005,
+        window: Optional[int] = None,
+        min_slope: Optional[float] = None,
+        min_evidence: int = MIN_EVIDENCE_SWEEPS,
+    ):
+        if window is None:
+            window = int(os.environ.get(
+                "PMMGTPU_GOVERN_WINDOW", obs_health.GOVERN_WINDOW))
+        if min_slope is None:
+            min_slope = float(os.environ.get(
+                "PMMGTPU_GOVERN_SLOPE", IN_BAND_SLOPE_MIN))
+        self.converge_frac = converge_frac
+        self.window = max(int(window), 2)
+        self.min_slope = float(min_slope)
+        self.min_evidence = int(min_evidence)
+        self.refunded = 0
+        self.decisions: List[dict] = []
+        self.stop_info: Optional[dict] = None
+        self._held_iters: set = set()
+
+    # -- decision plumbing --------------------------------------------
+
+    def _decide(self, action: str, **args) -> dict:
+        d = dict(action=action, **args)
+        self.decisions.append(d)
+        obs_trace.emit_event("control_decision", **d)
+        return d
+
+    def _refund(self, n: int) -> None:
+        if n > 0:
+            self.refunded += n
+            obs_metrics.registry().counter(
+                "control/refunded_sweeps").inc(n)
+
+    # -- sweep boundary -----------------------------------------------
+
+    def check_sweep(self, history: Sequence[dict], it: int,
+                    sweep: int, budget: int) -> dict:
+        """Judge the run after sweep `sweep` (0-based) of iteration
+        `it` against the current `budget`. Returns the decision dict;
+        callers break the sweep loop on ``action == "early_stop"`` and
+        adopt ``d["budget"]`` on ``action == "tune_budget"``."""
+        done = sweep + 1
+        tail = [r for r in obs_health.sweep_records(history)
+                if r.get("iter", 0) == it]
+        if len(tail) >= self.min_evidence and done < budget:
+            verdict = obs_health.assess(
+                history, converge_frac=self.converge_frac,
+                max_sweeps=None, window=self.window)
+            if verdict["verdict"] in ("oscillating", "stalled"):
+                slope = obs_health.in_band_slope(
+                    history, window=self.window)
+                if slope is not None and slope > self.min_slope:
+                    # quality still accruing: refuse the stop, once
+                    # per iteration so a long hold doesn't spam
+                    if it not in self._held_iters:
+                        self._held_iters.add(it)
+                        return self._decide(
+                            "hold", it=it, sweep=done,
+                            verdict=verdict["verdict"],
+                            in_band_slope=round(slope, 6),
+                            reason="in_band still improving "
+                                   f"({slope:.2%}/sweep)")
+                    return dict(action=None)
+                refund = budget - done
+                self._refund(refund)
+                self.stop_info = dict(
+                    verdict=verdict["verdict"],
+                    reason=verdict["reason"], it=it, sweep=done,
+                    refunded_sweeps=refund)
+                return self._decide(
+                    "early_stop", it=it, sweep=done,
+                    verdict=verdict["verdict"], refunded=refund,
+                    in_band_slope=None if slope is None
+                    else round(slope, 6),
+                    reason=verdict["reason"])
+        # drain-ETA budget cap: only the current iteration's frontier
+        # telemetry projects this loop's remaining work
+        eta = obs_health.drain_curve(tail)["eta_sweeps"]
+        if eta is not None:
+            cap = done + int(math.ceil(eta)) + ETA_MARGIN
+            if cap < budget:
+                self._refund(budget - cap)
+                return self._decide(
+                    "tune_budget", it=it, sweep=done, budget=cap,
+                    was=budget, eta_sweeps=eta,
+                    reason=f"drain ETA {eta} sweeps caps budget "
+                           f"{budget} -> {cap}")
+        return dict(action=None)
+
+    # -- iteration boundary -------------------------------------------
+
+    def check_iteration(self, history: Sequence[dict], it: int,
+                        niter: int) -> bool:
+        """After iteration `it` (0-based) completed: True ends the
+        outer loop (remaining iterations dropped)."""
+        if it + 1 >= niter:
+            return False
+        if self.stop_info is not None:
+            self._decide(
+                "shorten_niter", it=it, niter=niter,
+                reason="early-stop verdict "
+                       f"'{self.stop_info['verdict']}' ends the run")
+            return True
+        tail = [r for r in obs_health.sweep_records(history)
+                if r.get("iter", 0) == it]
+        if not tail:
+            return False
+        last = tail[-1]
+        drained = last.get("n_active", None) == 0 and last.get("skipped")
+        idle = all(
+            obs_health._ops(r) == 0 and not r.get("nmoved", 0)
+            for r in tail)
+        if drained or idle:
+            self._decide(
+                "shorten_niter", it=it, niter=niter,
+                reason="frontier projects drained"
+                if drained else "iteration performed zero operator "
+                                "work",
+            )
+            return True
+        return False
+
+    # -- run end ------------------------------------------------------
+
+    def finalize(self, verdict: dict) -> dict:
+        """Fold the governor's outcome into the run's final health
+        verdict (the dict that rides ``info["health"]`` and the
+        ``health:verdict`` event)."""
+        if self.stop_info is not None:
+            verdict["verdict"] = self.stop_info["verdict"]
+            verdict["reason"] = (
+                "governor early stop: " + self.stop_info["reason"])
+            verdict["early_stop"] = True
+        verdict["control"] = dict(
+            decisions=len(self.decisions),
+            refunded_sweeps=self.refunded,
+            window=self.window,
+        )
+        return verdict

@@ -839,6 +839,12 @@ _FINGERPRINT_EXCLUDE = frozenset({
     # trigger), a resource-layout knob like nparts: a resume may widen
     # or narrow the band without invalidating the checkpointed mesh
     "balance_band",
+    # govern arms the closed-loop run governor (parmmg_tpu.control) —
+    # a budget/termination controller like niter, which was never
+    # fingerprinted: arming or disarming control on a resume is a
+    # legitimate operator decision, not a different trajectory from
+    # the checkpointed state
+    "govern",
 })
 
 _MESH_DATA_FIELDS = tuple(

@@ -118,6 +118,17 @@ class AdaptOptions:
     # switches off ALL between-iteration resharding). Excluded from the
     # checkpoint fingerprint like other resource-layout knobs.
     balance_band: Optional[float] = None
+    # closed-loop run governor (parmmg_tpu.control): verdict-driven
+    # early termination (oscillating/stalled under the rolling
+    # health.assess window stops the phase and refunds the remaining
+    # sweep budget, unless len/in_band is still improving), drain-ETA
+    # budget capping and drained-frontier niter shortening, each
+    # emitted as a control_decision event (obs_report --control).
+    # None = PMMGTPU_GOVERN env (default off — equivalence gates
+    # compare governor-free arms); True/False force it. Excluded from
+    # the checkpoint fingerprint: arming control on a resume is
+    # legitimate and must not refuse the checkpoint.
+    govern: Optional[bool] = None
     # Pallas kernel subsystem selection (parmmg_tpu.kernels.registry):
     # None leaves the process mode alone (PMMGTPU_KERNELS env, default
     # "auto" = Pallas on TPU / lax elsewhere); "off" = lax references
@@ -1099,6 +1110,7 @@ def run_sweep_loop(
     ensure_fn,
     tcap_fn,
     sweep_fn,
+    governor=None,
 ):
     """Shared sweep-to-convergence engine for the single-shard and
     stacked (distributed) drivers: capacity growth between sweeps,
@@ -1111,6 +1123,12 @@ def run_sweep_loop(
     (state, rec)` runs one sweep and returns host-int stats with keys
     nsplit/ncollapse/nswap/nmoved/ne/np (aggregated over shards where
     applicable) plus n_unique (max) and capped (any).
+
+    `governor` (a control.RunGovernor, or None) gets a control point
+    after every sweep: an `early_stop` decision ends the loop with the
+    remaining budget refunded; a `tune_budget` decision caps the
+    budget at the frontier drain ETA. It reads only replicated host
+    history, so governed distributed shards stay in lockstep.
     """
     tr = obs_trace.get_tracer()
     sweep = 0
@@ -1154,6 +1172,12 @@ def run_sweep_loop(
             and nops <= opts.converge_frac * max(rec["ne"], 1)
         ):
             break
+        if governor is not None:
+            d = governor.check_sweep(history, it, sweep, budget)
+            if d["action"] == "early_stop":
+                break
+            if d["action"] == "tune_budget":
+                budget = d["budget"]
         sweep += 1
     return state
 
@@ -1165,11 +1189,17 @@ def run_batched_sweep_loop(
     history: List[dict],
     it: int,
     hausd: float,
+    governor=None,
 ) -> Mesh:
     """Single-shard sweep engine on top of `remesh_sweeps`: each device
     call runs as many sweeps as it can; the host only intervenes for
     capacity growth / edge-cap overflow, then re-enters. Replaces one
-    dispatch + stats readback PER SWEEP with one per capacity event."""
+    dispatch + stats readback PER SWEEP with one per capacity event.
+
+    An armed `governor` needs host control points, so fused device
+    calls are chunked to its rolling window; per chunk it may
+    early-stop the loop (budget refunded) or cap the budget at the
+    frontier drain ETA."""
     tr = obs_trace.get_tracer()
     budget = opts.max_sweeps
     done = 0
@@ -1177,6 +1207,8 @@ def run_batched_sweep_loop(
     while done < budget:
         mesh = ensure_capacity(mesh, opts)
         ecap = int(mesh.tcap * emult[0]) + 64
+        chunk = budget - done if governor is None \
+            else min(budget - done, governor.window)
         if mesh.tcap > UNFUSED_TCAP:
             # large mesh: one sweep per call, each op its own program
             # (fused whole-program compile takes hours at these shapes)
@@ -1213,7 +1245,7 @@ def run_batched_sweep_loop(
             # report joins with this device_span's measured mean
             obs_costs.capture(
                 "remesh_sweeps", remesh_sweeps,
-                (mesh, jnp.int32(budget - done), ecap, opts.max_sweeps),
+                (mesh, jnp.int32(chunk), ecap, opts.max_sweeps),
                 dict(noinsert=opts.noinsert, noswap=opts.noswap,
                      nomove=opts.nomove, nosurf=opts.nosurf,
                      hausd=hausd, converge_frac=opts.converge_frac,
@@ -1222,7 +1254,7 @@ def run_batched_sweep_loop(
             )
             with tr.device_span("remesh_sweeps", it=it, sweep=done):
                 mesh, hist, n_done = remesh_sweeps(
-                    mesh, jnp.int32(budget - done), ecap, opts.max_sweeps,
+                    mesh, jnp.int32(chunk), ecap, opts.max_sweeps,
                     noinsert=opts.noinsert, noswap=opts.noswap,
                     nomove=opts.nomove, nosurf=opts.nosurf, hausd=hausd,
                     converge_frac=opts.converge_frac,
@@ -1268,6 +1300,12 @@ def run_batched_sweep_loop(
             and nops <= opts.converge_frac * max(last["ne"], 1)
         ):
             break
+        if governor is not None and n > 0:
+            d = governor.check_sweep(history, it, done - 1, budget)
+            if d["action"] == "early_stop":
+                break
+            if d["action"] == "tune_budget":
+                budget = d["budget"]
     return mesh
 
 
@@ -1404,6 +1442,12 @@ def adapt(
             opts = dataclasses.replace(opts, mem_budget_mb=derived)
     fs = failsafe.harness(opts, driver="centralized")
     tr = obs_trace.get_tracer()
+    # closed-loop run governor (off unless opts.govern/PMMGTPU_GOVERN):
+    # lazy import — control is a consumer of the obs layer, not of the
+    # drivers, so this cannot cycle
+    from .. import control as run_control
+
+    gov = run_control.resolve_governor(opts)
     # unique-edge capacity multiplier: ~1.19 edges/tet asymptotically, but
     # pathological meshes can exceed 1.6x — grown on overflow
     emult = [1.6]
@@ -1547,7 +1591,7 @@ def adapt(
 
             def _iteration(m):
                 m = run_batched_sweep_loop(
-                    m, opts, emult, history, it, hausd
+                    m, opts, emult, history, it, hausd, governor=gov
                 )
                 m = fs.fire(it, "remesh", m)
                 fs.validate(m, it, phase="remesh")
@@ -1651,6 +1695,10 @@ def adapt(
                     "exiting for preemption; resume to continue"
                 )
             mesh = fs.post_iteration(it, mesh, history)
+            if gov is not None and gov.check_iteration(
+                    history, it, opts.niter):
+                it += 1
+                break
             it += 1
     finally:
         fs.disarm_preemption()
@@ -1682,6 +1730,8 @@ def adapt(
         history, converge_frac=opts.converge_frac,
         max_sweeps=opts.max_sweeps, status=int(status),
     )
+    if gov is not None:
+        verdict = gov.finalize(verdict)
     obs_health.emit_run_health(
         history, length_doc=len_doc, verdict=verdict,
         driver="centralized", tracer=tr,

@@ -403,7 +403,7 @@ def _frontier_stale_shard(fr: Frontier, m: Mesh, ecap: int) -> bool:
 
 def _remesh_phase_shardlocal(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
-    it: int, hausd, fs=None, fr0=None,
+    it: int, hausd, fs=None, fr0=None, governor=None,
 ):
     """Above-UNFUSED_TCAP remesh phase with SHARD-LOCAL unfused
     dispatch: each process runs the per-op `_sweep_body` (fused=False —
@@ -449,7 +449,8 @@ def _remesh_phase_shardlocal(
             # desyncing the ledger): fall back to the replicated
             # engine. Deterministic: dmesh is identical on every rank.
             return _remesh_phase_local(st, opts, emult, history, it,
-                                       hausd, fr0=fr0)
+                                       hausd, fr0=fr0,
+                                       governor=governor)
     owned = owned_shards(dmesh)
     use_fr = bool(opts.frontier)
     frs: dict = {}
@@ -517,6 +518,7 @@ def _remesh_phase_shardlocal(
         ensure_fn=lambda s: ensure_capacity_stacked(s, opts),
         tcap_fn=lambda s: int(s.tet.shape[1]),
         sweep_fn=sweep_fn,
+        governor=governor,
     )
     if not use_fr:
         return st, None
@@ -538,7 +540,7 @@ def _remesh_phase_shardlocal(
 
 def _remesh_phase_global(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
-    it: int, hausd, fs=None, fr0=None,
+    it: int, hausd, fs=None, fr0=None, governor=None,
 ):
     """Multi-process remesh phase: each sweep is ONE SPMD program over
     the global device mesh — with 2 processes owning 4 devices each, the
@@ -573,7 +575,8 @@ def _remesh_phase_global(
         # gather per sweep (digest-identical to the replicated vmapped
         # engine it replaced — tests/test_m24_balance.py).
         return _remesh_phase_shardlocal(st, opts, emult, history, it,
-                                        hausd, fs=fs, fr0=fr0)
+                                        hausd, fs=fs, fr0=fr0,
+                                        governor=governor)
     dmesh = device_mesh(D)
     use_fr = bool(opts.frontier)
     fr_cell: list = [None]
@@ -634,6 +637,7 @@ def _remesh_phase_global(
         ensure_fn=lambda s: ensure_capacity_stacked(s, opts),
         tcap_fn=lambda s: int(s.tet.shape[1]),
         sweep_fn=sweep_fn,
+        governor=governor,
     )
     if not use_fr:
         return st, None
@@ -650,7 +654,7 @@ def _remesh_phase_global(
 
 def remesh_phase(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
-    it: int, hausd: float = 0.01, fs=None, fr0=None,
+    it: int, hausd: float = 0.01, fs=None, fr0=None, governor=None,
 ):
     """Operator sweeps to convergence on every shard at once (vmapped) —
     the batched analog of the per-group `MMG5_mmg3d1_delone` calls in the
@@ -682,14 +686,14 @@ def remesh_phase(
             return st, fr0
     if _use_spmd_sweeps():
         return _remesh_phase_global(st, opts, emult, history, it, hausd,
-                                    fs=fs, fr0=fr0)
+                                    fs=fs, fr0=fr0, governor=governor)
     return _remesh_phase_local(st, opts, emult, history, it, hausd,
-                               fr0=fr0)
+                               fr0=fr0, governor=governor)
 
 
 def _remesh_phase_local(
     st: Mesh, opts: AdaptOptions, emult: List[float], history: List[dict],
-    it: int, hausd, fr0=None,
+    it: int, hausd, fr0=None, governor=None,
 ):
     """Single-process (vmapped) remesh phase. With `opts.frontier` the
     stacked Frontier is carried across sweeps with HOST-SHARED
@@ -731,6 +735,7 @@ def _remesh_phase_local(
         ensure_fn=lambda s: ensure_capacity_stacked(s, opts),
         tcap_fn=lambda s: int(s.tet.shape[1]),
         sweep_fn=sweep_fn,
+        governor=governor,
     )
     if not use_fr:
         return st, None
@@ -860,11 +865,13 @@ def _resume_stacked(resume, opts: DistOptions):
 
 
 def _finish_dist_info(stacked: Mesh, history: List[dict], h_in, fs,
-                      status, opts: "DistOptions", driver: str) -> dict:
+                      status, opts: "DistOptions", driver: str,
+                      governor=None) -> dict:
     """Common exit bookkeeping of both distributed entry points: the
     world quality histogram, the world edge-length histogram (per-shard
     unique edges merged like `merge_stacked_histograms` — the
-    `PMMG_prilen` world totals), the obs.health termination verdict and
+    `PMMG_prilen` world totals), the obs.health termination verdict
+    (folded with the run governor's outcome when one was armed) and
     its tracer emission. Returns the info dict."""
     h_out = quality.merge_stacked_histograms(
         jax.vmap(quality.quality_histogram)(stacked)
@@ -878,6 +885,8 @@ def _finish_dist_info(stacked: Mesh, history: List[dict], h_in, fs,
         history, converge_frac=opts.converge_frac,
         max_sweeps=opts.max_sweeps, status=int(status),
     )
+    if governor is not None:
+        verdict = governor.finalize(verdict)
     obs_health.emit_run_health(
         history, length_doc=len_doc, verdict=verdict, driver=driver,
     )
@@ -918,6 +927,9 @@ def adapt_distributed(
         kernels_registry.set_mode(opts.kernels)
     nparts = opts.nparts
     fs = failsafe.harness(opts, driver="distributed")
+    from .. import control as run_control
+
+    gov = run_control.resolve_governor(opts)
 
     resume = fs.resume()
     if resume is not None:
@@ -939,10 +951,11 @@ def adapt_distributed(
             icap0=icap0, fs=fs,
             start_it=resume.it + 1, emult0=resume.emult,
             ckpt_meta=dict(qual_in=resume.meta.get("qual_in")),
-            fr0=fr0,
+            fr0=fr0, governor=gov,
         )
         info = _finish_dist_info(
-            stacked, history, h_in, fs, status, opts, "distributed"
+            stacked, history, h_in, fs, status, opts, "distributed",
+            governor=gov,
         )
         return stacked, comm, info
 
@@ -992,9 +1005,11 @@ def adapt_distributed(
     stacked, comm, status = _iteration_loop(
         stacked, opts, hausd, history, fs=fs,
         ckpt_meta=dict(qual_in=failsafe._histo_to_json(h_in)),
+        governor=gov,
     )
     info = _finish_dist_info(
-        stacked, history, h_in, fs, status, opts, "distributed"
+        stacked, history, h_in, fs, status, opts, "distributed",
+        governor=gov,
     )
     return stacked, comm, info
 
@@ -1035,7 +1050,8 @@ def _publish_shard_gauges(st: Mesh) -> None:
 def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                     history: List[dict], icap0: int | None = None,
                     fs=None, start_it: int = 0, emult0: float | None = None,
-                    ckpt_meta: dict | None = None, fr0=None):
+                    ckpt_meta: dict | None = None, fr0=None,
+                    governor=None):
     """The niter remesh/interpolate/rebalance iterations shared by the
     centralized (`adapt_distributed`) and distributed-input
     (`adapt_stacked_input`) entry points — the `PMMG_parmmglib1` body
@@ -1133,7 +1149,7 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
             def _iteration(st, cm, ic, fr):
                 st, cm, ic, fr = _one_iteration(
                     st, opts, hausd, history, it, cm, ic, emult, nparts,
-                    fs=fs, fr=fr, policy=policy,
+                    fs=fs, fr=fr, policy=policy, governor=governor,
                 )
                 fs.validate(st, it, comm=cm, phase="iteration")
                 return st, cm, ic, fr
@@ -1287,6 +1303,10 @@ def _iteration_loop(stacked: Mesh, opts: DistOptions, hausd: float,
                     "exiting for preemption; resume to continue"
                 )
             stacked = fs.post_iteration(it, stacked, history)
+            if governor is not None and governor.check_iteration(
+                    history, it, opts.niter):
+                it += 1
+                break
             it += 1
     finally:
         fs.disarm_preemption()
@@ -1310,7 +1330,7 @@ def _compact_aux_stacked(st: Mesh, changed):
 
 
 def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
-                   nparts, fs=None, fr=None, policy=None):
+                   nparts, fs=None, fr=None, policy=None, governor=None):
     if fs is None:
         from .. import failsafe
 
@@ -1323,7 +1343,8 @@ def _one_iteration(stacked, opts, hausd, history, it, comm, icap, emult,
     obs_health.run_state().update(phase="remesh")
     with tr.span("phase:remesh", it=it):
         stacked, fr = remesh_phase(stacked, opts, emult, history, it,
-                                   hausd, fs=fs, fr0=fr)
+                                   hausd, fs=fs, fr0=fr,
+                                   governor=governor)
         if fr is not None:
             # the frontier carry survives the pack: compact_aux remaps
             # each shard's changed mask through the vertex renumbering
@@ -1654,6 +1675,9 @@ def adapt_stacked_input(
     opts = opts or DistOptions()
     opts = dataclasses.replace(opts, nparts=stacked.vert.shape[0])
     fs = failsafe.harness(opts, driver="distributed-input")
+    from .. import control as run_control
+
+    gov = run_control.resolve_governor(opts)
 
     resume = fs.resume()
     if resume is not None:
@@ -1669,10 +1693,11 @@ def adapt_stacked_input(
             st, opts, hausd, history, icap0=icap0,
             fs=fs, start_it=resume.it + 1, emult0=resume.emult,
             ckpt_meta=dict(qual_in=resume.meta.get("qual_in")),
-            fr0=fr0,
+            fr0=fr0, governor=gov,
         )
         return st, comm, _finish_dist_info(
-            st, history, h_in, fs, status, opts, "distributed-input"
+            st, history, h_in, fs, status, opts, "distributed-input",
+            governor=gov,
         )
 
     # per-shard preprocess: adjacency + analysis + metric, then the
@@ -1717,9 +1742,11 @@ def adapt_stacked_input(
         stacked, opts, hausd, history,
         icap0=comm.icap if comm is not None else None,
         fs=fs, ckpt_meta=dict(qual_in=failsafe._histo_to_json(h_in)),
+        governor=gov,
     )
     info = _finish_dist_info(
-        stacked, history, h_in, fs, status, opts, "distributed-input"
+        stacked, history, h_in, fs, status, opts, "distributed-input",
+        governor=gov,
     )
     return stacked, comm, info
 

@@ -39,7 +39,7 @@ __all__ = [
     "VERDICTS", "assess", "churn_scores", "drain_curve",
     "format_history_rows", "render_length_doc", "emit_run_health",
     "health_summary", "render_health", "run_state", "note_sweep",
-    "history_in_band",
+    "history_in_band", "in_band_slope", "GOVERN_WINDOW",
 ]
 
 VERDICTS = ("converged", "stalled", "oscillating", "budget_exhausted")
@@ -61,6 +61,12 @@ DECAY_RATIO = 0.7
 # history rows shipped in the health:history tracer event are capped so
 # a 10k-sweep run cannot bloat the JSONL; the drop is recorded
 HISTORY_EVENT_CAP = 512
+
+# rolling-window width (sweep records) for IN-RUN verdicts: the live
+# governor and the killed-run re-assessment both judge the same last-N
+# slice, so post-mortem and in-run verdicts can't disagree on
+# identical history rows
+GOVERN_WINDOW = 8
 
 
 def sweep_records(history: Sequence[dict]) -> List[dict]:
@@ -106,6 +112,21 @@ def churn_scores(recs: Sequence[dict]) -> List[float]:
     return out
 
 
+def in_band_slope(history: Sequence[dict],
+                  window: Optional[int] = None) -> Optional[float]:
+    """Per-sweep slope of the unit-band fraction over the last
+    `window` band-carrying sweep records (endpoint difference /
+    span). None when fewer than two sweeps measured a band — callers
+    treat that as "no improvement evidence", not as flat."""
+    recs = sweep_records(history)
+    if window is not None:
+        recs = recs[-window:]
+    bands = [float(r["in_band"]) for r in recs if "in_band" in r]
+    if len(bands) < 2:
+        return None
+    return (bands[-1] - bands[0]) / (len(bands) - 1)
+
+
 def drain_curve(recs: Sequence[dict]) -> dict:
     """Frontier drain telemetry: the active-fraction series and a
     linear-extrapolation ETA (sweeps until the active set reaches zero
@@ -127,6 +148,7 @@ def assess(
     converge_frac: float = 0.005,
     max_sweeps: Optional[int] = None,
     status: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> dict:
     """Fold a driver history into the typed termination verdict.
 
@@ -144,9 +166,16 @@ def assess(
     4. ``stalled`` — everything else: ops neither converged nor
        decaying (includes the forced max_sweeps=1 case, where one
        sweep gives no decay evidence).
+
+    With `window` set, only the last `window` sweep records are
+    judged — the ROLLING form shared by the live run governor and
+    the killed-run re-assessment (GOVERN_WINDOW), so an in-run stop
+    and the post-mortem can never disagree on identical rows.
     """
     recs = sweep_records(history)
     failures = len(history) - len(recs)
+    if window is not None:
+        recs = recs[-window:]
     if not recs:
         return dict(
             verdict="stalled", reason="no operator sweeps recorded",
@@ -154,7 +183,7 @@ def assess(
             in_band_first=None, in_band_last=None,
             churn=dict(scores=[], sustained=False),
             drain=dict(series=[], eta_sweeps=None),
-            status=status,
+            status=status, window=window,
         )
 
     last = recs[-1]
@@ -170,8 +199,8 @@ def assess(
     ) or (last.get("n_active", None) == 0 and last.get("skipped"))
 
     scores = churn_scores(recs)
-    window = scores[-CHURN_WINDOW:]
-    hot = sum(1 for s in window if s >= CHURN_MIN_FRACTION)
+    wscores = scores[-CHURN_WINDOW:]
+    hot = sum(1 for s in wscores if s >= CHURN_MIN_FRACTION)
     sustained = (
         hot >= CHURN_PAIRS
         and _ops(last) > converge_frac * max(int(last.get("ne", 0)), 1)
@@ -193,9 +222,9 @@ def assess(
         )
     elif sustained:
         verdict, reason = "oscillating", (
-            f"{hot}/{len(window)} recent sweep pairs above "
+            f"{hot}/{len(wscores)} recent sweep pairs above "
             f"{CHURN_MIN_FRACTION:.0%} split<->collapse churn "
-            f"(max {max(window):.0%})"
+            f"(max {max(wscores):.0%})"
         )
     elif decaying and budget_hit:
         verdict, reason = "budget_exhausted", (
@@ -217,12 +246,13 @@ def assess(
         in_band_first=bands[0] if bands else None,
         in_band_last=bands[-1] if bands else None,
         churn=dict(
-            scores=[round(s, 4) for s in window],
+            scores=[round(s, 4) for s in wscores],
             max_score=round(max(scores), 4) if scores else 0.0,
             sustained=sustained,
         ),
         drain=drain,
         status=int(status) if status is not None else None,
+        window=window,
     )
 
 
@@ -351,7 +381,11 @@ def health_summary(dirpath: str) -> dict:
             history.append(rec)
     verdict = _last_event(merged, "health:verdict")
     if verdict is None and history:
-        verdict = assess(history)
+        # killed-run re-assessment judges the SAME rolling window as
+        # the live governor — a post-mortem must not call a run
+        # "converged" (full-history view) where the in-run control
+        # loop would have called the same rows "oscillating"
+        verdict = assess(history, window=GOVERN_WINDOW)
         verdict["reassessed"] = True
     length = _last_event(merged, "health:length_histogram")
     return dict(

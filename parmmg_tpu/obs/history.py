@@ -45,7 +45,8 @@ from typing import Dict, List, Optional
 __all__ = [
     "SCHEMA", "REGRESSION_EXIT", "GATE_KEYS", "make_record", "git_sha",
     "append_db", "load_db", "normalize", "infer_rung",
-    "backfill_records", "gate", "GateResult",
+    "backfill_records", "gate", "GateResult", "baseline_records",
+    "quote",
 ]
 
 SCHEMA = "parmmg-perfdb/1"
@@ -339,21 +340,15 @@ class GateResult:
         return out
 
 
-def gate(db: List[dict], rec: dict, window: int = 8,
-         rel_floor: float = 0.5, mad_k: float = 4.0) -> GateResult:
-    """Gate `rec` against its rolling baseline in `db`.
-
-    Baseline = the last `window` non-partial records sharing the
-    candidate's (platform, rung, metric) group — falling back to
-    (platform, metric) when the exact rung has no history, so a renamed
-    rung degrades to a coarser baseline instead of gating nothing. Per
-    gated key the tolerance is ``max(mad_k * 1.4826 * MAD, rel_floor *
-    |median|)`` and only the bad direction regresses. A partial
-    candidate is never gated on its zeroed measurement keys (its
-    partial-ness already exits nonzero at the tool that produced it) —
-    it reports SKIP rows instead."""
-    rec = normalize(rec)
-    key = _group_key(rec)
+def baseline_records(db: List[dict], key: tuple,
+                     window: int = 8) -> List[dict]:
+    """The last `window` non-partial records of the (platform, rung,
+    metric) group `key` — falling back to (platform, metric) when the
+    exact rung has no history, so a renamed rung degrades to a coarser
+    baseline instead of selecting nothing. The SINGLE baseline
+    selection shared by the regression gate and the admission
+    :func:`quote` — the two must never disagree on what "history"
+    means for a group."""
     base = [r for r in db
             if _group_key(r) == key and not r.get("partial")]
     if not base:
@@ -366,7 +361,65 @@ def gate(db: List[dict], rec: dict, window: int = 8,
                 if (r.get("platform"), r.get("metric")) == (key[0], key[2])
                 and str(r.get("rung", "")).endswith("-pk") == pk
                 and not r.get("partial")]
-    base = base[-window:]
+    return base[-window:]
+
+
+def quote(db: List[dict], platform: str, rung: str,
+          window: int = 8) -> Dict[str, dict]:
+    """Rolling-median quote for a (platform, rung) pair from PERF_DB
+    history — the admission-time mirror of :func:`gate`, built on the
+    same :func:`baseline_records` selection (same window, same
+    partial-skip, same rung fallback), so what admission promises is
+    exactly what the gate will hold the run to.
+
+    Returns ``{metric: {"value": median(value), "wall_s":
+    median(wall_s), "n": baseline_n, "unit": ...}}`` per distinct
+    metric recorded under the rung; keys without any numeric history
+    are omitted, and an empty dict means no usable history at all
+    (callers fall back to configured defaults)."""
+    metrics = sorted({r.get("metric") for r in db
+                      if r.get("rung") == rung
+                      and r.get("platform") == platform
+                      and r.get("metric")})
+    if not metrics:
+        # rung fallback mirrors baseline_records: quote every metric
+        # that has (platform, metric) history at matching -pk parity
+        pk = str(rung).endswith("-pk")
+        metrics = sorted({r.get("metric") for r in db
+                          if r.get("platform") == platform
+                          and str(r.get("rung", "")).endswith("-pk") == pk
+                          and r.get("metric")})
+    out: Dict[str, dict] = {}
+    for metric in metrics:
+        base = baseline_records(db, (platform, rung, metric), window)
+        doc: dict = {"n": len(base)}
+        for mkey in ("value", "wall_s", "imbalance", "warmup_s"):
+            vals = [float(r[mkey]) for r in base
+                    if isinstance(r.get(mkey), (int, float))]
+            if vals:
+                doc[mkey] = _median(vals)
+        units = [r.get("unit") for r in base if r.get("unit")]
+        if units:
+            doc["unit"] = units[-1]
+        if len(doc) > 1:
+            out[metric] = doc
+    return out
+
+
+def gate(db: List[dict], rec: dict, window: int = 8,
+         rel_floor: float = 0.5, mad_k: float = 4.0) -> GateResult:
+    """Gate `rec` against its rolling baseline in `db`.
+
+    Baseline = :func:`baseline_records` of the candidate's (platform,
+    rung, metric) group — the selection shared with the admission
+    :func:`quote`. Per gated key the tolerance is ``max(mad_k * 1.4826
+    * MAD, rel_floor * |median|)`` and only the bad direction
+    regresses. A partial candidate is never gated on its zeroed
+    measurement keys (its partial-ness already exits nonzero at the
+    tool that produced it) — it reports SKIP rows instead."""
+    rec = normalize(rec)
+    key = _group_key(rec)
+    base = baseline_records(db, key, window)
     res = GateResult(key, len(base))
     partial = bool(rec.get("partial"))
     for mkey, direction in GATE_KEYS.items():

@@ -48,6 +48,7 @@ __all__ = [
     "rank_timelines", "chaos_summary", "render_chaos",
     "serve_summary", "render_serve", "dist_summary", "render_dist",
     "health_summary", "render_health",
+    "control_summary", "render_control",
 ]
 
 
@@ -453,6 +454,114 @@ def render_serve(dirpath: str) -> str:
     if s["counters"]:
         cnt = "  ".join(
             f"{k[len('serve/'):]} {v}"
+            for k, v in s["counters"].items()
+        )
+        lines.append(f"   counters: {cnt}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def control_summary(dirpath: str) -> dict:
+    """Structured view of the run governor's decision log
+    (``control_decision`` tracer events, `parmmg_tpu.control`). The
+    governor reads only replicated host history, so its decisions are
+    identical on every rank — the summary takes the lowest rank that
+    carries any, rather than multiplying replicas into the rollup.
+    Folds in the final ``health:verdict`` control block (refund total,
+    window, early-stop flag) and the merged ``control/*`` counters."""
+    timelines = rank_timelines(dirpath)
+    decisions: List[dict] = []
+    source_rank: Optional[int] = None
+    for rank in sorted(timelines):
+        recs = [r for r in timelines[rank]
+                if r.get("type") == "event"
+                and r.get("name") == "control_decision"]
+        if recs:
+            source_rank = rank
+            decisions = [dict(ts_us=r.get("ts_us", 0),
+                              **r.get("args", {})) for r in recs]
+            break
+    by_action: Dict[str, int] = {}
+    refunded = 0
+    for d in decisions:
+        act = d.get("action", "?")
+        by_action[act] = by_action.get(act, 0) + 1
+        if act == "early_stop":
+            refunded += int(d.get("refunded", 0) or 0)
+        elif act == "tune_budget":
+            refunded += int(d.get("was", 0) or 0) - int(
+                d.get("budget", 0) or 0)
+    verdict: Optional[dict] = None
+    for rank in sorted(timelines):
+        for r in reversed(timelines[rank]):
+            if (r.get("type") == "event"
+                    and r.get("name") == "health:verdict"):
+                verdict = r.get("args", {})
+                break
+        if verdict is not None:
+            break
+    counters = ((metrics_mod.merge_dir(dirpath) or {})
+                .get("counters", {}))
+    return dict(
+        dir=dirpath,
+        rank=source_rank,
+        decisions=decisions,
+        by_action=by_action,
+        refunded_sweeps=refunded,
+        verdict=verdict,
+        counters={k: v for k, v in sorted(counters.items())
+                  if k.startswith("control/")},
+    )
+
+
+def render_control(dirpath: str) -> str:
+    """Human-readable governor log: one line per control decision in
+    happened order (hold / early_stop / tune_budget / shorten_niter
+    with its reason), then the refund and final-verdict rollup."""
+    s = control_summary(dirpath)
+    lines = [f"== control decisions: {s['dir']} =="]
+    if not s["decisions"]:
+        lines.append("   (no control_decision events found — "
+                     "governor unarmed or run predates it)")
+    for d in s["decisions"]:
+        bits = []
+        if d.get("it") is not None:
+            bits.append(f"iter {d['it']}")
+        if d.get("sweep") is not None:
+            bits.append(f"sweep {d['sweep']}")
+        if d.get("action") == "early_stop":
+            bits.append(f"verdict {d.get('verdict')}")
+            bits.append(f"refunded {d.get('refunded')} sweep(s)")
+        elif d.get("action") == "tune_budget":
+            bits.append(f"budget {d.get('was')} -> {d.get('budget')}")
+        elif d.get("action") == "hold":
+            bits.append(f"verdict {d.get('verdict')} held")
+        lines.append(
+            f"   [{d.get('ts_us', 0) / 1e6:9.3f}s] "
+            f"{d.get('action', '?'):13s} {', '.join(bits)}"
+        )
+        if d.get("reason"):
+            lines.append(f"{'':16s}{d['reason']}")
+    lines.append("")
+    lines.append("-- rollup --")
+    acts = "  ".join(f"{k} {v}"
+                     for k, v in sorted(s["by_action"].items()))
+    lines.append(
+        f"   decisions {len(s['decisions'])}: {acts or '(none)'}")
+    lines.append(f"   refunded sweeps: {s['refunded_sweeps']}")
+    v = s.get("verdict")
+    if v is not None:
+        ctl = v.get("control") or {}
+        lines.append(
+            f"   final verdict: {v.get('verdict')} "
+            f"(early_stop={bool(v.get('early_stop'))}, "
+            f"window={ctl.get('window')})"
+        )
+        if v.get("reason"):
+            lines.append(f"     {v['reason']}")
+    if s["counters"]:
+        cnt = "  ".join(
+            f"{k[len('control/'):]} {v}"
             for k, v in s["counters"].items()
         )
         lines.append(f"   counters: {cnt}")

@@ -19,6 +19,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import metric as metric_mod
 from ..core.mesh import Mesh
@@ -187,8 +188,12 @@ class LengthStats:
 
 # bin edges for the length histogram — the reference's exact bounds
 # (`bd[9]` at `src/quality_pmmg.c:387`: 0, .3, .6, 1/sqrt2, .9, 1.3,
-# sqrt2, 2, 5), so "identical histogram" comparisons are well-defined
-_LEN_EDGES = jnp.array(
+# sqrt2, 2, 5), so "identical histogram" comparisons are well-defined.
+# Kept a HOST numpy constant: a module-level jnp.array would capture a
+# tracer if this module is first imported while a jit trace is active
+# (lazy import from inside a traced caller), leaking it to every later
+# use — the UnexpectedTracerError class of failure
+_LEN_EDGES = np.array(
     [0.0, 0.3, 0.6, float(metric_mod.LSHRT), 0.9, 1.3,
      float(metric_mod.LLONG), 2.0, 5.0]
 )

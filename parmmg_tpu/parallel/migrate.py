@@ -87,17 +87,80 @@ def stacked_halo_max(vals: jax.Array, comm: ShardComm) -> jax.Array:
 # grps_ratio escape hatch but far enough from 1.0 not to thrash)
 BALANCE_BAND_DEFAULT = 1.5
 
+# PERF_DB to derive the band from when neither the option nor the env
+# band is set (the same file the perf gate and SLO admission read)
+BALANCE_DB_ENV = "PMMGTPU_PERF_DB"
+
+# history-derived bands are clamped here: never tighter than 1.2 (a
+# band hugging 1.0 thrashes on noise) and never looser than the
+# GRPS_RATIO-adjacent default's reasoning allows
+_BAND_CLAMP = (1.2, 2.0)
+
+# (db path, platform) -> derived band or None; resolve_balance_band is
+# called once per iteration, the db only changes between runs
+_BAND_CACHE: dict = {}
+
+
+def _band_from_history() -> Optional[float]:
+    """Data-derived work-imbalance band: the rolling-median measured
+    ``imbalance`` of the PERF_DB's ``dist-*`` rungs (the same
+    :func:`obs.history.quote` API SLO admission uses), held 25% above
+    the steady state so the loop fires on drift, not on the imbalance
+    the runs historically settle at. None when no PERF_DB is named
+    (``PMMGTPU_PERF_DB``) or its dist records carry no imbalance —
+    callers fall back to :data:`BALANCE_BAND_DEFAULT`."""
+    path = os.environ.get(BALANCE_DB_ENV, "")
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        platform = jax.default_backend()
+    except Exception:  # backend probe must never break balancing
+        platform = "cpu"
+    key = (path, platform)
+    if key in _BAND_CACHE:
+        return _BAND_CACHE[key]
+    band: Optional[float] = None
+    try:
+        from ..obs import history as history_mod
+        db = history_mod.load_db(path)
+        vals = []
+        for rung in sorted({str(r.get("rung", "")) for r in db
+                            if str(r.get("rung", "")).startswith("dist-")}):
+            q = history_mod.quote(db, platform, rung)
+            # quote keys by metric; the imbalance median rides each
+            # metric's doc when the rung's records measured it
+            for doc in q.values():
+                if doc.get("imbalance"):
+                    vals.append(float(doc["imbalance"]))
+        if vals:
+            vals.sort()
+            steady = vals[len(vals) // 2]
+            if steady > 0:
+                band = min(max(1.25 * steady, _BAND_CLAMP[0]),
+                           _BAND_CLAMP[1])
+    except Exception:  # an unreadable db is a fallback, not a crash
+        band = None
+    _BAND_CACHE[key] = band
+    return band
+
 
 def resolve_balance_band(opts) -> Optional[float]:
     """Effective work-imbalance band: `opts.balance_band` when set,
-    else the PMMGTPU_BALANCE_BAND env contract, else the conservative
-    default. A band <= 0 (the `-nobalance`-style A/B escape hatch for
-    the policy alone) disables the closed loop — interface displacement
-    and the GRPS_RATIO guard are untouched either way."""
+    else the PMMGTPU_BALANCE_BAND env contract, else the PERF_DB
+    history quote (:func:`_band_from_history`, armed by naming a db in
+    ``PMMGTPU_PERF_DB``), else the conservative default. A band <= 0
+    (the `-nobalance`-style A/B escape hatch for the policy alone)
+    disables the closed loop — interface displacement and the
+    GRPS_RATIO guard are untouched either way."""
     band = getattr(opts, "balance_band", None)
     if band is None:
         env = os.environ.get("PMMGTPU_BALANCE_BAND")
-        band = float(env) if env else BALANCE_BAND_DEFAULT
+        if env:
+            band = float(env)
+        else:
+            band = _band_from_history()
+            if band is None:
+                band = BALANCE_BAND_DEFAULT
     band = float(band)
     return band if band > 0 else None
 

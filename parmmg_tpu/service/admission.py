@@ -39,7 +39,13 @@ import re
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from .jobs import BadJobError, JobSpec, JobTooLargeError, QueueFullError
+from .jobs import (
+    BadJobError,
+    JobSpec,
+    JobTooLargeError,
+    QueueFullError,
+    SloInfeasibleError,
+)
 
 # --- size classes ----------------------------------------------------------
 
@@ -165,6 +171,130 @@ def peek_counts(path: str) -> Tuple[int, int]:
         f"unknown mesh format {ext!r} for {path} (expected .mesh/"
         ".meshb/.vtu)", path=path, ext=ext,
     )
+
+
+# --- SLO admission from PERF_DB history ------------------------------------
+
+#: deadline = quote × margin when the client did not set one — derived
+#: from DATA, not config (PMMGTPU_SLO_MARGIN overrides; 4x leaves room
+#: for queueing plus the usual container wall-clock swing the serve
+#: bench gates with --rel-floor 8)
+SLO_MARGIN_ENV = "PMMGTPU_SLO_MARGIN"
+SLO_MARGIN_DEFAULT = 4.0
+
+
+def resolve_slo_margin(margin: Optional[float] = None) -> float:
+    """Explicit margin, else PMMGTPU_SLO_MARGIN, else the default."""
+    if margin is not None:
+        return float(margin)
+    raw = os.environ.get(SLO_MARGIN_ENV, "").strip()
+    return float(raw) if raw else SLO_MARGIN_DEFAULT
+
+
+def _default_platform() -> str:
+    """The platform key quotes are looked up under — the same stamp
+    the serve bench writes into its PERF_DB records
+    (PMMGTPU_SLO_PLATFORM overrides for cross-platform quoting)."""
+    env = os.environ.get("PMMGTPU_SLO_PLATFORM", "").strip()
+    if env:
+        return env
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+class SloPolicy:
+    """Per-size-class latency quotes from PERF_DB rung history, and the
+    admission decision they drive.
+
+    The serve bench commits ``jobs_per_min`` records under rung
+    ``serve-<class>``; :func:`obs.history.quote` folds them with the
+    SAME rolling-median/partial-skip baseline selection the perf gate
+    uses, so the latency a client is promised at submit is exactly the
+    history the gate holds the server to. Two decisions per job:
+
+    - an explicit ``deadline_s`` below the quoted latency is refused
+      typed (:class:`SloInfeasibleError`) at submit — better a refusal
+      in milliseconds than a mid-run deadline after burning
+      batch-mates' machine time;
+    - a job WITHOUT a deadline gets ``quote × margin`` (plus the
+      rung's recorded warmup as a cold-start allowance) as its
+      data-derived default, so every admitted job runs under a
+      deadline the measured history says is feasible.
+
+    A class with no usable history quotes ``None`` and admission
+    passes through unchanged — the policy arms itself as records
+    accumulate, exactly like the perf gate."""
+
+    def __init__(self, db, platform: Optional[str] = None,
+                 margin: Optional[float] = None, window: int = 8):
+        from ..obs import history as history_mod
+
+        self._history = history_mod
+        if isinstance(db, (str, os.PathLike)):
+            self.records: List[dict] = history_mod.load_db(str(db))
+        else:
+            self.records = list(db or [])
+        self.platform = platform or _default_platform()
+        self.margin = resolve_slo_margin(margin)
+        self.window = int(window)
+
+    def quote(self, class_name: str) -> Optional[dict]:
+        """Rolling-median latency quote for one size class, or None
+        when the rung has no non-partial throughput history."""
+        q = self._history.quote(
+            self.records, self.platform, f"serve-{class_name}",
+            window=self.window,
+        )
+        jm = q.get("jobs_per_min")
+        if not jm or not jm.get("value"):
+            return None
+        latency_s = 60.0 / float(jm["value"])
+        doc = dict(
+            latency_s=round(latency_s, 3),
+            jobs_per_min=round(float(jm["value"]), 3),
+            baseline_n=int(jm["n"]),
+            rung=f"serve-{class_name}", platform=self.platform,
+        )
+        if jm.get("wall_s") is not None:
+            doc["wall_s"] = round(float(jm["wall_s"]), 3)
+        if jm.get("warmup_s") is not None:
+            doc["warmup_s"] = round(float(jm["warmup_s"]), 3)
+        return doc
+
+    def admit(self, spec: JobSpec, class_name: str) -> JobSpec:
+        """Apply the SLO decision to an about-to-be-queued job:
+        returns the spec (deadline defaulted from data when unset) or
+        raises the typed refusal."""
+        q = self.quote(class_name)
+        if q is None:
+            return spec
+        if spec.deadline_s is not None:
+            if float(spec.deadline_s) < q["latency_s"]:
+                raise SloInfeasibleError(
+                    f"job {spec.job_id}: deadline {spec.deadline_s}s is "
+                    f"below the quoted '{class_name}' latency "
+                    f"{q['latency_s']}s (rolling median of "
+                    f"{q['baseline_n']} PERF_DB record(s)) — the run "
+                    "would deadline mid-flight",
+                    deadline_s=float(spec.deadline_s),
+                    quoted_s=q["latency_s"],
+                    baseline_n=q["baseline_n"],
+                    size_class=class_name, platform=self.platform,
+                )
+            return spec
+        # the quote is WARMED-executable throughput; a job that lands on
+        # a cold class (solo runs, a restarted server replaying its
+        # journal before warmup) pays the full compile first, so the
+        # derived default adds the recorded warmup as a cold-start
+        # allowance — explicit deadlines are still judged against the
+        # raw latency, which is infeasible even warm
+        derived = round(q["latency_s"] * self.margin
+                        + q.get("warmup_s", 0.0), 3)
+        return dataclasses.replace(spec, deadline_s=derived)
 
 
 # --- the bounded queue -----------------------------------------------------

@@ -106,6 +106,20 @@ class JobTooLargeError(ServiceRefusal):
     transient = False
 
 
+class SloInfeasibleError(ServiceRefusal):
+    """The job's deadline is below what PERF_DB history says this size
+    class costs (the admission quote, `service.admission.SloPolicy`):
+    the run would deadline mid-flight after burning its batch-mates'
+    machine time, so it is refused AT SUBMIT instead. Permanent for
+    this (deadline, size-class) pair — resubmit with a feasible
+    deadline or a coarser target. Payload carries the quoted latency,
+    the deadline asked for, and the baseline depth the quote came
+    from."""
+
+    code = "slo-infeasible"
+    transient = False
+
+
 class BadJobError(ServiceRefusal):
     """The job's input could not be read/parsed (missing file, unknown
     format, corrupt header). Permanent — ``rejected``."""

@@ -118,13 +118,22 @@ class JobServer:
                  queue_cap: int = 16,
                  batch_max: int = 4,
                  margin: float = 2.0,
-                 base_options=None):
+                 base_options=None,
+                 slo=None):
         self.journal = JobJournal(store)
         self.classes = tuple(classes)
         self.queue = AdmissionQueue(queue_cap)
         self.batch_max = int(batch_max)
         self.margin = float(margin)
         self._base_options = base_options
+        # SLO admission from history: an admission.SloPolicy, or a
+        # PERF_DB path to build one from (None = no SLO enforcement —
+        # the pre-quote behavior)
+        if slo is not None and not hasattr(slo, "admit"):
+            from .admission import SloPolicy
+
+            slo = SloPolicy(slo)
+        self.slo = slo
         self._draining = False
         self._cancel_requested: set = set()
         self._running_id: Optional[str] = None
@@ -175,6 +184,11 @@ class JobServer:
         try:
             npoin, ntet = peek_counts(spec.inmesh)
             cls = classify(npoin, ntet, self.classes, self.margin)
+            if self.slo is not None:
+                # quote-infeasible deadlines are refused HERE (typed,
+                # permanent → journaled rejected below); deadline-less
+                # jobs leave with the data-derived default attached
+                spec = self.slo.admit(spec, cls.name)
         except ServiceRefusal as err:
             code = f"serve/refused_{err.code.replace('-', '_')}"
             reg.counter(code).inc()
@@ -202,6 +216,7 @@ class JobServer:
         obs_trace.emit_event(
             "job_submitted", job_id=spec.job_id, tenant=spec.tenant,
             size_class=cls.name, npoin=npoin, ntet=ntet,
+            deadline_s=spec.deadline_s,
         )
         return rec
 

@@ -1,0 +1,432 @@
+"""M25: the closed-loop run governor and PERF_DB-quoted SLO admission.
+
+Coverage of `parmmg_tpu/control/` + the quote API + admission:
+
+- `obs.history.quote` shares the EXACT baseline selection of the perf
+  gate (`baseline_records`): rolling window, partial-record skip,
+  rung fallback at matching ``-pk`` parity — admission can never
+  promise a latency the gate would not hold the server to;
+- empty-history fallbacks: `quote` -> {}, `SloPolicy.quote` -> None,
+  admission passes specs through unchanged (the policy arms itself as
+  records accumulate);
+- the admission decision matrix: infeasible explicit deadlines refused
+  typed (`SloInfeasibleError`, journaled ``rejected`` through the
+  server) and deadline-less jobs stamped with the data-derived
+  ``quote x margin`` default;
+- `RunGovernor` decision semantics on synthetic histories: the
+  evidence floor, the in_band slope guard (hold, once per iteration),
+  early-stop refund accounting (state + counter), drain-ETA budget
+  tuning, drained/idle iteration shortening, and `finalize` folding
+  the stop into the run verdict;
+- the live governor and the killed-run post-mortem judge the SAME
+  rolling window (`assess(window=GOVERN_WINDOW)`);
+- the history-quoted balance band (`parallel.migrate`): derived from
+  the median measured dist imbalance when a PERF_DB is named, else
+  the 1.5 default.
+"""
+
+import json
+import os
+
+import pytest
+
+from parmmg_tpu import control
+from parmmg_tpu.obs import health, history
+from parmmg_tpu.obs import metrics as obs_metrics
+from parmmg_tpu.service.admission import SloPolicy, resolve_slo_margin
+from parmmg_tpu.service.jobs import JobSpec, SloInfeasibleError
+
+
+def _rec(it, sw, nsplit=0, ncollapse=0, nswap=0, ne=1000,
+         n_unique=500, n_active=100, capped=False, **kw):
+    r = dict(iter=it, sweep=sw, nsplit=nsplit, ncollapse=ncollapse,
+             nswap=nswap, nmoved=0, ne=ne, np=300, n_unique=n_unique,
+             n_active=n_active, capped=capped)
+    r.update(kw)
+    return r
+
+
+def _churn_tail(it=0, n=6, in_band=0.5, start=0):
+    """n sweeps of sustained split<->collapse thrash (oscillating
+    under the rolling assess) at a FLAT in_band."""
+    out = []
+    for k in range(n):
+        big, small = (100, 5) if k % 2 == 0 else (8, 95)
+        out.append(_rec(it, start + k, nsplit=big, ncollapse=small,
+                        in_band=in_band))
+    return out
+
+
+def _db_rec(rung, metric, value, platform="cpu", **kw):
+    r = dict(rung=rung, metric=metric, value=value, platform=platform)
+    r.update(kw)
+    return r
+
+
+# ---------------------------------------------------------------------------
+# quote: the gate's baseline selection, verbatim
+# ---------------------------------------------------------------------------
+
+
+def test_quote_rolling_median_shares_gate_selection():
+    db = [_db_rec("serve-tiny", "jobs_per_min", 100.0 + i,
+                  run_id=f"r{i}", wall_s=3.0 + i)
+          for i in range(12)]
+    q = history.quote(db, "cpu", "serve-tiny", window=8)
+    jm = q["jobs_per_min"]
+    # only the LAST 8 records quote — same [-window:] the gate gates on
+    assert jm["n"] == 8
+    assert jm["value"] == pytest.approx(
+        history._median([104.0 + i for i in range(8)]))
+    base = history.baseline_records(
+        db, ("cpu", "serve-tiny", "jobs_per_min"), window=8)
+    assert [r["value"] for r in base] == [104.0 + i for i in range(8)]
+
+
+def test_quote_skips_partial_records_like_the_gate():
+    db = [
+        _db_rec("serve-tiny", "jobs_per_min", 100.0),
+        _db_rec("serve-tiny", "jobs_per_min", 9999.0, partial=True),
+        _db_rec("serve-tiny", "jobs_per_min", 110.0),
+    ]
+    q = history.quote(db, "cpu", "serve-tiny")
+    assert q["jobs_per_min"]["n"] == 2
+    assert q["jobs_per_min"]["value"] == pytest.approx(105.0)
+
+
+def test_quote_rung_fallback_honors_pk_parity():
+    db = [
+        _db_rec("n6-hsiz0.15", "tets_per_sec", 1000.0),
+        _db_rec("n6-hsiz0.15-pk", "tets_per_sec", 5000.0),
+    ]
+    # unknown non-pk rung degrades to the non-pk (platform, metric)
+    # history — never to the Pallas-kernel baseline
+    q = history.quote(db, "cpu", "n8-hsiz0.10")
+    assert q["tets_per_sec"]["value"] == pytest.approx(1000.0)
+    qpk = history.quote(db, "cpu", "n8-hsiz0.10-pk")
+    assert qpk["tets_per_sec"]["value"] == pytest.approx(5000.0)
+
+
+def test_quote_empty_history_returns_empty_dict():
+    assert history.quote([], "cpu", "serve-tiny") == {}
+    # wrong platform is no history either
+    db = [_db_rec("serve-tiny", "jobs_per_min", 100.0, platform="tpu")]
+    assert history.quote(db, "cpu", "serve-tiny") == {}
+
+
+# ---------------------------------------------------------------------------
+# SloPolicy: quotes -> admission decisions
+# ---------------------------------------------------------------------------
+
+
+def test_slo_policy_quote_and_derived_deadline():
+    db = [_db_rec("serve-t", "jobs_per_min", v, wall_s=3.0)
+          for v in (140.0, 150.0, 145.0)]
+    pol = SloPolicy(db, platform="cpu", margin=4.0)
+    q = pol.quote("t")
+    assert q["baseline_n"] == 3
+    assert q["latency_s"] == pytest.approx(60.0 / 145.0, abs=1e-3)
+    spec = pol.admit(JobSpec(job_id="a", inmesh="x.mesh"), "t")
+    assert spec.deadline_s == pytest.approx(q["latency_s"] * 4.0,
+                                            abs=1e-3)
+
+
+def test_slo_derived_deadline_adds_cold_start_allowance():
+    # the quote is WARMED throughput — a recorded warmup_s must ride
+    # the derived default so a cold class (solo run, post-restart
+    # replay before warmup) doesn't kill deadline-less jobs on its
+    # own stamp; the explicit-deadline refusal threshold stays the
+    # raw latency (infeasible even warm)
+    db = [_db_rec("serve-t", "jobs_per_min", 60.0, warmup_s=50.0)]
+    pol = SloPolicy(db, platform="cpu", margin=4.0)
+    q = pol.quote("t")
+    assert q["warmup_s"] == pytest.approx(50.0)
+    spec = pol.admit(JobSpec(job_id="a", inmesh="x.mesh"), "t")
+    assert spec.deadline_s == pytest.approx(1.0 * 4.0 + 50.0, abs=1e-3)
+    with pytest.raises(SloInfeasibleError):
+        pol.admit(JobSpec(job_id="b", inmesh="x.mesh",
+                          deadline_s=0.5), "t")
+
+
+def test_slo_policy_refuses_infeasible_deadline_typed():
+    db = [_db_rec("serve-t", "jobs_per_min", 60.0)]  # 1 s/job quote
+    pol = SloPolicy(db, platform="cpu", margin=4.0)
+    with pytest.raises(SloInfeasibleError) as ei:
+        pol.admit(JobSpec(job_id="a", inmesh="x.mesh",
+                          deadline_s=0.25), "t")
+    err = ei.value
+    assert err.code == "slo-infeasible" and not err.transient
+    doc = err.doc()
+    assert doc["quoted_s"] == pytest.approx(1.0)
+    assert doc["deadline_s"] == 0.25
+    assert doc["size_class"] == "t" and doc["baseline_n"] == 1
+    # a feasible explicit deadline passes through untouched
+    ok = pol.admit(JobSpec(job_id="b", inmesh="x.mesh",
+                           deadline_s=30.0), "t")
+    assert ok.deadline_s == 30.0
+
+
+def test_slo_policy_no_history_passes_through():
+    pol = SloPolicy([], platform="cpu")
+    assert pol.quote("t") is None
+    spec = JobSpec(job_id="a", inmesh="x.mesh", deadline_s=0.001)
+    assert pol.admit(spec, "t") is spec
+
+
+def test_slo_margin_env_override(monkeypatch):
+    monkeypatch.delenv("PMMGTPU_SLO_MARGIN", raising=False)
+    assert resolve_slo_margin() == 4.0
+    monkeypatch.setenv("PMMGTPU_SLO_MARGIN", "2.5")
+    assert resolve_slo_margin() == 2.5
+    assert resolve_slo_margin(6.0) == 6.0
+
+
+def test_server_submit_journals_slo_refusal(tmp_path):
+    from parmmg_tpu.io import ckpt_store, medit
+    from parmmg_tpu.service import JobServer, SizeClass
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    obs_metrics.registry().reset()
+    tiny = SizeClass("t", pcap=256, tcap=1024, fcap=256, ecap=256)
+    ckpt_store.memory_bucket("m25-slo").clear()
+    db = [_db_rec("serve-t", "jobs_per_min", 60.0)]
+    srv = JobServer(ckpt_store.make_store("mem://m25-slo", None),
+                    classes=(tiny,),
+                    slo=SloPolicy(db, platform="cpu", margin=4.0))
+    inmesh = str(tmp_path / "cube.mesh")
+    medit.save_mesh(unit_cube_mesh(2), inmesh)
+    with pytest.raises(SloInfeasibleError):
+        srv.submit(JobSpec(job_id="bad", inmesh=inmesh,
+                           deadline_s=0.01))
+    doc = srv.journal.load("bad")
+    assert doc["state"] == "rejected"
+    assert doc["error"]["code"] == "slo-infeasible"
+    c = obs_metrics.registry().counter("serve/refused_slo_infeasible")
+    assert c.value == 1
+    # the deadline-less job is admitted with the derived default
+    rec = srv.submit(JobSpec(job_id="ok", inmesh=inmesh))
+    assert rec["spec"]["deadline_s"] == pytest.approx(4.0, abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# RunGovernor: decisions on synthetic histories
+# ---------------------------------------------------------------------------
+
+
+def _governor(**kw):
+    kw.setdefault("window", health.GOVERN_WINDOW)
+    kw.setdefault("min_slope", control.IN_BAND_SLOPE_MIN)
+    return control.RunGovernor(**kw)
+
+
+def test_governor_needs_evidence_before_stopping():
+    gov = _governor()
+    hist = _churn_tail(n=3)
+    d = gov.check_sweep(hist, it=0, sweep=2, budget=30)
+    assert d["action"] is None and gov.stop_info is None
+
+
+def test_governor_early_stops_oscillation_with_refund():
+    obs_metrics.registry().reset()
+    gov = _governor()
+    hist = _churn_tail(n=6)
+    d = gov.check_sweep(hist, it=0, sweep=5, budget=30)
+    assert d["action"] == "early_stop"
+    assert d["verdict"] == "oscillating"
+    assert d["refunded"] == 30 - 6
+    assert gov.refunded == 24
+    assert gov.stop_info["verdict"] == "oscillating"
+    c = obs_metrics.registry().counter("control/refunded_sweeps")
+    assert c.value == 24
+
+
+def test_governor_slope_guard_holds_improving_run():
+    gov = _governor()
+    # same churn, but in_band still climbing 5%/sweep: REFUSE the stop
+    hist = [dict(r, in_band=0.3 + 0.05 * k)
+            for k, r in enumerate(_churn_tail(n=6))]
+    d = gov.check_sweep(hist, it=0, sweep=5, budget=30)
+    assert d["action"] == "hold"
+    assert gov.stop_info is None and gov.refunded == 0
+    # the hold is emitted once per iteration, then goes quiet
+    d2 = gov.check_sweep(hist, it=0, sweep=5, budget=30)
+    assert d2["action"] is None
+    assert [x["action"] for x in gov.decisions] == ["hold"]
+
+
+def test_governor_never_stops_healthy_decay():
+    gov = _governor()
+    # cleanly decaying ops: the rolling verdict is budget_exhausted
+    # (never oscillating/stalled), so no stop can fire
+    hist = [_rec(0, k, nsplit=max(400 - 120 * k, 1), n_active=0,
+                 in_band=0.5)
+            for k in range(6)]
+    d = gov.check_sweep(hist, it=0, sweep=5, budget=30)
+    assert d["action"] != "early_stop"
+    assert gov.stop_info is None
+
+
+def test_governor_tunes_budget_from_drain_eta():
+    obs_metrics.registry().reset()
+    gov = _governor()
+    # frontier draining linearly: 0.8 -> 0.2 projects empty in ~1 sweep
+    hist = [_rec(0, k, nsplit=300 - 60 * k,
+                 n_active=400 - 100 * k, in_band=0.5)
+            for k in range(4)]
+    d = gov.check_sweep(hist, it=0, sweep=3, budget=30)
+    assert d["action"] == "tune_budget"
+    assert d["budget"] < 30 and d["budget"] >= 4
+    assert gov.refunded == 30 - d["budget"]
+
+
+def test_governor_iteration_shortens_after_stop_and_on_drain():
+    gov = _governor()
+    gov.stop_info = dict(verdict="oscillating", reason="x", it=0,
+                         sweep=6, refunded_sweeps=10)
+    assert gov.check_iteration([], it=0, niter=3) is True
+    assert gov.decisions[-1]["action"] == "shorten_niter"
+
+    gov2 = _governor()
+    drained = [_rec(1, 0, nsplit=5, n_active=100),
+               _rec(1, 1, n_active=0, skipped=True)]
+    assert gov2.check_iteration(drained, it=1, niter=3) is True
+
+    gov3 = _governor()
+    idle = [_rec(0, 0), _rec(0, 1)]
+    assert gov3.check_iteration(idle, it=0, niter=3) is True
+
+    # the LAST iteration never needs shortening
+    gov4 = _governor()
+    gov4.stop_info = gov.stop_info
+    assert gov4.check_iteration([], it=2, niter=3) is False
+
+    # active work continues
+    gov5 = _governor()
+    busy = [_rec(0, 0, nsplit=50, n_active=200)]
+    assert gov5.check_iteration(busy, it=0, niter=3) is False
+
+
+def test_governor_finalize_folds_stop_into_verdict():
+    gov = _governor()
+    hist = _churn_tail(n=6)
+    gov.check_sweep(hist, it=0, sweep=5, budget=30)
+    v = gov.finalize(dict(verdict="budget_exhausted", reason="budget"))
+    assert v["verdict"] == "oscillating"
+    assert v["reason"].startswith("governor early stop:")
+    assert v["early_stop"] is True
+    assert v["control"]["refunded_sweeps"] == 24
+    assert v["control"]["window"] == gov.window
+    # no stop: the verdict passes through, control block still rides
+    gov2 = _governor()
+    v2 = gov2.finalize(dict(verdict="converged", reason="ok"))
+    assert v2["verdict"] == "converged" and "early_stop" not in v2
+    assert v2["control"]["decisions"] == 0
+
+
+def test_governor_and_postmortem_share_the_rolling_window():
+    # one big ancient drop, then a WHOLE governor window flat at the
+    # same ops: the full history still reads "decaying" off that first
+    # sweep (budget_exhausted), the rolling window reads the flatline
+    # for what it is (stalled) — and the live governor stops on the
+    # SAME windowed judgment the killed-run re-assessment would make
+    hist = [_rec(0, 0, nsplit=1000, in_band=0.5)] + [
+        _rec(0, 1 + k, nsplit=100, in_band=0.5)
+        for k in range(health.GOVERN_WINDOW + 2)
+    ]
+    full = health.assess(hist, max_sweeps=None)
+    rolled = health.assess(hist, max_sweeps=None,
+                           window=health.GOVERN_WINDOW)
+    assert full["verdict"] == "budget_exhausted"
+    assert rolled["verdict"] == "stalled"
+    assert rolled["window"] == health.GOVERN_WINDOW
+    gov = _governor()
+    d = gov.check_sweep(hist, it=0, sweep=len(hist) - 1, budget=30)
+    assert d["action"] == "early_stop" and d["verdict"] == "stalled"
+
+
+def test_in_band_slope():
+    assert health.in_band_slope([]) is None
+    assert health.in_band_slope([_rec(0, 0, in_band=0.5)]) is None
+    hist = [_rec(0, k, in_band=0.2 + 0.1 * k) for k in range(5)]
+    assert health.in_band_slope(hist) == pytest.approx(0.1)
+    assert health.in_band_slope(hist, window=2) == pytest.approx(0.1)
+
+
+def test_resolve_governor_env_and_option(monkeypatch):
+    class Opts:
+        govern = None
+        converge_frac = 0.01
+
+    monkeypatch.delenv(control.GOVERN_ENV, raising=False)
+    assert control.resolve_governor(Opts()) is None
+    monkeypatch.setenv(control.GOVERN_ENV, "1")
+    gov = control.resolve_governor(Opts())
+    assert gov is not None and gov.converge_frac == 0.01
+    monkeypatch.setenv(control.GOVERN_ENV, "0")
+    assert control.resolve_governor(Opts()) is None
+    # the option beats the env in both directions
+    on = Opts()
+    on.govern = True
+    assert control.resolve_governor(on) is not None
+    monkeypatch.setenv(control.GOVERN_ENV, "1")
+    off = Opts()
+    off.govern = False
+    assert control.resolve_governor(off) is None
+
+
+def test_governor_window_env_override(monkeypatch):
+    monkeypatch.setenv("PMMGTPU_GOVERN_WINDOW", "5")
+    monkeypatch.setenv("PMMGTPU_GOVERN_SLOPE", "0.02")
+    gov = control.RunGovernor()
+    assert gov.window == 5 and gov.min_slope == 0.02
+
+
+# ---------------------------------------------------------------------------
+# history-quoted balance band (parallel.migrate)
+# ---------------------------------------------------------------------------
+
+
+def test_balance_band_quoted_from_history(tmp_path, monkeypatch):
+    from parmmg_tpu.parallel import migrate
+
+    class Opts:
+        balance_band = None
+
+    db = tmp_path / "db.jsonl"
+    rows = [_db_rec("dist-p2", "tets_per_sec_distributed", 500.0,
+                    imbalance=imb, wall_s=30.0)
+            for imb in (1.30, 1.20, 1.40)]
+    db.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    monkeypatch.delenv("PMMGTPU_BALANCE_BAND", raising=False)
+    monkeypatch.setenv(migrate.BALANCE_DB_ENV, str(db))
+    migrate._BAND_CACHE.clear()
+    band = migrate.resolve_balance_band(Opts())
+    assert band == pytest.approx(1.25 * 1.30)
+    # explicit env band still wins over the quote
+    monkeypatch.setenv("PMMGTPU_BALANCE_BAND", "1.9")
+    assert migrate.resolve_balance_band(Opts()) == 1.9
+
+
+def test_balance_band_falls_back_without_imbalance(tmp_path,
+                                                   monkeypatch):
+    from parmmg_tpu.parallel import migrate
+
+    class Opts:
+        balance_band = None
+
+    monkeypatch.delenv("PMMGTPU_BALANCE_BAND", raising=False)
+    # no db named: the conservative default
+    monkeypatch.delenv(migrate.BALANCE_DB_ENV, raising=False)
+    migrate._BAND_CACHE.clear()
+    assert migrate.resolve_balance_band(Opts()) == \
+        migrate.BALANCE_BAND_DEFAULT
+    # a db whose dist records carry no imbalance: same fallback
+    db = tmp_path / "db.jsonl"
+    db.write_text(json.dumps(_db_rec(
+        "dist-p2", "tets_per_sec_distributed", 500.0)) + "\n")
+    monkeypatch.setenv(migrate.BALANCE_DB_ENV, str(db))
+    migrate._BAND_CACHE.clear()
+    assert migrate.resolve_balance_band(Opts()) == \
+        migrate.BALANCE_BAND_DEFAULT
+    # the derived band is cached per (path, platform)
+    assert migrate._BAND_CACHE

@@ -181,6 +181,183 @@ def worker(ckdir: str) -> None:
     sys.exit(0)
 
 
+def govern_worker() -> None:
+    """Child mode for ``--govern``: one governed forced-oscillation
+    run. A discontinuous metric (0.5 -> 0.13 edge targets split at
+    x=0.5) keeps split and collapse fighting over the same band of
+    elements, so an ungoverned run burns its whole
+    ``niter x max_sweeps`` budget churning. With the governor armed
+    the run must instead end EARLY with the typed verdict and a sweep
+    refund — reported as a ``GOVERN_RESULT`` line the parent
+    asserts."""
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _accel in ("axon", "tpu", "cuda", "rocm"):
+        _xb._backend_factories.pop(_accel, None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    mesh = unit_cube_mesh(3, perturb=0.1, seed=3)
+    x = np.asarray(mesh.vert[:, 0])
+    h = np.where(x < 0.5, 0.5, 0.13)
+    # met_set=True or prepare_metric overwrites the discontinuity
+    mesh = mesh.replace(met=jnp.asarray(h, mesh.vert.dtype)[:, None],
+                        met_set=True)
+    budget, niter = 30, 3
+    _out, info = adapt(
+        mesh,
+        AdaptOptions(niter=niter, max_sweeps=budget, converge_frac=0.0,
+                     hgrad=None, polish_sweeps=0, govern=True),
+    )
+    hlt = info["health"]
+    ctl = hlt.get("control", {})
+    sweeps = len([r for r in info["history"] if "nsplit" in r])
+    print(
+        f"GOVERN_RESULT verdict={hlt['verdict']} "
+        f"early_stop={int(bool(hlt.get('early_stop')))} "
+        f"refunded={ctl.get('refunded_sweeps', 0)} "
+        f"decisions={ctl.get('decisions', 0)} "
+        f"sweeps={sweeps} budget={budget * niter}",
+        flush=True,
+    )
+    sys.exit(0)
+
+
+def main_govern(args) -> int:
+    """The run-governor acceptance scenario: a seeded forced-churn run
+    with ``PMMGTPU_GOVERN`` control points must (a) terminate early —
+    inside the stage watchdog, well under its sweep budget — with the
+    typed ``oscillating``/``stalled`` verdict, (b) refund the unused
+    budget (counter + ``info["health"]["control"]``), and (c) leave
+    ``control_decision`` events the real ``obs_report --control`` CLI
+    renders as the decision post-mortem."""
+    import glob
+    import json as _json
+
+    tmp = tempfile.mkdtemp(prefix="parmmg_chaos_gov_")
+    failures = []
+    try:
+        obs = os.path.join(tmp, "obs")
+        log = os.path.join(tmp, "govern.log")
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(JAX_PLATFORMS="cpu", PMMGTPU_TRACE=obs)
+        try:
+            with open(log, "w") as lf:
+                p = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--govern-worker"],
+                    env=env, stdout=lf, stderr=subprocess.STDOUT,
+                    timeout=RUN_TIMEOUT,
+                )
+        except subprocess.TimeoutExpired:
+            failures.append(
+                "govern: HANG — the governor must terminate a forced "
+                "oscillation inside the watchdog")
+            raise SystemExit
+        text = open(log).read()
+        if p.returncode != 0:
+            failures.append(
+                f"govern: worker exited {p.returncode}: "
+                f"…{text[-1500:]}")
+            raise SystemExit
+        res = {}
+        for ln in reversed(text.splitlines()):
+            if ln.startswith("GOVERN_RESULT"):
+                res = dict(tok.split("=", 1) for tok in ln.split()[1:])
+                break
+        if not res:
+            failures.append(f"govern: no GOVERN_RESULT line: "
+                            f"…{text[-1500:]}")
+            raise SystemExit
+        label = (f"govern: verdict={res.get('verdict')} "
+                 f"refunded={res.get('refunded')}")
+        if res.get("early_stop") != "1":
+            failures.append(f"{label}: run was NOT early-stopped")
+            raise SystemExit
+        if res.get("verdict") not in ("oscillating", "stalled"):
+            failures.append(f"{label}: verdict is not the typed "
+                            "churn family")
+            raise SystemExit
+        if int(res.get("refunded", 0)) <= 0:
+            failures.append(f"{label}: no sweep budget was refunded")
+            raise SystemExit
+        if int(res.get("sweeps", 0)) >= int(res.get("budget", 0)):
+            failures.append(f"{label}: the full sweep budget was "
+                            "spent — that is not an early stop")
+            raise SystemExit
+        print(f"[chaos-govern] forced churn stopped typed "
+              f"'{res['verdict']}' after {res['sweeps']} of "
+              f"{res['budget']} budgeted sweep(s), "
+              f"{res['refunded']} refunded")
+
+        # the durable timeline must carry the decision events (they
+        # survive even a killed run — same stdlib-parse rule as the
+        # chaos post-mortem, the parent stays jax-free)
+        actions = []
+        for path in sorted(glob.glob(
+                os.path.join(obs, "events_rank*.jsonl"))):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = _json.loads(line)
+                    except _json.JSONDecodeError:
+                        continue
+                    if rec.get("type") == "event" and \
+                            rec.get("name") == "control_decision":
+                        actions.append(
+                            rec.get("args", {}).get("action"))
+        if "early_stop" not in actions:
+            failures.append(
+                f"govern: timeline carries no early_stop "
+                f"control_decision event (saw {actions})")
+            raise SystemExit
+
+        # post-mortem through the REAL CLI: the refund must render
+        p2 = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "tools", "obs_report.py"),
+             obs, "--control", "1"],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        if p2.returncode != 0:
+            failures.append(
+                f"govern: --control post-mortem failed: "
+                f"{p2.stdout[-1000:]}{p2.stderr[-1000:]}")
+            raise SystemExit
+        for want in ("control decisions", "early_stop",
+                     "refunded sweeps", "final verdict"):
+            if want not in p2.stdout:
+                failures.append(
+                    f"govern: --control post-mortem misses "
+                    f"{want!r}:\n{p2.stdout}")
+                raise SystemExit
+        print(f"[chaos-govern] --control post-mortem renders "
+              f"{len(actions)} decision(s) incl. the early stop + "
+              "refund")
+        print("[chaos-govern] the governor converted runaway churn "
+              "into a typed early stop with its budget refunded")
+        return 0
+    except SystemExit:
+        pass
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("\n[chaos-govern] FAILURES:")
+    for f in failures:
+        print(" -", f)
+    return 1
+
+
 def gen_schedule(rng: random.Random):
     """One seeded single-rank schedule: (spec string, terminal kind or
     None, trajectory-altering?, async staging?, flip kernel backend on
@@ -1070,6 +1247,8 @@ def main_desync(args) -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(sys.argv[2])
+    if len(sys.argv) > 1 and sys.argv[1] == "--govern-worker":
+        govern_worker()
     ap = argparse.ArgumentParser()
     ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--seed-base", type=int, default=0)
@@ -1085,9 +1264,16 @@ if __name__ == "__main__":
                          "it1:comm:desync@rank1 must end in the typed "
                          "divergence exit on EVERY rank (the "
                          "collective-lockstep ledger), never a hang")
+    ap.add_argument("--govern", action="store_true",
+                    help="run-governor rung: a forced split<->collapse "
+                         "oscillation must terminate EARLY with the "
+                         "typed verdict, a refunded sweep budget and "
+                         "a rendered control_decision post-mortem")
     args = ap.parse_args()
     if args.elastic:
         sys.exit(main_elastic(args))
     if args.desync:
         sys.exit(main_desync(args))
+    if args.govern:
+        sys.exit(main_govern(args))
     sys.exit(main(args) if args.world == 1 else main_world(args))

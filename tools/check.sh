@@ -162,6 +162,17 @@ rc=$?
 echo "## health-smoke rc=$rc"
 [ $rc -ne 0 ] && exit $rc
 
+# run-governor smoke: a governed forced-oscillation run must stop
+# EARLY with the typed verdict and its unused sweep budget refunded
+# (counter control/refunded_sweeps + a rendered obs_report --control
+# decision log), a healthy improving run must NOT be stopped, and SLO
+# admission must refuse an infeasible deadline typed at submit while
+# stamping deadline-less jobs with the PERF_DB-derived default
+timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/control_smoke.py
+rc=$?
+echo "## control-smoke rc=$rc"
+[ $rc -ne 0 ] && exit $rc
+
 # adaptation-service smoke: the mixed poisoned batch through the real
 # tools/serve.py process — typed too-large refusal, nan + deadline
 # members contained to their own typed terminals, SIGKILL mid-batch +

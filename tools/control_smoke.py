@@ -1,0 +1,214 @@
+"""Run-governor smoke for the CI gate (tools/check.sh control stage).
+
+The closed-loop control acceptance, end to end on the hermetic CPU
+harness (`parmmg_tpu.control` + `service.admission.SloPolicy`):
+
+1. **forced-oscillation leg** — a governed run over a discontinuous
+   metric (a 0.5 -> 0.13 target-size jump at x=0.5, the classic
+   split<->collapse churn driver) must terminate EARLY with the typed
+   ``oscillating``/``stalled`` verdict, refund its unused sweep budget
+   (counter ``control/refunded_sweeps``, the refund folded into
+   ``info["health"]["control"]``), and leave ``control_decision``
+   trace events that ``obs_report --control`` renders;
+2. **improving-run leg** — the SAME governor over a healthy converging
+   run must never early-stop: control refuses to trade quality it can
+   see accruing (the in_band slope guard + the decaying-ops verdict);
+3. **admission leg** — a `JobServer` armed with a PERF_DB fixture
+   (``serve-<class>`` throughput history) refuses an infeasible
+   deadline TYPED at submit (``slo-infeasible``, journaled
+   ``rejected``, counter ``serve/refused_slo_infeasible``) and stamps
+   a deadline-less job with the data-derived ``quote x margin``
+   default.
+
+Exit 0 = the governor stops what telemetry condemns, spares what it
+clears, and admission quotes what history proves.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def oscillation_mesh():
+    """The validated forced-churn scenario: a perturbed cube whose
+    metric demands 0.5-edges on one half and 0.13-edges on the other —
+    the discontinuity keeps split and collapse fighting over the same
+    band of elements sweep after sweep."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    mesh = unit_cube_mesh(3, perturb=0.1, seed=3)
+    x = np.asarray(mesh.vert[:, 0])
+    h = np.where(x < 0.5, 0.5, 0.13)
+    # met_set=True or prepare_metric overwrites the discontinuity with
+    # implied sizes
+    return mesh.replace(met=jnp.asarray(h, mesh.vert.dtype)[:, None],
+                        met_set=True)
+
+
+def main() -> int:
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _accel in ("axon", "tpu", "cuda", "rocm"):
+        _xb._backend_factories.pop(_accel, None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from parmmg_tpu.models.adapt import AdaptOptions, adapt
+    from parmmg_tpu.obs import health as obs_health
+    from parmmg_tpu.obs import metrics as obs_metrics
+    from parmmg_tpu.obs import report as obs_report
+    from parmmg_tpu.obs import trace as obs_trace
+    from parmmg_tpu.utils.gen import unit_cube_mesh
+
+    tmp = tempfile.mkdtemp(prefix="parmmg_control_smoke_")
+    obs_dir = os.path.join(tmp, "obs")
+    try:
+        # 1. forced oscillation: the governor must stop it early ------
+        obs_metrics.registry().reset()
+        obs_health.run_state().reset()
+        tr = obs_trace.Tracer(obs_dir)
+        budget = 30
+        _out, info = adapt(
+            oscillation_mesh(),
+            AdaptOptions(niter=3, max_sweeps=budget, converge_frac=0.0,
+                         hgrad=None, polish_sweeps=0, govern=True),
+            tracer=tr,
+        )
+        tr.flush()
+        health = info["health"]
+        assert health.get("early_stop"), (
+            "governed forced-oscillation run did not early-stop: "
+            f"{health}"
+        )
+        assert health["verdict"] in ("oscillating", "stalled"), health
+        assert health["reason"].startswith("governor early stop"), \
+            health["reason"]
+        ctl = health["control"]
+        assert ctl["refunded_sweeps"] > 0, ctl
+        assert ctl["decisions"] >= 1, ctl
+        refunded = obs_metrics.registry().counter(
+            "control/refunded_sweeps").value
+        assert refunded == ctl["refunded_sweeps"], \
+            (refunded, ctl["refunded_sweeps"])
+        sweeps_run = len([r for r in info["history"] if "nsplit" in r])
+        assert sweeps_run < budget * 3, (
+            "early stop claimed but the full budget was spent"
+        )
+        print(f"[control-smoke] forced oscillation -> "
+              f"verdict={health['verdict']} early_stop after "
+              f"{sweeps_run} sweep(s), {ctl['refunded_sweeps']} "
+              "refunded")
+
+        # the decision log is a rendered artifact, not just counters
+        s = obs_report.control_summary(obs_dir)
+        acts = s["by_action"]
+        assert acts.get("early_stop", 0) >= 1, acts
+        assert s["refunded_sweeps"] > 0, s
+        text = obs_report.render_control(obs_dir)
+        for want in ("control decisions", "early_stop", "refunded",
+                     "final verdict"):
+            assert want in text, (want, text)
+        print(f"[control-smoke] --control renders "
+              f"{len(s['decisions'])} decision(s): "
+              + "  ".join(f"{k} {v}" for k, v in sorted(acts.items())))
+
+        # 2. healthy improving run: the governor must NOT stop it -----
+        obs_metrics.registry().reset()
+        obs_health.run_state().reset()
+        _out2, info2 = adapt(
+            unit_cube_mesh(2),
+            AdaptOptions(hsiz=0.5, niter=1, max_sweeps=8, hgrad=None,
+                         polish_sweeps=0, govern=True),
+        )
+        h2 = info2["health"]
+        assert not h2.get("early_stop"), (
+            "governor early-stopped a healthy improving run: "
+            f"{h2}"
+        )
+        assert h2["verdict"] not in ("oscillating", "stalled"), h2
+        assert "control" in h2, h2
+        print(f"[control-smoke] healthy run spared -> "
+              f"verdict={h2['verdict']} "
+              f"(decisions={h2['control']['decisions']})")
+
+        # 3. SLO admission vs a PERF_DB fixture -----------------------
+        from parmmg_tpu.io import ckpt_store, medit
+        from parmmg_tpu.service import JobServer, JobSpec, SizeClass
+        from parmmg_tpu.service.jobs import SloInfeasibleError
+
+        tiny = SizeClass("t", pcap=256, tcap=1024, fcap=256, ecap=256)
+        db_path = os.path.join(tmp, "perf_db.jsonl")
+        with open(db_path, "w") as f:
+            for i, jpm in enumerate((140.0, 150.0, 145.0)):
+                f.write(json.dumps(dict(
+                    rung="serve-t", platform="cpu",
+                    metric="jobs_per_min", value=jpm,
+                    unit="jobs/min", run_id=f"fix{i}",
+                    warmup_s=30.0,
+                )) + "\n")
+        os.environ["PMMGTPU_SLO_PLATFORM"] = "cpu"
+        ckpt_store.memory_bucket("control-smoke").clear()
+        server = JobServer(
+            ckpt_store.make_store("mem://control-smoke", None),
+            classes=(tiny,), slo=db_path,
+        )
+        quote = server.slo.quote("t")
+        assert quote and quote["baseline_n"] == 3, quote
+        inmesh = os.path.join(tmp, "cube.mesh")
+        medit.save_mesh(unit_cube_mesh(2), inmesh)
+
+        # infeasible deadline: refused typed, journaled rejected
+        try:
+            server.submit(JobSpec(job_id="slo-bad", inmesh=inmesh,
+                                  deadline_s=quote["latency_s"] / 10))
+            raise AssertionError(
+                "infeasible deadline was admitted (quote "
+                f"{quote['latency_s']}s)"
+            )
+        except SloInfeasibleError as err:
+            doc = err.doc()
+            assert doc["code"] == "slo-infeasible", doc
+            assert doc["transient"] is False, doc
+            assert doc["quoted_s"] == quote["latency_s"], doc
+        jdoc = server.journal.load("slo-bad")
+        assert jdoc and jdoc["state"] == "rejected", jdoc
+        refused = obs_metrics.registry().counter(
+            "serve/refused_slo_infeasible").value
+        assert refused == 1, refused
+        print(f"[control-smoke] deadline {quote['latency_s'] / 10:.4f}s"
+              f" < quote {quote['latency_s']}s -> typed slo-infeasible"
+              " at submit, journaled rejected")
+
+        # deadline-less job: data-derived default = quote x margin
+        # plus the rung's recorded warmup as the cold-start allowance
+        rec = server.submit(JobSpec(job_id="slo-ok", inmesh=inmesh))
+        got = rec["spec"]["deadline_s"]
+        want = round(quote["latency_s"] * server.slo.margin
+                     + quote["warmup_s"], 3)
+        assert got == want, (got, want)
+        print(f"[control-smoke] deadline-less job stamped "
+              f"{got}s (= quote x {server.slo.margin} margin "
+              f"+ {quote['warmup_s']}s warmup allowance)")
+
+        print("[control-smoke] OK: governor stops churn, spares "
+              "progress; admission quotes history")
+        return 0
+    finally:
+        os.environ.pop("PMMGTPU_SLO_PLATFORM", None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

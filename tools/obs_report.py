@@ -21,6 +21,14 @@ Usage:
                                   # stalled / oscillating /
                                   # budget_exhausted) with reasons,
                                   # drain curve + ETA, sweep history
+  python tools/obs_report.py <trace-dir> --control 1 # run-governor
+                                  # decision log: every hold /
+                                  # early_stop / tune_budget /
+                                  # shorten_niter control_decision
+                                  # event with its reason, the sweep
+                                  # refund total, and the final
+                                  # (possibly governor-overridden)
+                                  # health verdict
   python tools/obs_report.py <trace-dir> --dist 1   # cross-rank view:
                                   # clock-aligned per-rank timelines,
                                   # per-phase collective decomposition
@@ -92,6 +100,13 @@ def main():
                              indent=1, default=str))
             return 0
         print(obs_report.render_health(trace_dir))
+        return 0
+    if flags.get("control", "") not in ("", "0"):
+        if flags.get("json", "") not in ("", "0"):
+            print(json.dumps(obs_report.control_summary(trace_dir),
+                             indent=1, default=str))
+            return 0
+        print(obs_report.render_control(trace_dir))
         return 0
     if flags.get("serve", "") not in ("", "0"):
         if flags.get("json", "") not in ("", "0"):

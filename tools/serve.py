@@ -26,10 +26,17 @@ the OS boundary:
   pre-pay, N synthetic jobs of one size class, headline
   ``jobs_per_min`` recorded as PERF_DB rung ``serve-<class>``.
 
+- **SLO admission** (``--slo PERF_DB.jsonl``): arm
+  `service.admission.SloPolicy` with the named history — explicit
+  deadlines below the rolling-median ``serve-<class>`` quote are
+  refused typed (``slo-infeasible``) at submit; deadline-less jobs get
+  ``quote x PMMGTPU_SLO_MARGIN`` as their data-derived default.
+
 Usage::
 
   python tools/serve.py --spool DIR [--journal SPEC] [--warmup 1]
       [--idle-exit S] [--trace DIR] [--status PORT]
+      [--slo PERF_DB.jsonl]
   python tools/serve.py --solo spec.json [--journal SPEC]
   python tools/serve.py --bench 1 [--jobs 6] [--size-class tiny]
       [--db PERF_DB.jsonl --update 1]
@@ -251,7 +258,8 @@ def main_bench(args):
         store = make_store(spec)
         server = JobServer(store, classes=classes,
                            queue_cap=max(args.jobs, 4),
-                           batch_max=args.batch_max)
+                           batch_max=args.batch_max,
+                           slo=getattr(args, "slo", None))
         warmup_s = server.warmup() if args.warmup else 0.0
         if args.warmup:
             print(f"[serve-bench] warmup {warmup_s}s "
@@ -341,6 +349,11 @@ def main() -> int:
                     help="bench: write the enveloped record here")
     ap.add_argument("--db", default=None,
                     help="bench: PERF_DB.jsonl to gate against")
+    ap.add_argument("--slo", default=None,
+                    help="PERF_DB.jsonl to quote SLO admission from: "
+                         "infeasible deadlines are refused typed at "
+                         "submit, deadline-less jobs get quote x "
+                         "PMMGTPU_SLO_MARGIN")
     ap.add_argument("--update", default="0",
                     help="bench: append the record to --db")
     ap.add_argument("--rel-floor", type=float, default=0.5,
@@ -373,7 +386,10 @@ def main() -> int:
         store = make_store(args.journal)
         server = JobServer(store, classes=_classes_arg(args.size_class),
                            queue_cap=args.queue_cap,
-                           batch_max=args.batch_max)
+                           batch_max=args.batch_max,
+                           slo=args.slo)
+        if args.slo:
+            print(f"[serve] SLO admission quoting from {args.slo}")
         if args.warmup:
             s = server.warmup()
             print(f"[serve] warmup {s}s")
